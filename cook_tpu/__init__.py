@@ -1,0 +1,30 @@
+"""cook_tpu — a TPU-native, multi-tenant fair-share batch scheduler.
+
+A from-scratch framework with the capabilities of Two Sigma's Cook
+(reference: /root/reference): DRU fair-share ranking, job<->offer
+bin-packing with hard placement constraints, score-based preemption,
+per-user shares/quotas/rate-limits, pools, job groups, a REST API +
+CLI/clients, and pluggable compute backends.
+
+Unlike the Clojure/Fenzo/Datomic reference, the per-cycle scheduling math
+(rank / match / rebalance) is implemented as vectorized JAX/XLA kernels
+that run on TPU, sharded over a device mesh for multi-pool / large-cluster
+operation (see cook_tpu.parallel).
+
+Layout:
+  ops/        pure JAX kernels: dru ranking, match, rebalance (the Fenzo
+              + dru.clj + rebalancer.clj equivalents)
+  parallel/   jax.sharding Mesh / shard_map wrappers for pool- and
+              offer-sharded cycles
+  state/      durable job state store: event log + snapshot, job/instance
+              state machines, shares/quotas/rate-limits (the Datomic role)
+  scheduler/  cycle orchestration: rank loop, match loop, rebalancer,
+              constraints, stragglers, unscheduled reasons
+  backends/   ComputeCluster protocol + mock backend + k8s-style controller
+  rest/       HTTP API (reference: scheduler/src/cook/rest/api.clj)
+  cli/        `cs`-style command-line client
+  client/     Python job client library
+  native/     C++ host-side runtime components (event log, oracle matcher)
+"""
+
+__version__ = "0.1.0"

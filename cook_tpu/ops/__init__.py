@@ -1,0 +1,12 @@
+"""Pure JAX kernels for the per-cycle scheduling math.
+
+These are the TPU-native equivalents of the reference's hot loops:
+  dru.py       <- cook.scheduler.dru (dru.clj) fair-share ranking
+  match.py     <- Fenzo TaskScheduler.scheduleOnce bin-packing
+  rebalance.py <- cook.rebalancer compute-preemption-decision
+  segments.py  <- shared segment-scan helpers
+
+All kernels are pure functions of padded, fixed-shape arrays (SoA layout)
+so they jit once per bucket size and run entirely on device.
+"""
+from cook_tpu.ops import segments  # noqa: F401

@@ -1,0 +1,243 @@
+"""Preemption (rebalancer) kernel.
+
+TPU-native re-design of cook.rebalancer (rebalancer.clj; DRF design doc
+in its header comments :37-145). Per cycle, for up to `max_preemption`
+pending jobs in fair-queue order:
+
+  1. compute the pending job's DRU: DRU of its user's "nearest" running
+     task (the latest task that would sort before it) plus the job's own
+     dominant share (rebalancer.clj:183-207),
+  2. candidate victims = running tasks with dru >= safe-dru-threshold and
+     dru - pending_dru > min-dru-diff; if the pending user is over quota,
+     only their own tasks qualify (rebalancer.clj:330-344),
+  3. on each host, consider prefixes of candidates in global-DRU-DESC
+     order, seeded with the host's spare resources as a dru=+inf
+     pseudo-task (rebalancer.clj:346-349,375-392); the first prefix whose
+     cumulative (mem, cpus) covers the job is that host's best decision,
+  4. across hosts, pick the decision maximizing the minimum preempted DRU
+     (rebalancer.clj:399 max-key :dru — ties resolve to the *last* host),
+  5. update state: victims leave, the job "starts" on the chosen host,
+     DRUs recompute (next-state, rebalancer.clj:269-308).
+
+The reference walks a JVM priority map per job; here each step is a sort
++ segmented cumsum over all (tasks + hosts) at once, and the sequential
+outer loop is a lax.scan whose carry holds the mutable cluster state.
+DRUs are *fully recomputed* each step on device (cheap: one fused sort
+pipeline) instead of incrementally patched like dru.clj:123-139.
+
+Shapes: T task slots (running tasks padded, plus `max_preemption` empty
+slots that the scan fills with placed pending jobs), H hosts, P pending
+candidates, U users.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cook_tpu.ops.segments import segment_cumsum
+from cook_tpu.ops import dru as dru_ops
+
+INF = jnp.float32(jnp.finfo(jnp.float32).max)
+
+
+class TaskState(NamedTuple):
+    """Running tasks (mutable through the scan). Length T."""
+
+    user: jnp.ndarray       # i32
+    mem: jnp.ndarray        # f32
+    cpus: jnp.ndarray       # f32
+    priority: jnp.ndarray   # i32
+    start_time: jnp.ndarray  # i64
+    host: jnp.ndarray       # i32
+    valid: jnp.ndarray      # bool (False once preempted / empty slot)
+    mem_share: jnp.ndarray  # f32 per-task user share divisors
+    cpus_share: jnp.ndarray
+
+
+class PendingJobs(NamedTuple):
+    """Pending jobs to try to make room for, in fair-queue order. Length P."""
+
+    user: jnp.ndarray
+    mem: jnp.ndarray
+    cpus: jnp.ndarray
+    priority: jnp.ndarray
+    start_time: jnp.ndarray
+    valid: jnp.ndarray
+    mem_share: jnp.ndarray
+    cpus_share: jnp.ndarray
+
+
+class RebalanceResult(NamedTuple):
+    job_placed: jnp.ndarray   # (P,) bool
+    job_host: jnp.ndarray     # (P,) i32, -1 when not placed
+    preempted: jnp.ndarray    # (T,) bool — tasks chosen for preemption
+    spare_mem: jnp.ndarray    # (H,) f32 final spare view
+    spare_cpus: jnp.ndarray
+
+
+def _key_leq(p1, s1, i1, p2, s2, i2):
+    """Lexicographic (-priority, start_time, id) <= comparison."""
+    lt = (p1 > p2) | ((p1 == p2) & ((s1 < s2) | ((s1 == s2) & (i1 <= i2))))
+    return lt
+
+
+@functools.partial(jax.jit, static_argnames=())
+def rebalance(tasks: TaskState,
+              pending: PendingJobs,
+              spare_mem: jnp.ndarray,
+              spare_cpus: jnp.ndarray,
+              host_forbidden: jnp.ndarray,
+              user_quota_mem: jnp.ndarray,
+              user_quota_cpus: jnp.ndarray,
+              user_quota_count: jnp.ndarray,
+              safe_dru_threshold: jnp.ndarray | float,
+              min_dru_diff: jnp.ndarray | float) -> RebalanceResult:
+    """Run one rebalancer cycle.
+
+    host_forbidden: (P, H) bool — hosts each pending job may NOT use
+    (job/group constraints evaluated by cook_tpu.scheduler.constraints,
+    rebalancer path rebalancer.clj:351-370).
+    user_quota_*: (U,) per-user quota, +inf / INT_MAX when unset.
+    The `tasks` arrays must have at least P trailing invalid slots: placed
+    pending jobs are materialized there so later decisions see them.
+    """
+    T = tasks.user.shape[0]
+    H = spare_mem.shape[0]
+    P = pending.user.shape[0]
+    task_idx = jnp.arange(T)
+    safe_dru_threshold = jnp.float32(safe_dru_threshold)
+    min_dru_diff = jnp.float32(min_dru_diff)
+
+    # Per-user running usage for the quota test (job-below-quota,
+    # rebalancer.clj:209-219).
+    U = user_quota_mem.shape[0]
+
+    def usage_of(valid, user, vals):
+        return jax.ops.segment_sum(jnp.where(valid, vals, 0.0),
+                                   jnp.where(valid, user, U),
+                                   num_segments=U + 1)[:U]
+
+    def step(carry, xs):
+        (t_user, t_mem, t_cpus, t_prio, t_start, t_host, t_valid,
+         t_mshare, t_cshare, preempted, sp_mem, sp_cpus, fill_ptr) = carry
+        (j_user, j_mem, j_cpus, j_prio, j_start, j_valid,
+         j_mshare, j_cshare, j_forbidden) = xs
+
+        # -- recompute DRUs over current task set ----------------------
+        ranked = dru_ops.dru_rank(t_user, t_mem, t_cpus, t_prio, t_start,
+                                  t_valid, t_mshare, t_cshare)
+        dru = ranked.dru
+
+        # -- pending job dru ------------------------------------------
+        same_user = t_valid & (t_user == j_user)
+        leq = _key_leq(t_prio, t_start, task_idx,
+                       j_prio, j_start, jnp.int32(2**30))
+        nearest = jnp.max(jnp.where(same_user & leq, dru, 0.0))
+        own_share = jnp.maximum(j_mem / j_mshare, j_cpus / j_cshare)
+        pending_dru = nearest + own_share
+
+        # -- quota test -----------------------------------------------
+        u_mem = usage_of(t_valid, t_user, t_mem)
+        u_cpus = usage_of(t_valid, t_user, t_cpus)
+        u_cnt = jax.ops.segment_sum(t_valid.astype(jnp.int32),
+                                    jnp.where(t_valid, t_user, U),
+                                    num_segments=U + 1)[:U]
+        uid = jnp.clip(j_user, 0, U - 1)
+        below_quota = ((u_mem[uid] + j_mem <= user_quota_mem[uid])
+                       & (u_cpus[uid] + j_cpus <= user_quota_cpus[uid])
+                       & (u_cnt[uid] + 1 <= user_quota_count[uid]))
+
+        # -- candidate victims ----------------------------------------
+        cand = (t_valid
+                & (dru >= safe_dru_threshold)
+                & (dru - pending_dru > min_dru_diff)
+                & (below_quota | (t_user == j_user)))
+
+        # -- per-host prefix feasibility ------------------------------
+        # Build a combined sequence: one spare pseudo-entry per host
+        # (dru=+inf) followed by that host's candidates in global
+        # (-dru, user) order. Sort key: (host, -dru, user, idx).
+        seq_host = jnp.concatenate([jnp.arange(H, dtype=jnp.int32),
+                                    jnp.where(cand, t_host, H)])
+        seq_dru = jnp.concatenate([jnp.full(H, INF), jnp.where(cand, dru, 0.0)])
+        seq_user = jnp.concatenate([jnp.full(H, -1, jnp.int32), t_user])
+        seq_mem = jnp.concatenate([sp_mem, jnp.where(cand, t_mem, 0.0)])
+        seq_cpus = jnp.concatenate([sp_cpus, jnp.where(cand, t_cpus, 0.0)])
+        n_seq = H + T
+        perm = jnp.lexsort((jnp.arange(n_seq), seq_user, -seq_dru, seq_host))
+        p_host = seq_host[perm]
+        cums = segment_cumsum(
+            jnp.stack([seq_mem[perm], seq_cpus[perm]], -1), p_host)
+        feas = ((cums[:, 0] >= j_mem) & (cums[:, 1] >= j_cpus)
+                & (p_host < H))
+        feas &= ~j_forbidden[jnp.clip(p_host, 0, H - 1)]
+        # first feasible position per host == the prefix with max min-dru
+        pos = jnp.arange(n_seq)
+        first_pos = jax.ops.segment_min(
+            jnp.where(feas, pos, n_seq),
+            jnp.clip(p_host, 0, H), num_segments=H + 1)[:H]
+        has_decision = first_pos < n_seq
+        decision_dru = jnp.where(
+            has_decision, seq_dru[perm][jnp.clip(first_pos, 0, n_seq - 1)],
+            -INF)
+
+        # -- choose host: max decision dru, ties -> last host ----------
+        best_host = jnp.where(
+            jnp.any(has_decision),
+            (H - 1) - jnp.argmax(decision_dru[::-1]),
+            -1)
+        placed = j_valid & (best_host >= 0)
+        best_host = jnp.where(placed, best_host, -1)
+        bh = jnp.clip(best_host, 0, H - 1)
+        cut = jnp.where(placed, first_pos[bh], -1)
+
+        # victims: candidates on best_host at sorted position <= cut
+        sorted_pos_of = jnp.zeros(n_seq, jnp.int32).at[perm].set(
+            jnp.arange(n_seq, dtype=jnp.int32))
+        task_sorted_pos = sorted_pos_of[H:]
+        victim = cand & (t_host == best_host) & (task_sorted_pos <= cut) & placed
+
+        freed_mem = jnp.sum(jnp.where(victim, t_mem, 0.0)) + jnp.where(placed, sp_mem[bh], 0.0)
+        freed_cpus = jnp.sum(jnp.where(victim, t_cpus, 0.0)) + jnp.where(placed, sp_cpus[bh], 0.0)
+
+        # -- state update (next-state, rebalancer.clj:269-308) ---------
+        t_valid = t_valid & ~victim
+        preempted = preempted | victim
+        sp_mem = jnp.where(placed, sp_mem.at[bh].set(freed_mem - j_mem), sp_mem)
+        sp_cpus = jnp.where(placed, sp_cpus.at[bh].set(freed_cpus - j_cpus), sp_cpus)
+
+        # materialize the placed job as a running task in its fill slot
+        fp = jnp.clip(fill_ptr, 0, T - 1)
+        def put(arr, val):
+            return arr.at[fp].set(jnp.where(placed, val, arr[fp]))
+        t_user = put(t_user, j_user)
+        t_mem = put(t_mem, j_mem)
+        t_cpus = put(t_cpus, j_cpus)
+        t_prio = put(t_prio, j_prio)
+        t_start = put(t_start, j_start)
+        t_host = put(t_host, best_host)
+        t_mshare = put(t_mshare, j_mshare)
+        t_cshare = put(t_cshare, j_cshare)
+        t_valid = t_valid.at[fp].set(jnp.where(placed, True, t_valid[fp]))
+        fill_ptr = fill_ptr + placed.astype(jnp.int32)
+
+        carry = (t_user, t_mem, t_cpus, t_prio, t_start, t_host, t_valid,
+                 t_mshare, t_cshare, preempted, sp_mem, sp_cpus, fill_ptr)
+        return carry, (placed, best_host)
+
+    first_free = jnp.int32(T - P)  # pending fill slots are the P trailing ones
+    carry = (tasks.user, tasks.mem, tasks.cpus, tasks.priority,
+             tasks.start_time, tasks.host, tasks.valid,
+             tasks.mem_share, tasks.cpus_share,
+             jnp.zeros(T, bool), spare_mem, spare_cpus, first_free)
+    xs = (pending.user, pending.mem, pending.cpus, pending.priority,
+          pending.start_time, pending.valid, pending.mem_share,
+          pending.cpus_share, host_forbidden)
+    carry, (placed, hostv) = jax.lax.scan(step, carry, xs)
+    preempted = carry[9]
+    return RebalanceResult(job_placed=placed, job_host=hostv,
+                           preempted=preempted,
+                           spare_mem=carry[10], spare_cpus=carry[11])

@@ -1,0 +1,50 @@
+"""Segment-scan helpers shared by the ranking / rebalance kernels.
+
+The reference computes per-user (and per-host) running sums with lazy
+Clojure `reductions` (dru.clj:40-45, rebalancer.clj:379-392). On TPU the
+same computation is a segmented cumulative sum over arrays that have been
+sorted so each segment (user, host, ...) is contiguous.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def segment_starts(seg_ids: jnp.ndarray) -> jnp.ndarray:
+    """Boolean mask marking the first element of each contiguous segment.
+
+    `seg_ids` must be sorted (each segment contiguous).
+    """
+    n = seg_ids.shape[0]
+    idx = jnp.arange(n)
+    return jnp.where(idx == 0, True, seg_ids != jnp.roll(seg_ids, 1))
+
+
+def segment_cumsum(values: jnp.ndarray, seg_ids: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive cumulative sum that restarts at each segment boundary.
+
+    `seg_ids` must be sorted. Works on float or int arrays; leading axis is
+    the scan axis, extra trailing axes are carried through.
+    """
+    total = jnp.cumsum(values, axis=0)
+    starts = segment_starts(seg_ids)
+    n = seg_ids.shape[0]
+    idx = jnp.arange(n)
+    # Index of the start of each element's segment, propagated forward.
+    start_idx = jnp.maximum.accumulate(jnp.where(starts, idx, -1))
+    # Sum of everything strictly before the segment start.
+    base = jnp.take(total, start_idx, axis=0) - jnp.take(values, start_idx, axis=0)
+    return total - base
+
+
+def segment_rank(seg_ids: jnp.ndarray) -> jnp.ndarray:
+    """0-based position of each element within its contiguous segment."""
+    ones = jnp.ones_like(seg_ids, dtype=jnp.int32)
+    return segment_cumsum(ones, seg_ids) - 1
+
+
+def first_true_index(mask: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Index of the first True along `axis`; size-of-axis when none."""
+    n = mask.shape[axis]
+    idx = jnp.where(mask, jnp.arange(n), n)
+    return jnp.min(idx, axis=axis)

@@ -1,0 +1,27 @@
+"""Test config: run JAX on 8 virtual CPU devices so the multi-chip
+sharding paths (pool-sharded match, psum reductions) are exercised without
+TPU hardware, mirroring how the driver dry-runs dryrun_multichip().
+
+The ambient environment pins JAX to the real TPU (axon tunnel) and its
+sitecustomize hook may already have imported jax and set the platform
+config, so we must override via jax.config, not just the env var.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Persistent compilation cache: kernel compiles dominate test wall-time on
+# the CPU backend; cache them across pytest runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,191 @@
+"""Pure-Python reference oracles for the scheduling math.
+
+These re-state the reference's algorithms (dru.clj, Fenzo bin-packing,
+rebalancer.clj) in the most direct sequential Python possible, and the
+JAX kernels are tested for equivalence against them on randomized inputs.
+This mirrors the reference's own strategy of testing DRU math functionally
+with plain data (test/cook/test/scheduler/dru.clj:25-144).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Task:
+    id: int
+    user: int
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    priority: int = 50
+    start_time: int = 0
+    host: int = -1
+
+
+def user_sort_key(t: Task):
+    # same-user-task-comparator (tools.clj:612-639): priority desc,
+    # start-time asc, id asc.
+    return (-t.priority, t.start_time, t.id)
+
+
+def dru_rank_oracle(tasks, shares):
+    """shares: user -> (mem_share, cpus_share). Returns list of
+    (task, dru) in global fair-queue order (dru.clj:111-121)."""
+    by_user = {}
+    for t in tasks:
+        by_user.setdefault(t.user, []).append(t)
+    per_user = {}
+    for user, ts in by_user.items():
+        ts.sort(key=user_sort_key)
+        mem_div, cpus_div = shares.get(user, (math.inf, math.inf))
+        cum_mem = cum_cpus = 0.0
+        scored = []
+        for t in ts:
+            cum_mem += t.mem
+            cum_cpus += t.cpus
+            scored.append((t, max(cum_mem / mem_div, cum_cpus / cpus_div)))
+        per_user[user] = scored
+    # k-way merge by dru ascending; tie-break deterministic by user
+    # (dru.clj:118 sort-by first), preserving per-user order.
+    out = []
+    for user in sorted(per_user):
+        for pos, (t, dru) in enumerate(per_user[user]):
+            out.append((dru, user, pos, t))
+    out.sort(key=lambda x: (x[0], x[1], x[2]))
+    return [(t, dru) for dru, _, _, t in out]
+
+
+def gpu_dru_rank_oracle(tasks, gpu_shares):
+    by_user = {}
+    for t in tasks:
+        by_user.setdefault(t.user, []).append(t)
+    out = []
+    for user in sorted(by_user):
+        ts = sorted(by_user[user], key=user_sort_key)
+        div = gpu_shares.get(user, math.inf)
+        cum = 0.0
+        for pos, t in enumerate(ts):
+            cum += t.gpus
+            out.append((cum / div, user, pos, t))
+    out.sort(key=lambda x: (x[0], x[1], x[2]))
+    return [(t, score) for score, _, _, t in out]
+
+
+@dataclass
+class Host:
+    id: int
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+
+def binpack_fitness(job, host_used_mem, host_used_cpus, host: Host):
+    """Fenzo CPUAndMemoryBinPacker: average of post-assignment
+    utilization fractions on cpu and mem."""
+    f_cpu = (host_used_cpus + job.cpus) / host.cpus if host.cpus > 0 else 0.0
+    f_mem = (host_used_mem + job.mem) / host.mem if host.mem > 0 else 0.0
+    return 0.5 * (f_cpu + f_mem)
+
+
+def match_oracle(jobs, hosts, forbidden=None, good_enough=1.01):
+    """Sequential greedy matcher with Fenzo semantics: take jobs in queue
+    order; assign each to the feasible host with the highest bin-packing
+    fitness (first host reaching `good_enough` wins, in host order);
+    deplete host resources. Returns {job_id: host_id}.
+
+    forbidden: set of (job_id, host_id) pairs that constraints exclude.
+    """
+    forbidden = forbidden or set()
+    used = {h.id: [0.0, 0.0, 0.0] for h in hosts}  # mem, cpus, gpus
+    assignment = {}
+    for j in jobs:
+        best, best_fit = None, -1.0
+        for h in hosts:
+            if (j.id, h.id) in forbidden:
+                continue
+            um, uc, ug = used[h.id]
+            if um + j.mem > h.mem + 1e-9 or uc + j.cpus > h.cpus + 1e-9:
+                continue
+            if j.gpus > 0 and ug + j.gpus > h.gpus + 1e-9:
+                continue
+            fit = binpack_fitness(j, um, uc, h)
+            if fit > best_fit + 1e-12:
+                best, best_fit = h, fit
+                if fit >= good_enough:
+                    break
+        if best is not None:
+            assignment[j.id] = best.id
+            used[best.id][0] += j.mem
+            used[best.id][1] += j.cpus
+            used[best.id][2] += j.gpus
+    return assignment
+
+
+def rebalance_oracle(running, spare, pending_job, shares,
+                     safe_dru_threshold, min_dru_diff,
+                     same_user_only=False, excluded_hosts=()):
+    """compute-preemption-decision (rebalancer.clj:317-401) for one
+    pending job. running: list[Task] with .host set; spare: host ->
+    (mem, cpus). Returns (host, [tasks to preempt], decision_dru) or None."""
+    ranked = dru_rank_oracle(running, shares)
+    dru_of = {t.id: d for t, d in ranked}
+
+    # pending job dru (rebalancer.clj:183-207): nearest same-user task
+    # sorting <= the would-be task, + job resources over divisors.
+    user_tasks = sorted((t for t in running if t.user == pending_job.user),
+                        key=user_sort_key)
+    pend_key = user_sort_key(pending_job)
+    nearest = None
+    for t in user_tasks:
+        if user_sort_key(t) <= pend_key:
+            nearest = t
+    nearest_dru = dru_of[nearest.id] if nearest else 0.0
+    mem_div, cpus_div = shares.get(pending_job.user, (math.inf, math.inf))
+    pending_dru = max(nearest_dru + pending_job.mem / mem_div,
+                      nearest_dru + pending_job.cpus / cpus_div)
+
+    # Candidate tasks: dru >= threshold and dru - pending > min_diff,
+    # in global dru-DESC order — the reversed priority map, keyfn
+    # (juxt -dru user) (rebalancer.clj:251-254,334-344).
+    cands = sorted(((t, d) for t, d in ranked
+                    if d >= safe_dru_threshold and d - pending_dru > min_dru_diff
+                    and (not same_user_only or t.user == pending_job.user)),
+                   key=lambda td: (-td[1], td[0].user))
+
+    by_host = {}
+    for t, d in cands:
+        by_host.setdefault(t.host, []).append((t, d))
+
+    best = None  # (decision_dru, host, tasks, freed_mem, freed_cpus)
+    hosts = set(by_host) | set(spare)
+    for host in sorted(hosts):
+        if host in excluded_hosts:
+            continue
+        sm, sc = spare.get(host, (0.0, 0.0))
+        tasks_prefix = []
+        cum_mem = cum_cpus = 0.0
+        # Spare resources act as a dru=+inf pseudo-task (rebalancer.clj:346-349)
+        chain = ([(None, math.inf, sm, sc)] if host in spare else []) + \
+                [(t, d, t.mem, t.cpus) for t, d in by_host.get(host, [])]
+        for t, d, m, c in chain:
+            cum_mem += m
+            cum_cpus += c
+            if t is not None:
+                tasks_prefix.append(t)
+            if cum_mem >= pending_job.mem and cum_cpus >= pending_job.cpus:
+                cand = (d, host, list(tasks_prefix), cum_mem, cum_cpus)
+                # max-key :dru over all feasible prefixes on all hosts;
+                # later (larger) prefixes have smaller d, so the first
+                # feasible prefix per host dominates the rest of its
+                # chain. Cross-host ties resolve to the LAST host
+                # (clojure max-key keeps the later argument).
+                if best is None or cand[0] >= best[0]:
+                    best = cand
+                break
+    if best is None:
+        return None
+    d, host, tasks, fm, fc = best
+    return host, tasks, d

@@ -1,0 +1,141 @@
+"""DRU ranking kernel vs. the sequential oracle.
+
+Mirrors the reference's functional DRU tests
+(test/cook/test/scheduler/dru.clj:25-144) plus randomized equivalence.
+"""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cook_tpu.ops import dru as dru_ops
+from tests.oracles import Task, dru_rank_oracle, gpu_dru_rank_oracle
+
+
+def to_arrays(tasks, shares, pad_to=None):
+    n = len(tasks)
+    pad_to = pad_to or n
+    user = np.zeros(pad_to, np.int32)
+    mem = np.zeros(pad_to, np.float32)
+    cpus = np.zeros(pad_to, np.float32)
+    prio = np.zeros(pad_to, np.int32)
+    start = np.zeros(pad_to, np.int64)
+    valid = np.zeros(pad_to, bool)
+    mem_share = np.full(pad_to, np.float32(3.4e38))
+    cpus_share = np.full(pad_to, np.float32(3.4e38))
+    for i, t in enumerate(tasks):
+        user[i], mem[i], cpus[i] = t.user, t.mem, t.cpus
+        prio[i], start[i], valid[i] = t.priority, t.start_time, True
+        ms, cs = shares.get(t.user, (math.inf, math.inf))
+        mem_share[i] = min(ms, 3.4e38)
+        cpus_share[i] = min(cs, 3.4e38)
+    return user, mem, cpus, prio, start, valid, mem_share, cpus_share
+
+
+def run_kernel(tasks, shares, pad_to=None):
+    args = to_arrays(tasks, shares, pad_to)
+    res = dru_ops.dru_rank(*[jnp.asarray(a) for a in args])
+    return np.asarray(res.dru), np.asarray(res.order), np.asarray(res.rank)
+
+
+def test_single_user_cumulative():
+    # One user, three tasks: dru accumulates in comparator order.
+    tasks = [
+        Task(id=0, user=0, mem=10.0, cpus=1.0, priority=10, start_time=5),
+        Task(id=1, user=0, mem=20.0, cpus=2.0, priority=50, start_time=3),
+        Task(id=2, user=0, mem=30.0, cpus=1.0, priority=50, start_time=1),
+    ]
+    shares = {0: (100.0, 10.0)}
+    dru, order, rank = run_kernel(tasks, shares)
+    # Order within user: prio 50/start 1 (id 2), prio 50/start 3 (id 1),
+    # prio 10 (id 0). Cumulative mem: 30, 50, 60; cpus 1, 3, 4.
+    assert np.allclose(dru[2], max(30 / 100, 1 / 10))
+    assert np.allclose(dru[1], max(50 / 100, 3 / 10))
+    assert np.allclose(dru[0], max(60 / 100, 4 / 10))
+    assert list(order) == [2, 1, 0]
+
+
+def test_two_users_interleave():
+    tasks = [
+        Task(id=0, user=0, mem=10.0, cpus=1.0),
+        Task(id=1, user=0, mem=10.0, cpus=1.0, start_time=1),
+        Task(id=2, user=1, mem=15.0, cpus=1.0),
+    ]
+    shares = {0: (100.0, 100.0), 1: (100.0, 100.0)}
+    dru, order, rank = run_kernel(tasks, shares)
+    oracle = dru_rank_oracle(tasks, shares)
+    assert [t.id for t, _ in oracle] == list(order)[:3]
+    for t, d in oracle:
+        assert np.isclose(dru[t.id], d, rtol=1e-6)
+
+
+def test_unset_share_is_infinite():
+    # No share => divisor Double/MAX_VALUE => dru ~ 0 (share.clj:86-104).
+    tasks = [Task(id=0, user=7, mem=1e6, cpus=1e3)]
+    dru, order, rank = run_kernel(tasks, {})
+    assert dru[0] < 1e-20
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_randomized_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    n = 257
+    tasks = [
+        Task(
+            id=i,
+            user=int(rng.integers(0, 13)),
+            mem=float(rng.uniform(1, 100)),
+            cpus=float(rng.uniform(0.1, 16)),
+            priority=int(rng.integers(0, 4)),
+            start_time=int(rng.integers(0, 50)),
+        )
+        for i in range(n)
+    ]
+    shares = {u: (float(rng.uniform(50, 500)), float(rng.uniform(5, 50)))
+              for u in range(13)}
+    dru, order, rank = run_kernel(tasks, shares, pad_to=300)
+    oracle = dru_rank_oracle(tasks, shares)
+    for t, d in oracle:
+        # kernel is float32; oracle is float64
+        assert np.isclose(dru[t.id], d, rtol=2e-4), t
+    # Queue order must agree wherever drus are not within f32 noise of
+    # each other; near-ties may legally flip between precisions.
+    for (ta, da), (tb, db) in zip(oracle, oracle[1:]):
+        if db - da > 1e-3:
+            assert rank[ta.id] < rank[tb.id]
+    # padded slots rank last
+    assert set(order[n:]) == set(range(n, 300))
+    # rank is the inverse of order
+    assert all(rank[order[i]] == i for i in range(300))
+
+
+def test_gpu_mode():
+    tasks = [
+        Task(id=0, user=0, mem=1, cpus=1, gpus=2.0),
+        Task(id=1, user=0, mem=1, cpus=1, gpus=1.0, start_time=1),
+        Task(id=2, user=1, mem=1, cpus=1, gpus=1.0),
+    ]
+    gpu_shares = {0: 4.0, 1: 1.0}
+    user = jnp.asarray([0, 0, 1], jnp.int32)
+    gpus = jnp.asarray([2.0, 1.0, 1.0], jnp.float32)
+    prio = jnp.asarray([50, 50, 50], jnp.int32)
+    start = jnp.asarray([0, 1, 0], jnp.int64)
+    valid = jnp.asarray([True, True, True])
+    share = jnp.asarray([4.0, 4.0, 1.0], jnp.float32)
+    res = dru_ops.gpu_dru_rank(user, gpus, prio, start, valid, share)
+    oracle = gpu_dru_rank_oracle(tasks, gpu_shares)
+    assert [t.id for t, _ in oracle] == list(np.asarray(res.order))
+    for t, s in oracle:
+        assert np.isclose(np.asarray(res.dru)[t.id], s)
+
+
+def test_limit_over_quota():
+    # queue of 6 jobs, users [0,0,0,1,0,1]; user0 quota 2, running 1 =>
+    # cap = 2 - 1 + allowance; with allowance 1 user0 keeps 2 jobs.
+    qu = jnp.asarray([0, 0, 0, 1, 0, 1], jnp.int32)
+    valid = jnp.ones(6, bool)
+    quota = jnp.asarray([2, 2, 2, 100, 2, 100], jnp.int32)
+    running = jnp.asarray([1, 1, 1, 0, 1, 0], jnp.int32)
+    keep = dru_ops.limit_over_quota(qu, valid, quota, running, over_quota_allowance=1)
+    assert list(np.asarray(keep)) == [True, True, False, True, False, True]

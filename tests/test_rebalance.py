@@ -1,0 +1,199 @@
+"""Rebalancer (preemption) kernel vs. the sequential oracle."""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cook_tpu.ops import rebalance as rb
+from tests.oracles import Task, dru_rank_oracle, rebalance_oracle, user_sort_key
+
+PENDING_ID_BASE = 2 ** 30  # pending jobs compare after all running tasks
+
+
+def make_task_state(tasks, shares, n_slots, n_users):
+    T = n_slots
+    arr = dict(
+        user=np.zeros(T, np.int32), mem=np.zeros(T, np.float32),
+        cpus=np.zeros(T, np.float32), priority=np.zeros(T, np.int32),
+        start_time=np.zeros(T, np.int64), host=np.full(T, -1, np.int32),
+        valid=np.zeros(T, bool),
+        mem_share=np.full(T, 3.4e38, np.float32),
+        cpus_share=np.full(T, 3.4e38, np.float32),
+    )
+    for i, t in enumerate(tasks):
+        arr["user"][i], arr["mem"][i], arr["cpus"][i] = t.user, t.mem, t.cpus
+        arr["priority"][i], arr["start_time"][i] = t.priority, t.start_time
+        arr["host"][i], arr["valid"][i] = t.host, True
+        ms, cs = shares.get(t.user, (math.inf, math.inf))
+        arr["mem_share"][i] = min(ms, 3.4e38)
+        arr["cpus_share"][i] = min(cs, 3.4e38)
+    return rb.TaskState(**{k: jnp.asarray(v) for k, v in arr.items()})
+
+
+def make_pending(jobs, shares):
+    P = len(jobs)
+    arr = dict(
+        user=np.zeros(P, np.int32), mem=np.zeros(P, np.float32),
+        cpus=np.zeros(P, np.float32), priority=np.zeros(P, np.int32),
+        start_time=np.zeros(P, np.int64), valid=np.ones(P, bool),
+        mem_share=np.full(P, 3.4e38, np.float32),
+        cpus_share=np.full(P, 3.4e38, np.float32),
+    )
+    for i, j in enumerate(jobs):
+        arr["user"][i], arr["mem"][i], arr["cpus"][i] = j.user, j.mem, j.cpus
+        arr["priority"][i], arr["start_time"][i] = j.priority, j.start_time
+        ms, cs = shares.get(j.user, (math.inf, math.inf))
+        arr["mem_share"][i] = min(ms, 3.4e38)
+        arr["cpus_share"][i] = min(cs, 3.4e38)
+    return rb.PendingJobs(**{k: jnp.asarray(v) for k, v in arr.items()})
+
+
+def run_kernel(tasks, pending_jobs, shares, spare, n_hosts, n_users,
+               safe=0.0, min_diff=0.0, forbidden=None):
+    P = len(pending_jobs)
+    T = len(tasks) + P
+    ts = make_task_state(tasks, shares, T, n_users)
+    pj = make_pending(pending_jobs, shares)
+    sp_mem = np.zeros(n_hosts, np.float32)
+    sp_cpus = np.zeros(n_hosts, np.float32)
+    for h, (m, c) in spare.items():
+        sp_mem[h], sp_cpus[h] = m, c
+    forb = np.zeros((P, n_hosts), bool) if forbidden is None else forbidden
+    inf = np.float32(3.4e38)
+    res = rb.rebalance(
+        ts, pj, jnp.asarray(sp_mem), jnp.asarray(sp_cpus), jnp.asarray(forb),
+        jnp.full(n_users, inf), jnp.full(n_users, inf),
+        jnp.full(n_users, 2 ** 30, jnp.int32),
+        safe, min_diff)
+    return res
+
+
+def test_single_job_prefers_highest_dru_host():
+    # user 0 hogs host 0 (high dru), user 1 has one small task on host 1.
+    # user 2's pending job fits by preempting from host 0 — the decision
+    # must maximize the minimum preempted dru.
+    shares = {0: (10.0, 10.0), 1: (10.0, 10.0), 2: (10.0, 10.0)}
+    tasks = [
+        Task(id=0, user=0, mem=10, cpus=10, host=0, start_time=0),
+        Task(id=1, user=0, mem=10, cpus=10, host=0, start_time=1),
+        Task(id=2, user=1, mem=2, cpus=2, host=1, start_time=0),
+    ]
+    pend = [Task(id=PENDING_ID_BASE, user=2, mem=5, cpus=5, start_time=9)]
+    res = run_kernel(tasks, pend, shares, spare={}, n_hosts=2, n_users=3)
+    assert bool(res.job_placed[0])
+    assert int(res.job_host[0]) == 0
+    # Only the *last* (highest-dru) task of user 0 preempted: task id 1
+    # has cumulative dru 4.0 > task 0's 2.0 and alone frees 10/10 >= 5/5.
+    assert list(np.asarray(res.preempted)[:3]) == [False, True, False]
+
+
+def test_spare_resources_avoid_preemption():
+    shares = {0: (10.0, 10.0), 1: (10.0, 10.0)}
+    tasks = [Task(id=0, user=0, mem=10, cpus=10, host=0)]
+    pend = [Task(id=PENDING_ID_BASE, user=1, mem=5, cpus=5, start_time=9)]
+    res = run_kernel(tasks, pend, shares, spare={1: (8.0, 8.0)},
+                     n_hosts=2, n_users=2)
+    assert bool(res.job_placed[0])
+    assert int(res.job_host[0]) == 1
+    assert not np.asarray(res.preempted)[:1].any()
+
+
+def test_min_dru_diff_blocks():
+    shares = {0: (10.0, 10.0), 1: (10.0, 10.0)}
+    tasks = [Task(id=0, user=0, mem=10, cpus=10, host=0)]
+    pend = [Task(id=PENDING_ID_BASE, user=1, mem=10, cpus=10, start_time=9)]
+    # pending dru = 1.0 == task dru -> diff 0, not > min_dru_diff
+    res = run_kernel(tasks, pend, shares, spare={}, n_hosts=1, n_users=2)
+    assert not bool(res.job_placed[0])
+    assert int(res.job_host[0]) == -1
+
+
+def test_safe_dru_threshold_blocks():
+    shares = {0: (100.0, 100.0), 1: (10.0, 10.0)}
+    tasks = [Task(id=0, user=0, mem=10, cpus=10, host=0)]  # dru 0.1
+    pend = [Task(id=PENDING_ID_BASE, user=1, mem=1, cpus=1, start_time=9)]
+    res = run_kernel(tasks, pend, shares, spare={}, n_hosts=1, n_users=2,
+                     safe=0.5)
+    assert not bool(res.job_placed[0])
+
+
+def test_host_forbidden():
+    shares = {0: (10.0, 10.0), 1: (10.0, 10.0)}
+    tasks = [Task(id=0, user=0, mem=10, cpus=10, host=0)]
+    pend = [Task(id=PENDING_ID_BASE, user=1, mem=5, cpus=5, start_time=9)]
+    forb = np.ones((1, 1), bool)
+    res = run_kernel(tasks, pend, shares, spare={}, n_hosts=1, n_users=2,
+                     forbidden=forb)
+    assert not bool(res.job_placed[0])
+
+
+def sequential_oracle(tasks, pending_jobs, shares, spare, safe, min_diff,
+                      n_hosts):
+    """Apply rebalance_oracle job-by-job, updating running set and spare,
+    mirroring rebalance/next-state (rebalancer.clj:403-411,269-308)."""
+    running = list(tasks)
+    spare = dict(spare)
+    placements, all_victims = [], set()
+    next_id = PENDING_ID_BASE
+    for job in pending_jobs:
+        decision = rebalance_oracle(running, spare, job, shares,
+                                    safe, min_diff)
+        if decision is None:
+            placements.append(None)
+            continue
+        host, victims, d = decision
+        freed_mem = sum(t.mem for t in victims) + spare.get(host, (0, 0))[0]
+        freed_cpus = sum(t.cpus for t in victims) + spare.get(host, (0, 0))[1]
+        vict_ids = {t.id for t in victims}
+        running = [t for t in running if t.id not in vict_ids]
+        newt = Task(id=job.id, user=job.user, mem=job.mem, cpus=job.cpus,
+                    priority=job.priority, start_time=job.start_time,
+                    host=host)
+        running.append(newt)
+        spare[host] = (freed_mem - job.mem, freed_cpus - job.cpus)
+        placements.append(host)
+        all_victims |= vict_ids
+    return placements, all_victims
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_multi_job_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    n_users, n_hosts, n_tasks, n_pend = 5, 6, 40, 6
+    shares = {u: (float(rng.uniform(20, 60)), float(rng.uniform(5, 15)))
+              for u in range(n_users)}
+    tasks = [
+        Task(id=i, user=int(rng.integers(0, n_users)),
+             mem=float(rng.integers(1, 20)), cpus=float(rng.integers(1, 8)),
+             priority=int(rng.integers(0, 3)),
+             start_time=int(rng.integers(0, 30)),
+             host=int(rng.integers(0, n_hosts)))
+        for i in range(n_tasks)
+    ]
+    pend = [
+        Task(id=PENDING_ID_BASE + i, user=int(rng.integers(0, n_users)),
+             mem=float(rng.integers(1, 25)), cpus=float(rng.integers(1, 10)),
+             priority=int(rng.integers(0, 3)),
+             start_time=int(100 + i))
+        for i in range(n_pend)
+    ]
+    spare = {h: (float(rng.integers(0, 6)), float(rng.integers(0, 3)))
+             for h in range(n_hosts)}
+    res = run_kernel(tasks, pend, shares, spare, n_hosts, n_users,
+                     safe=0.1, min_diff=0.05)
+    placements, victims = sequential_oracle(
+        tasks, pend, shares, spare, 0.1, 0.05, n_hosts)
+    got_hosts = [int(h) if bool(p) else None
+                 for p, h in zip(np.asarray(res.job_placed),
+                                 np.asarray(res.job_host))]
+    assert got_hosts == placements
+    # Kernel fill slot k (the k-th trailing slot) holds the k-th *placed*
+    # pending job; placed jobs may themselves be preempted by later
+    # decisions, so map fill-slot victims back to pending ids.
+    placed_ids = [pend[i].id for i, h in enumerate(placements) if h is not None]
+    preempted = np.asarray(res.preempted)
+    got_victims = {i for i, v in enumerate(preempted[:n_tasks]) if v}
+    got_victims |= {placed_ids[k] for k, v in enumerate(preempted[n_tasks:])
+                    if v and k < len(placed_ids)}
+    assert got_victims == victims
