@@ -1,0 +1,101 @@
+"""Headline benchmark: sched decisions/sec @ 100k pending x 10k offers.
+
+Runs the fused scheduling cycle (DRU rank over 110k tasks -> considerable
+filter -> batched bin-packing match of an 8k considerable head onto 10k
+hosts) on the real TPU chip and reports decisions/sec and p99 cycle
+latency.
+
+Baseline: the reference's design throughput bound — Fenzo considers 1000
+jobs per 1 s match-cycle tick (config.clj:319-324, mesos.clj:102), i.e.
+~1000 decisions/sec. vs_baseline = decisions_per_sec / 1000.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from cook_tpu.ops import cycle as cycle_ops
+    from cook_tpu.ops import match as match_ops
+
+    R = 10_000       # running tasks (rank-cycle benchmark scale, benchmark.clj:41-57 uses 10k running)
+    P = 100_000      # pending jobs
+    H = 10_000       # offers/hosts
+    U = 500          # users
+    C = 8_192        # considerable head matched per cycle
+
+    rng = np.random.default_rng(0)
+    INF = np.float32(3.4e38)
+
+    dev = jax.devices()[0]
+    args = (
+        jnp.asarray(rng.integers(0, U, R), jnp.int32),
+        jnp.asarray(rng.uniform(1, 10, R), jnp.float32),
+        jnp.asarray(rng.uniform(1, 4, R), jnp.float32),
+        jnp.asarray(rng.integers(0, 3, R), jnp.int32),
+        jnp.asarray(rng.integers(0, 100, R), jnp.int32),
+        jnp.ones(R, bool),
+        jnp.full(R, 1000.0, jnp.float32),
+        jnp.full(R, 200.0, jnp.float32),
+        jnp.asarray(rng.integers(0, U, P), jnp.int32),
+        jnp.asarray(rng.uniform(1, 10, P), jnp.float32),
+        jnp.asarray(rng.uniform(0.5, 4, P), jnp.float32),
+        jnp.zeros(P, jnp.float32),
+        jnp.asarray(rng.integers(0, 3, P), jnp.int32),
+        jnp.asarray(rng.integers(100, 200, P), jnp.int32),
+        jnp.ones(P, bool),
+        jnp.full(P, 1000.0, jnp.float32),
+        jnp.full(P, 200.0, jnp.float32),
+        jnp.full(P, -1, jnp.int32),
+        jnp.zeros(P, bool),
+        match_ops.make_hosts(
+            mem=rng.uniform(64, 256, H).astype(np.float32),
+            cpus=rng.uniform(16, 64, H).astype(np.float32)),
+        None,  # forbidden: constraint-free headline config
+        jnp.full(U, INF), jnp.full(U, INF), jnp.full(U, 1e9, jnp.float32),
+    )
+    args = jax.device_put(args, dev)
+
+    import functools
+    fn = functools.partial(cycle_ops.rank_and_match,
+                           num_considerable=C, sequential=False)
+
+    # warmup / compile
+    t0 = time.perf_counter()
+    out = fn(*args)
+    out.job_host.block_until_ready()
+    compile_s = time.perf_counter() - t0
+
+    lat = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        out.job_host.block_until_ready()
+        lat.append(time.perf_counter() - t0)
+    lat_ms = np.array(lat) * 1e3
+    matched = int((np.asarray(out.job_host) >= 0).sum())
+    mean_s = float(np.mean(lat))
+    dps = matched / mean_s
+    p99 = float(np.percentile(lat_ms, 99))
+
+    print(json.dumps({
+        "metric": "sched decisions/sec @ 100k-pending x 10k-offers",
+        "value": round(dps, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(dps / 1000.0, 2),
+        "p99_cycle_ms": round(p99, 2),
+        "mean_cycle_ms": round(float(np.mean(lat_ms)), 2),
+        "matched_per_cycle": matched,
+        "compile_s": round(compile_s, 1),
+        "device": str(dev),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""One full scheduling cycle as a single fused device program.
+
+Composes the rank cycle and the match cycle the way the reference's
+leader loop does (scheduler.clj:940-1036 match loop consuming the
+rank loop's pool-name->pending-jobs-atom, :1281-1458):
+
+  1. rank: DRU-score the union of running tasks and pending jobs
+     (pending jobs are scored as hypothetical next tasks of their user,
+     exactly how sort-jobs-by-dru-pool feeds both sets to
+     dru/sorted-task-scored-task-pairs, scheduler.clj:1335-1376),
+  2. considerable filter: walk pending jobs in fair-queue order and keep
+     those whose user stays under their resource/count quota given
+     running usage plus the queue prefix ahead of them
+     (pending-jobs->considerable-jobs scheduler.clj:627-657,
+     filter-based-on-quota tools.clj:905), capped at `num_considerable`
+     (fenzo-max-jobs-considered, config.clj:319),
+  3. match: greedy bin-packing assignment of the considerable jobs onto
+     hosts (ops/match.py).
+
+Everything runs on device in one jit; the host only ships deltas of the
+job/offer tensors and reads back the assignment vector.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cook_tpu.ops import dru as dru_ops
+from cook_tpu.ops import match as match_ops
+from cook_tpu.ops.segments import segment_cumsum
+
+
+class CycleResult(NamedTuple):
+    pending_dru: jnp.ndarray     # (P,) dru score of each pending job
+    queue_rank: jnp.ndarray      # (P,) fair-queue position among pending
+    considerable: jnp.ndarray    # (P,) bool — survived quota/cap filters
+    job_host: jnp.ndarray        # (P,) assigned host or -1
+    mem_left: jnp.ndarray        # (H,)
+    cpus_left: jnp.ndarray       # (H,)
+    gpus_left: jnp.ndarray       # (H,)
+
+
+@functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
+                                             "sequential"))
+def rank_and_match(
+    # running tasks (R slots)
+    run_user, run_mem, run_cpus, run_prio, run_start, run_valid,
+    run_mem_share, run_cpus_share,
+    # pending jobs (P slots)
+    pend_user, pend_mem, pend_cpus, pend_gpus, pend_prio, pend_start,
+    pend_valid, pend_mem_share, pend_cpus_share, pend_group,
+    pend_unique_group,
+    # hosts
+    hosts: match_ops.Hosts,
+    forbidden,                 # (P, H) bool
+    # per-user quotas (U,)
+    user_quota_mem, user_quota_cpus, user_quota_count,
+    num_considerable: int = 1024,
+    num_groups: int = 1,
+    sequential: bool = True,
+) -> CycleResult:
+    R = run_user.shape[0]
+    P = pend_user.shape[0]
+    U = user_quota_mem.shape[0]
+
+    # ---- 1. rank union of running + pending --------------------------
+    user = jnp.concatenate([run_user, pend_user])
+    mem = jnp.concatenate([run_mem, pend_mem])
+    cpus = jnp.concatenate([run_cpus, pend_cpus])
+    prio = jnp.concatenate([run_prio, pend_prio])
+    start = jnp.concatenate([run_start, pend_start])
+    valid = jnp.concatenate([run_valid, pend_valid])
+    mshare = jnp.concatenate([run_mem_share, pend_mem_share])
+    cshare = jnp.concatenate([run_cpus_share, pend_cpus_share])
+
+    ranked = dru_ops.dru_rank(user, mem, cpus, prio, start, valid,
+                              mshare, cshare)
+    pending_dru = ranked.dru[R:]
+    # fair-queue position among *pending* jobs only: order pending by
+    # their global rank.
+    pend_global_rank = ranked.rank[R:]
+    queue_perm = jnp.argsort(
+        jnp.where(pend_valid, pend_global_rank, jnp.iinfo(jnp.int32).max))
+    queue_rank = jnp.zeros(P, jnp.int32).at[queue_perm].set(
+        jnp.arange(P, dtype=jnp.int32))
+
+    # ---- 2. considerable filter (quota + cap) ------------------------
+    # running usage per user
+    def usage(vals):
+        return jax.ops.segment_sum(jnp.where(run_valid, vals, 0.0),
+                                   jnp.where(run_valid, run_user, U),
+                                   num_segments=U + 1)[:U]
+
+    u_mem = usage(run_mem)
+    u_cpus = usage(run_cpus)
+    u_cnt = jax.ops.segment_sum(run_valid.astype(jnp.float32),
+                                jnp.where(run_valid, run_user, U),
+                                num_segments=U + 1)[:U]
+
+    # cumulative pending demand per user in queue order
+    q_user = pend_user[queue_perm]
+    q_valid = pend_valid[queue_perm]
+    sort_user = jnp.where(q_valid, q_user, U)
+    uperm = jnp.lexsort((jnp.arange(P), sort_user))
+    su = sort_user[uperm]
+    cum = segment_cumsum(
+        jnp.stack([jnp.where(q_valid, pend_mem[queue_perm], 0.0)[uperm],
+                   jnp.where(q_valid, pend_cpus[queue_perm], 0.0)[uperm],
+                   q_valid[uperm].astype(jnp.float32)], -1), su)
+    uid = jnp.clip(su, 0, U - 1)
+    within = ((u_mem[uid] + cum[:, 0] <= user_quota_mem[uid])
+              & (u_cpus[uid] + cum[:, 1] <= user_quota_cpus[uid])
+              & (u_cnt[uid] + cum[:, 2] <= user_quota_count[uid]))
+    within_q = jnp.zeros(P, bool).at[uperm].set(within)      # queue order
+    considerable_q = q_valid & within_q
+    # cap at num_considerable in queue order
+    taken = jnp.cumsum(considerable_q.astype(jnp.int32))
+    considerable_q &= taken <= num_considerable
+    considerable = jnp.zeros(P, bool).at[queue_perm].set(considerable_q)
+
+    # ---- 3. compact the considerable head, then match ----------------
+    # Gather the first num_considerable queue entries into a dense C-batch
+    # so the match kernel's (jobs x hosts) working set is C x H, not P x H
+    # (at 100k pending x 10k offers a dense P x H mask would be ~1 GB).
+    C = num_considerable
+    H = hosts.mem.shape[0]
+    cons_pos = jnp.cumsum(considerable_q.astype(jnp.int32)) - 1
+    slot = jnp.where(considerable_q, jnp.minimum(cons_pos, C), C)
+    # src[c] = queue position feeding compact slot c (P = empty slot)
+    src = jnp.full(C + 1, P, jnp.int32).at[slot].set(
+        jnp.arange(P, dtype=jnp.int32), mode="drop")[:C]
+    in_use = src < P
+    srcc = jnp.clip(src, 0, P - 1)
+    # compose queue_perm with the compact slots once, so each gather below
+    # is a direct (C,)-sized gather — never a (P, H) intermediate
+    pend_idx = queue_perm[srcc]
+
+    def gq(arr):  # gather: original pending order -> compact batch
+        return arr[pend_idx]
+
+    jobs = match_ops.Jobs(
+        mem=gq(pend_mem), cpus=gq(pend_cpus), gpus=gq(pend_gpus),
+        valid=in_use,
+        group=gq(pend_group), unique_group=gq(pend_unique_group),
+    )
+    if forbidden is None:
+        forb = match_ops.varying_full(hosts.valid, False, (C, H), bool)
+    else:
+        forb = forbidden[pend_idx] & in_use[:, None]
+    if sequential:
+        res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups)
+    else:
+        res = match_ops.match_rounds(jobs, hosts, forb, rounds=12,
+                                     num_groups=num_groups)
+    # scatter back: compact -> original pending order in one scatter
+    # (empty compact slots get index P and are dropped)
+    scatter_idx = jnp.where(in_use, pend_idx, P)
+    job_host = jnp.full(P, match_ops.NO_HOST).at[scatter_idx].set(
+        res.job_host, mode="drop")
+
+    return CycleResult(pending_dru=pending_dru, queue_rank=queue_rank,
+                       considerable=considerable, job_host=job_host,
+                       mem_left=res.mem_left, cpus_left=res.cpus_left,
+                       gpus_left=res.gpus_left)

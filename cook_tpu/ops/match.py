@@ -50,6 +50,18 @@ from cook_tpu.ops.segments import segment_cumsum
 NO_HOST = jnp.int32(-1)
 
 
+def varying_full(ref: jnp.ndarray, value, shape=None, dtype=None):
+    """Constant-filled array that inherits `ref`'s mesh-axis-varying
+    status. Inside shard_map, a plain jnp.full/zeros carry is 'replicated'
+    and trips the scan carry-type check; deriving the constant from an
+    input array keeps the varying manual axes consistent in any context.
+    """
+    shape = ref.shape if shape is None else shape
+    dtype = dtype or jnp.result_type(value)
+    zero = (ref.reshape(-1)[0].astype(jnp.float32) * 0).astype(dtype)
+    return jnp.full(shape, value, dtype) + zero
+
+
 class Jobs(NamedTuple):
     """Considerable jobs in fair-queue order (padded to N)."""
 
@@ -114,7 +126,7 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     num_groups: static upper bound on dense group ids in this batch.
     """
     H = hosts.mem.shape[0]
-    group_occ = jnp.zeros((num_groups, H), dtype=bool)
+    group_occ = varying_full(hosts.valid, False, (num_groups, H), bool)
 
     def step(carry, xs):
         mem_left, cpus_left, gpus_left, slots_left, group_occ = carry
@@ -235,8 +247,9 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         return (new_host, mem_left, cpus_left, gpus_left, slots_left,
                 group_occ), None
 
-    init = (jnp.full(N, NO_HOST), hosts.mem, hosts.cpus, hosts.gpus,
-            hosts.task_slots, jnp.zeros((num_groups, H), bool))
+    init = (varying_full(jobs.valid, NO_HOST, (N,), jnp.int32),
+            hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots,
+            varying_full(hosts.valid, False, (num_groups, H), bool))
     (job_host, mem_left, cpus_left, gpus_left, _, _), _ = jax.lax.scan(
         one_round, init, None, length=rounds)
     return MatchResult(job_host, mem_left, cpus_left, gpus_left)

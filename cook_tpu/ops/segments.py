@@ -41,10 +41,3 @@ def segment_rank(seg_ids: jnp.ndarray) -> jnp.ndarray:
     """0-based position of each element within its contiguous segment."""
     ones = jnp.ones_like(seg_ids, dtype=jnp.int32)
     return segment_cumsum(ones, seg_ids) - 1
-
-
-def first_true_index(mask: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
-    """Index of the first True along `axis`; size-of-axis when none."""
-    n = mask.shape[axis]
-    idx = jnp.where(mask, jnp.arange(n), n)
-    return jnp.min(idx, axis=axis)

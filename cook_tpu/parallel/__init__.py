@@ -1,0 +1,16 @@
+"""Device-mesh parallelism for the scheduling kernels.
+
+Two axes of scale, mirroring the reference's two scaling mechanisms
+(SURVEY.md §2.5):
+
+  pools.py          per-pool parallel scheduling loops
+                    (scheduler.clj:1557-1578: one Fenzo + match loop per
+                    pool) -> pools sharded across mesh devices with
+                    shard_map; cluster-wide totals via psum over ICI.
+
+  sharded_match.py  the reference scales a single pool by truncating to
+                    num-considerable jobs; we instead shard the
+                    (jobs x hosts) match problem over the mesh's host
+                    axis and run a distributed sequential greedy with a
+                    per-step pmax/pmin argmax reduction.
+"""

@@ -1,0 +1,96 @@
+"""Pool-sharded scheduling cycles over a device mesh.
+
+The reference runs one independent Fenzo + match loop per pool
+(scheduler.clj:1557-1578), all on one JVM. Here each mesh device owns a
+slice of the pools and runs the full fused cycle kernel
+(ops/cycle.rank_and_match) for its pools via shard_map; pools on the same
+device are vmapped. Cluster-wide aggregates (total matched, total demand
+— the inputs to global launch-rate limiting, rate_limit.clj:58 and the
+monitor counters, monitor.clj:125) are psum'd over the mesh axis so every
+device (and the host) sees consistent totals after one ICI reduction.
+
+All tensors carry a leading pools axis, padded so n_pools % mesh size == 0.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cook_tpu.ops import cycle as cycle_ops
+from cook_tpu.ops import match as match_ops
+
+POOL_AXIS = "pools"
+
+
+def make_pool_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(devs[:n], (POOL_AXIS,))
+
+
+class PoolCycleStats(NamedTuple):
+    """Cluster-wide (psum'd) per-cycle aggregates, replicated on all
+    devices."""
+
+    total_matched: jnp.ndarray     # scalar i32
+    total_considerable: jnp.ndarray
+    total_pending: jnp.ndarray
+
+
+class PoolCycleOut(NamedTuple):
+    result: cycle_ops.CycleResult  # leading pools axis
+    stats: PoolCycleStats
+
+
+def pool_sharded_cycle(mesh: Mesh, num_considerable: int = 1024,
+                       num_groups: int = 1, sequential: bool = True):
+    """Build the jitted pool-sharded cycle fn for `mesh`.
+
+    Returns fn(run..., pend..., hosts, forbidden, quotas) where every
+    array has a leading pools axis divisible by the mesh size.
+    """
+
+    kernel = functools.partial(
+        cycle_ops.rank_and_match,
+        num_considerable=num_considerable, num_groups=num_groups,
+        sequential=sequential)
+
+    def per_pool(args):
+        (run_user, run_mem, run_cpus, run_prio, run_start, run_valid,
+         run_mshare, run_cshare,
+         pend_user, pend_mem, pend_cpus, pend_gpus, pend_prio, pend_start,
+         pend_valid, pend_mshare, pend_cshare, pend_group, pend_unique,
+         hosts, forbidden, q_mem, q_cpus, q_cnt) = args
+        return kernel(
+            run_user, run_mem, run_cpus, run_prio, run_start, run_valid,
+            run_mshare, run_cshare,
+            pend_user, pend_mem, pend_cpus, pend_gpus, pend_prio, pend_start,
+            pend_valid, pend_mshare, pend_cshare, pend_group, pend_unique,
+            hosts, forbidden, q_mem, q_cpus, q_cnt)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(POOL_AXIS), out_specs=(P(POOL_AXIS), P()))
+    def shard_fn(args):
+        res = jax.vmap(per_pool)(args)
+        pend_valid = args[14]
+        matched = jnp.sum((res.job_host >= 0).astype(jnp.int32))
+        considerable = jnp.sum(res.considerable.astype(jnp.int32))
+        pending = jnp.sum(pend_valid.astype(jnp.int32))
+        stats = PoolCycleStats(
+            total_matched=jax.lax.psum(matched, POOL_AXIS),
+            total_considerable=jax.lax.psum(considerable, POOL_AXIS),
+            total_pending=jax.lax.psum(pending, POOL_AXIS),
+        )
+        return res, stats
+
+    @jax.jit
+    def run(args):
+        res, stats = shard_fn(args)
+        return PoolCycleOut(result=res, stats=stats)
+
+    return run

@@ -1,0 +1,93 @@
+"""Distributed sequential-greedy match, hosts sharded over the mesh.
+
+For a single huge pool (the 100k-pending x 10k-offer headline config) one
+device's HBM comfortably holds the tensors, but sharding the *host* axis
+lets the per-job feasibility/fitness sweep run on D devices at once and
+extends to multi-host meshes over ICI/DCN.
+
+Per scan step (one job):
+  1. every device scores its local host shard (feasibility + fitness),
+  2. one pmax reduces the best local fitness to the global best,
+  3. one pmin picks the lowest global host index among devices tying at
+     that fitness (identical tie-break to the single-device argmax),
+  4. the winning device subtracts the job's resources from its shard.
+
+Semantically identical to ops/match.match_scan for group-free batches —
+the equivalence test runs both on an 8-device CPU mesh. LIMITATION: this
+path does not yet enforce same-cycle group coupling (jobs.group /
+jobs.unique_group are ignored); callers must route batches containing
+unique-host groups through match_scan / match_rounds, which do.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cook_tpu.ops import match as match_ops
+
+HOST_AXIS = "hosts"
+_BIG = jnp.int32(2 ** 30)
+
+
+def make_host_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(devs[:n], (HOST_AXIS,))
+
+
+def sharded_match_scan(mesh: Mesh):
+    """Build the jitted host-sharded greedy matcher for `mesh`.
+
+    fn(jobs: Jobs, hosts: Hosts, forbidden[N, H]) -> job_host[N]
+    H must be divisible by the mesh size.
+    """
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(HOST_AXIS), P(None, HOST_AXIS)),
+        out_specs=P())
+    def run(jobs: match_ops.Jobs, hosts: match_ops.Hosts, forbidden):
+        Hl = hosts.mem.shape[0]  # local shard size
+        shard = jax.lax.axis_index(HOST_AXIS)
+        base = shard * Hl  # global index of this shard's first host
+
+        def step(carry, xs):
+            mem_left, cpus_left, gpus_left, slots_left = carry
+            j_mem, j_cpus, j_gpus, j_valid, forb = xs
+
+            ok = match_ops._feasible(
+                j_mem, j_cpus, j_gpus, mem_left, cpus_left, gpus_left,
+                hosts.cap_gpus, hosts.valid, slots_left, forb)
+            ok &= j_valid
+            fit = match_ops._fitness(j_mem, j_cpus, mem_left, cpus_left,
+                                     hosts.cap_mem, hosts.cap_cpus)
+            fit = jnp.where(ok, fit, -1.0)
+            lbest = jnp.argmax(fit)
+            lfit = fit[lbest]
+
+            gfit = jax.lax.pmax(lfit, HOST_AXIS)
+            # lowest global host index among ties (matches single-device
+            # argmax-first semantics)
+            cand = jnp.where((lfit == gfit) & (gfit > -0.5),
+                             base + lbest, _BIG)
+            gwin = jax.lax.pmin(cand, HOST_AXIS)
+            assigned = gwin < _BIG
+
+            mine = assigned & (gwin >= base) & (gwin < base + Hl)
+            onehot = (jnp.arange(Hl) == (gwin - base)) & mine
+            mem_left = mem_left - jnp.where(onehot, j_mem, 0.0)
+            cpus_left = cpus_left - jnp.where(onehot, j_cpus, 0.0)
+            gpus_left = gpus_left - jnp.where(onehot, j_gpus, 0.0)
+            slots_left = slots_left - onehot.astype(jnp.int32)
+            host = jnp.where(assigned, gwin, match_ops.NO_HOST)
+            return (mem_left, cpus_left, gpus_left, slots_left), host
+
+        carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots)
+        xs = (jobs.mem, jobs.cpus, jobs.gpus, jobs.valid, forbidden)
+        _, job_host = jax.lax.scan(step, carry, xs)
+        return job_host
+
+    return jax.jit(run)

@@ -1,0 +1,156 @@
+"""Fused cycle kernel + mesh-sharded variants on the 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cook_tpu.ops import cycle as cycle_ops
+from cook_tpu.ops import match as match_ops
+from cook_tpu.parallel import pools as pool_par
+from cook_tpu.parallel import sharded_match
+
+INF = np.float32(3.4e38)
+
+
+def make_cycle_inputs(rng, R=16, Pn=24, H=6, U=4, n_pools=None):
+    def one():
+        run = dict(
+            run_user=rng.integers(0, U, R).astype(np.int32),
+            run_mem=rng.uniform(1, 10, R).astype(np.float32),
+            run_cpus=rng.uniform(1, 4, R).astype(np.float32),
+            run_prio=rng.integers(0, 3, R).astype(np.int32),
+            run_start=rng.integers(0, 100, R).astype(np.int64),
+            run_valid=rng.random(R) < 0.8,
+            run_mem_share=np.full(R, 100.0, np.float32),
+            run_cpus_share=np.full(R, 20.0, np.float32),
+        )
+        pend = dict(
+            pend_user=rng.integers(0, U, Pn).astype(np.int32),
+            pend_mem=rng.uniform(1, 10, Pn).astype(np.float32),
+            pend_cpus=rng.uniform(0.5, 4, Pn).astype(np.float32),
+            pend_gpus=np.zeros(Pn, np.float32),
+            pend_prio=rng.integers(0, 3, Pn).astype(np.int32),
+            pend_start=rng.integers(100, 200, Pn).astype(np.int64),
+            pend_valid=rng.random(Pn) < 0.9,
+            pend_mem_share=np.full(Pn, 100.0, np.float32),
+            pend_cpus_share=np.full(Pn, 20.0, np.float32),
+            pend_group=np.full(Pn, -1, np.int32),
+            pend_unique_group=np.zeros(Pn, bool),
+        )
+        hosts = match_ops.make_hosts(
+            mem=rng.uniform(20, 60, H).astype(np.float32),
+            cpus=rng.uniform(8, 24, H).astype(np.float32))
+        forbidden = np.zeros((Pn, H), bool)
+        quotas = dict(
+            user_quota_mem=np.full(U, INF),
+            user_quota_cpus=np.full(U, INF),
+            user_quota_count=np.full(U, 1e9, np.float32),
+        )
+        return {**run, **pend, "hosts": hosts, "forbidden": forbidden, **quotas}
+
+    if n_pools is None:
+        return one()
+    ins = [one() for _ in range(n_pools)]
+    return jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *ins)
+
+
+def test_cycle_runs_and_is_consistent():
+    rng = np.random.default_rng(0)
+    inp = make_cycle_inputs(rng)
+    res = cycle_ops.rank_and_match(**{k: jnp.asarray(v) if not isinstance(v, (match_ops.Hosts,)) else v
+                                      for k, v in inp.items()},
+                                   num_considerable=16)
+    job_host = np.asarray(res.job_host)
+    considerable = np.asarray(res.considerable)
+    # only considerable jobs may be matched
+    assert all(considerable[i] for i in range(len(job_host)) if job_host[i] >= 0)
+    # matched jobs obey capacity
+    hosts = inp["hosts"]
+    used_m = np.zeros(hosts.mem.shape[0])
+    used_c = np.zeros_like(used_m)
+    for i, h in enumerate(job_host):
+        if h >= 0:
+            used_m[h] += inp["pend_mem"][i]
+            used_c[h] += inp["pend_cpus"][i]
+    assert (used_m <= np.asarray(hosts.mem) + 1e-3).all()
+    assert (used_c <= np.asarray(hosts.cpus) + 1e-3).all()
+    # resources left reported correctly
+    assert np.allclose(np.asarray(res.mem_left), np.asarray(hosts.mem) - used_m,
+                       atol=1e-3)
+
+
+def test_cycle_quota_filter():
+    rng = np.random.default_rng(1)
+    inp = make_cycle_inputs(rng, R=4, Pn=8, U=1)
+    inp["run_valid"] = np.zeros(4, bool)
+    inp["pend_valid"] = np.ones(8, bool)
+    inp["user_quota_count"] = np.asarray([3.0], np.float32)
+    res = cycle_ops.rank_and_match(
+        **{k: (jnp.asarray(v) if not isinstance(v, match_ops.Hosts) else v)
+           for k, v in inp.items()}, num_considerable=16)
+    assert int(np.asarray(res.considerable).sum()) == 3
+
+
+def test_num_considerable_cap():
+    rng = np.random.default_rng(2)
+    inp = make_cycle_inputs(rng, R=4, Pn=20)
+    inp["pend_valid"] = np.ones(20, bool)
+    res = cycle_ops.rank_and_match(
+        **{k: (jnp.asarray(v) if not isinstance(v, match_ops.Hosts) else v)
+           for k, v in inp.items()}, num_considerable=5)
+    assert int(np.asarray(res.considerable).sum()) == 5
+    # the 5 considerables are the head of the fair queue
+    qr = np.asarray(res.queue_rank)
+    cons = np.asarray(res.considerable)
+    assert set(qr[cons]) == set(range(5))
+
+
+def test_pool_sharded_cycle_psum():
+    n_dev = len(jax.devices())
+    assert n_dev == 8, "conftest must force 8 virtual cpu devices"
+    rng = np.random.default_rng(3)
+    stacked = make_cycle_inputs(rng, n_pools=8)
+    mesh = pool_par.make_pool_mesh()
+    runner = pool_par.pool_sharded_cycle(mesh, num_considerable=16)
+    args = (
+        stacked["run_user"], stacked["run_mem"], stacked["run_cpus"],
+        stacked["run_prio"], stacked["run_start"], stacked["run_valid"],
+        stacked["run_mem_share"], stacked["run_cpus_share"],
+        stacked["pend_user"], stacked["pend_mem"], stacked["pend_cpus"],
+        stacked["pend_gpus"], stacked["pend_prio"], stacked["pend_start"],
+        stacked["pend_valid"], stacked["pend_mem_share"],
+        stacked["pend_cpus_share"], stacked["pend_group"],
+        stacked["pend_unique_group"],
+        stacked["hosts"], stacked["forbidden"],
+        stacked["user_quota_mem"], stacked["user_quota_cpus"],
+        stacked["user_quota_count"],
+    )
+    out = runner(args)
+    assert out.result.job_host.shape[0] == 8
+    total = int(out.stats.total_matched)
+    per_pool = int((np.asarray(out.result.job_host) >= 0).sum())
+    assert total == per_pool
+    # pool-sharded result == running each pool's cycle independently
+    for p in range(8):
+        single = cycle_ops.rank_and_match(
+            *[jax.tree.map(lambda x: x[p], a) for a in args],
+            num_considerable=16)
+        np.testing.assert_array_equal(np.asarray(out.result.job_host[p]),
+                                      np.asarray(single.job_host))
+
+
+def test_sharded_match_equals_single_device():
+    rng = np.random.default_rng(4)
+    N, H = 40, 16  # 16 hosts over 8 devices -> 2 per shard
+    jobs = match_ops.make_jobs(
+        mem=rng.uniform(1, 20, N).astype(np.float32),
+        cpus=rng.uniform(0.5, 8, N).astype(np.float32))
+    hosts = match_ops.make_hosts(
+        mem=rng.uniform(30, 100, H).astype(np.float32),
+        cpus=rng.uniform(8, 32, H).astype(np.float32))
+    forb = jnp.zeros((N, H), bool)
+    mesh = sharded_match.make_host_mesh()
+    fn = sharded_match.sharded_match_scan(mesh)
+    sharded = np.asarray(fn(jobs, hosts, forb))
+    single = np.asarray(match_ops.match_scan(jobs, hosts, forb).job_host)
+    np.testing.assert_array_equal(sharded, single)

@@ -133,7 +133,8 @@ def test_rounds_group_unique_within_round():
                              group=[0, 0, 1, 1],
                              unique_group=[True, True, True, True])
     hb = match_ops.make_hosts(mem=[100.0, 100.0], cpus=[10.0, 10.0])
-    res = match_ops.match_rounds(jb, hb, jnp.zeros((4, 2), bool), rounds=4)
+    res = match_ops.match_rounds(jb, hb, jnp.zeros((4, 2), bool), rounds=4,
+                                 num_groups=2)
     job_host = [int(h) for h in np.asarray(res.job_host)]
     # each group's two tasks must land on distinct hosts
     for g in (0, 1):
