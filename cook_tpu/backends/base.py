@@ -1,0 +1,127 @@
+"""ComputeCluster protocol: the backend abstraction.
+
+Equivalent of cook.compute-cluster (compute_cluster.clj:44-92) — the
+surface between the scheduling core and concrete cluster backends
+(mock/simulator, k8s-style controller). The registry mirrors
+register-compute-cluster! (compute_cluster.clj:127-156).
+
+The launch/kill atomicity rule the reference documents at length
+(compute_cluster.clj:21-42 "kill-lock"): the coordinator writes the
+instance transaction BEFORE calling launch_tasks, and kill_task is
+always safe to call for unknown tasks (idempotent).
+"""
+from __future__ import annotations
+
+import abc
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from cook_tpu.state.model import InstanceStatus
+
+
+@dataclass
+class Offer:
+    """Spare capacity on one host, one pool (VirtualMachineLease
+    equivalent, scheduler.clj:442-468)."""
+
+    hostname: str
+    pool: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    attributes: dict[str, str] = field(default_factory=dict)
+    # total capacity for bin-packing fitness
+    cap_mem: float = 0.0
+    cap_cpus: float = 0.0
+    cap_gpus: float = 0.0
+
+
+@dataclass
+class LaunchSpec:
+    """One matched task to launch."""
+
+    task_id: str
+    job_uuid: str
+    hostname: str
+    command: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    env: dict[str, str] = field(default_factory=dict)
+    container: Optional[dict] = None
+
+
+StatusCallback = Callable[[str, InstanceStatus, Optional[int]], None]
+# (task_id, status, reason_code)
+
+
+class ComputeCluster(abc.ABC):
+    """Backend protocol (compute_cluster.clj:44-92)."""
+
+    name: str = "cluster"
+
+    @abc.abstractmethod
+    def pending_offers(self, pool: str) -> list[Offer]:
+        """Current spare capacity per host for `pool`."""
+
+    @abc.abstractmethod
+    def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
+        """Start matched tasks. Must not raise for individual task
+        failures — report them through the status callback instead."""
+
+    @abc.abstractmethod
+    def kill_task(self, task_id: str) -> None:
+        """Idempotent kill; unknown task ids are a no-op (safe-kill-task,
+        compute_cluster.clj:94)."""
+
+    def set_status_callback(self, cb: StatusCallback) -> None:
+        self._status_cb = cb
+
+    def emit_status(self, task_id: str, status: InstanceStatus,
+                    reason: Optional[int] = None) -> None:
+        cb = getattr(self, "_status_cb", None)
+        if cb:
+            cb(task_id, status, reason)
+
+    # lifecycle / recovery ------------------------------------------------
+    def initialize(self) -> None:
+        """Connect, start watches, reconcile state (initialize-cluster)."""
+
+    def shutdown(self) -> None:
+        pass
+
+    def known_task_ids(self) -> set[str]:
+        """For reconciliation (reconcile-tasks scheduler.clj:1041-1104)."""
+        return set()
+
+    def host_attributes(self) -> dict[str, dict[str, str]]:
+        """hostname -> attribute map, for constraint evaluation off the
+        offer path (the agent-attributes-cache, scheduler.clj:986-993)."""
+        return {}
+
+    def autoscale(self, pool: str, queue_depth: int) -> None:
+        """Hook for synthetic-pod style autoscaling (autoscale!,
+        kubernetes/compute_cluster.clj:339-409)."""
+
+
+class ClusterRegistry:
+    """register-compute-cluster! / compute-cluster-name->ComputeCluster
+    (compute_cluster.clj:127-156)."""
+
+    def __init__(self):
+        self._clusters: dict[str, ComputeCluster] = {}
+        self._lock = threading.Lock()
+
+    def register(self, cluster: ComputeCluster) -> None:
+        with self._lock:
+            if cluster.name in self._clusters:
+                raise ValueError(f"cluster {cluster.name} already registered")
+            self._clusters[cluster.name] = cluster
+
+    def get(self, name: str) -> ComputeCluster:
+        return self._clusters[name]
+
+    def all(self) -> list[ComputeCluster]:
+        with self._lock:
+            return list(self._clusters.values())

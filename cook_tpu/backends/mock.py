@@ -1,0 +1,173 @@
+"""Mock compute cluster: hosts, offers, simulated task lifetimes.
+
+Equivalent of the reference's mock Mesos driver (mesos/mesos_mock.clj):
+keeps per-host resource state, synthesizes offers from spare capacity
+(make-offer mesos_mock.clj:33), "runs" launched tasks for a
+caller-specified duration on a virtual clock and emits completion
+statuses (complete-tasks! :229, default-task->runtime-ms :320). Powers
+the unit tests and the faster-than-real-time simulator
+(backends/simulate.py), like zz_simulator.clj does.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
+from cook_tpu.state.model import InstanceStatus
+
+
+@dataclass
+class MockHost:
+    hostname: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    pool: str = "default"
+    attributes: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class _RunningTask:
+    spec: LaunchSpec
+    end_time: float
+    success: bool = True
+    reason: Optional[int] = None
+
+
+class MockCluster(ComputeCluster):
+    """Virtual-clock cluster. `runtime_fn(spec) -> (runtime_s, success,
+    reason_code)` decides each task's fate (default: 60 s success)."""
+
+    def __init__(self, hosts: list[MockHost], name: str = "mock",
+                 runtime_fn: Optional[Callable] = None):
+        self.name = name
+        self.hosts = {h.hostname: h for h in hosts}
+        self.used: dict[str, list[float]] = {
+            h.hostname: [0.0, 0.0, 0.0] for h in hosts}
+        self.tasks: dict[str, _RunningTask] = {}
+        self._heap: list[tuple[float, str]] = []
+        self.clock = 0.0
+        self.runtime_fn = runtime_fn or (lambda spec: (60.0, True, None))
+        self._lock = threading.RLock()
+
+    # -- protocol ------------------------------------------------------
+    def pending_offers(self, pool: str) -> list[Offer]:
+        with self._lock:
+            offers = []
+            for h in self.hosts.values():
+                if h.pool != pool:
+                    continue
+                um, uc, ug = self.used[h.hostname]
+                if h.mem - um <= 0 and h.cpus - uc <= 0:
+                    continue
+                offers.append(Offer(
+                    hostname=h.hostname, pool=pool,
+                    mem=h.mem - um, cpus=h.cpus - uc, gpus=h.gpus - ug,
+                    attributes=dict(h.attributes),
+                    cap_mem=h.mem, cap_cpus=h.cpus, cap_gpus=h.gpus))
+            return offers
+
+    def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
+        with self._lock:
+            for spec in specs:
+                host = self.hosts.get(spec.hostname)
+                if host is None:
+                    self.emit_status(spec.task_id, InstanceStatus.FAILED, 5000)
+                    continue
+                um, uc, ug = self.used[spec.hostname]
+                if (um + spec.mem > host.mem + 1e-6
+                        or uc + spec.cpus > host.cpus + 1e-6
+                        or ug + spec.gpus > host.gpus + 1e-6):
+                    # oversubscription = launch failure
+                    self.emit_status(spec.task_id, InstanceStatus.FAILED,
+                                     99000)
+                    continue
+                self.used[spec.hostname] = [um + spec.mem, uc + spec.cpus,
+                                            ug + spec.gpus]
+                runtime, success, reason = self.runtime_fn(spec)
+                t = _RunningTask(spec, self.clock + runtime, success, reason)
+                self.tasks[spec.task_id] = t
+                heapq.heappush(self._heap, (t.end_time, spec.task_id))
+                self.emit_status(spec.task_id, InstanceStatus.RUNNING, None)
+
+    def kill_task(self, task_id: str) -> None:
+        with self._lock:
+            t = self.tasks.pop(task_id, None)
+            if t is None:
+                return
+            self._release(t.spec)
+            self.emit_status(task_id, InstanceStatus.FAILED, 1004)
+
+    def preempt_task(self, task_id: str) -> None:
+        """Kill with the preemption reason (rebalancer path)."""
+        with self._lock:
+            t = self.tasks.pop(task_id, None)
+            if t is None:
+                return
+            self._release(t.spec)
+            self.emit_status(task_id, InstanceStatus.FAILED, 2000)
+
+    def known_task_ids(self) -> set[str]:
+        with self._lock:
+            return set(self.tasks)
+
+    def host_attributes(self) -> dict[str, dict[str, str]]:
+        with self._lock:
+            return {h.hostname: dict(h.attributes)
+                    for h in self.hosts.values()}
+
+    # -- virtual clock -------------------------------------------------
+    def advance(self, dt: float) -> int:
+        """Advance the virtual clock, completing due tasks. Returns the
+        number of completions emitted."""
+        with self._lock:
+            self.clock += dt
+            done = 0
+            while self._heap and self._heap[0][0] <= self.clock:
+                _, task_id = heapq.heappop(self._heap)
+                t = self.tasks.pop(task_id, None)
+                if t is None:
+                    continue  # killed earlier
+                self._release(t.spec)
+                status = (InstanceStatus.SUCCESS if t.success
+                          else InstanceStatus.FAILED)
+                self.emit_status(task_id, status,
+                                 t.reason if not t.success else None)
+                done += 1
+            return done
+
+    def next_completion_time(self) -> Optional[float]:
+        with self._lock:
+            while self._heap and self._heap[0][1] not in self.tasks:
+                heapq.heappop(self._heap)
+            return self._heap[0][0] if self._heap else None
+
+    def _release(self, spec: LaunchSpec) -> None:
+        if spec.hostname in self.used:
+            um, uc, ug = self.used[spec.hostname]
+            self.used[spec.hostname] = [um - spec.mem, uc - spec.cpus,
+                                        ug - spec.gpus]
+
+    # -- test helpers --------------------------------------------------
+    def fail_task(self, task_id: str, reason: int = 6000) -> None:
+        with self._lock:
+            t = self.tasks.pop(task_id, None)
+            if t is None:
+                return
+            self._release(t.spec)
+            self.emit_status(task_id, InstanceStatus.FAILED, reason)
+
+    def remove_host(self, hostname: str) -> list[str]:
+        """Simulate host loss: running tasks there fail with host-lost."""
+        with self._lock:
+            dead = [tid for tid, t in self.tasks.items()
+                    if t.spec.hostname == hostname]
+            for tid in dead:
+                t = self.tasks.pop(tid)
+                self.emit_status(tid, InstanceStatus.FAILED, 5000)
+            self.hosts.pop(hostname, None)
+            self.used.pop(hostname, None)
+            return dead

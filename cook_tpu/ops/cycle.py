@@ -61,6 +61,7 @@ def rank_and_match(
     num_considerable: int = 1024,
     num_groups: int = 1,
     sequential: bool = True,
+    considerable_limit=None,
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -116,9 +117,14 @@ def rank_and_match(
               & (u_cnt[uid] + cum[:, 2] <= user_quota_count[uid]))
     within_q = jnp.zeros(P, bool).at[uperm].set(within)      # queue order
     considerable_q = q_valid & within_q
-    # cap at num_considerable in queue order
+    # cap at num_considerable (static, sets the compact batch shape) and
+    # at considerable_limit (dynamic, the scaleback feedback value —
+    # scheduler.clj:1002-1036 — which must not trigger a recompile)
+    cap = num_considerable if considerable_limit is None else \
+        jnp.minimum(jnp.int32(num_considerable),
+                    jnp.asarray(considerable_limit, jnp.int32))
     taken = jnp.cumsum(considerable_q.astype(jnp.int32))
-    considerable_q &= taken <= num_considerable
+    considerable_q &= taken <= cap
     considerable = jnp.zeros(P, bool).at[queue_perm].set(considerable_q)
 
     # ---- 3. compact the considerable head, then match ----------------

@@ -1,0 +1,129 @@
+"""Placement constraints -> boolean forbidden masks for the kernels.
+
+The reference evaluates constraints twice — as Fenzo ConstraintEvaluators
+on the match path and as plain fns on the rebalancer path
+(constraints.clj:57-311). Here both paths consume the same dense
+`forbidden[job, host]` mask; the constraints that couple same-cycle
+assignments (group unique host-placement, max-tasks-per-host) are
+enforced inside the match kernel itself (ops/match.py).
+
+Implemented constraint kinds:
+  novel-host            job never returns to a host a previous instance
+                        ran on (constraints.clj:73-100)
+  user attr constraints (attribute, EQUALS, pattern)
+                        (constraints.clj:171-198)
+  rebalancer reservation hosts reserved for a specific job are forbidden
+                        to all others (constraints.clj:130-141,
+                        rebalancer.clj:413-426)
+  gpu-host              enforced in-kernel from cap_gpus
+  group attribute-equals all group tasks on hosts with equal attribute
+                        value (constraints.clj:453-480)
+"""
+from __future__ import annotations
+
+import fnmatch
+from typing import Optional
+
+import numpy as np
+
+from cook_tpu.state.model import Job
+
+
+def _matches(op: str, pattern: str, value: Optional[str]) -> bool:
+    if value is None:
+        return False
+    if op == "EQUALS":
+        return value == pattern
+    if op == "GLOB":
+        return fnmatch.fnmatch(value, pattern)
+    return False
+
+
+def build_forbidden(jobs: list[Job], host_names: list[str],
+                    host_attrs: list[dict[str, str]],
+                    reservations: Optional[dict[str, str]] = None,
+                    group_cotask_attr: Optional[dict[str, dict[str, str]]] = None,
+                    group_cotask_hosts: Optional[dict[str, set]] = None,
+                    ) -> np.ndarray:
+    """forbidden[j, h] True => job j may not land on host h.
+
+    reservations: job_uuid -> reserved hostname (other jobs excluded).
+    group_cotask_attr: group_uuid -> {attr: required_value} pinned by
+    already-running cotasks of an attribute-equals group.
+    group_cotask_hosts: group_uuid -> hostnames holding running cotasks
+    of a *unique* host-placement group (cross-cycle uniqueness; the
+    in-cycle half is enforced by the match kernel's group_occ).
+
+    Vectorized per job over hosts: the hot dimension H is handled with
+    numpy masks, never a Python loop.
+    """
+    P, H = len(jobs), len(host_names)
+    forb = np.zeros((P, H), bool)
+    reservations = reservations or {}
+    group_cotask_attr = group_cotask_attr or {}
+    group_cotask_hosts = group_cotask_hosts or {}
+    host_idx = {h: i for i, h in enumerate(host_names)}
+
+    # hosts reserved for some job are forbidden to every other job
+    reserved_rows = np.zeros(H, bool)
+    reserved_owner = np.full(H, -1, np.int64)
+    uuid_to_row = {job.uuid: j for j, job in enumerate(jobs)}
+    for owner_uuid, hostname in reservations.items():
+        hi = host_idx.get(hostname)
+        if hi is not None:
+            reserved_rows[hi] = True
+            reserved_owner[hi] = uuid_to_row.get(owner_uuid, -1)
+
+    # per-attribute host value arrays, built lazily once
+    attr_cache: dict[str, np.ndarray] = {}
+
+    def attr_values(attr: str) -> np.ndarray:
+        vals = attr_cache.get(attr)
+        if vals is None:
+            vals = np.array([a.get(attr) for a in host_attrs], dtype=object)
+            attr_cache[attr] = vals
+        return vals
+
+    for j, job in enumerate(jobs):
+        # novel-host: exclude hosts of previous instances
+        for inst in job.instances:
+            hi = host_idx.get(inst.hostname)
+            if hi is not None:
+                forb[j, hi] = True
+        # user-defined constraints
+        for (attr, op, pattern) in job.constraints:
+            vals = attr_values(attr)
+            if op == "EQUALS":
+                forb[j] |= vals != pattern
+            else:
+                forb[j] |= ~np.array(
+                    [_matches(op, pattern, v) for v in vals], bool)
+        # reservations
+        forb[j] |= reserved_rows & (reserved_owner != j)
+        # group attribute-equals pinning
+        if job.group and job.group in group_cotask_attr:
+            for attr, required in group_cotask_attr[job.group].items():
+                forb[j] |= attr_values(attr) != required
+        # cross-cycle unique host-placement
+        if job.group and job.group in group_cotask_hosts:
+            for hostname in group_cotask_hosts[job.group]:
+                hi = host_idx.get(hostname)
+                if hi is not None:
+                    forb[j, hi] = True
+    return forb
+
+
+def group_attr_requirements(group, running_cotask_hosts: list[dict[str, str]]
+                            ) -> dict[str, str]:
+    """For an attribute-equals group, derive the pinned attribute value
+    from any running cotask's host (constraints.clj:453-480)."""
+    hp = group.host_placement
+    if hp.get("type") != "attribute-equals":
+        return {}
+    attr = hp.get("parameters", {}).get("attribute")
+    if not attr:
+        return {}
+    for attrs in running_cotask_hosts:
+        if attr in attrs:
+            return {attr: attrs[attr]}
+    return {}

@@ -1,0 +1,518 @@
+"""The leader's scheduling loops: rank/match, rebalance, watchdogs.
+
+This is the coordinator that glues the durable store, the JAX kernels
+and the compute backends together — the role of the reference's
+create-datomic-scheduler + make-offer-handler match loop
+(scheduler.clj:940-1036, :1548-1583), start-rebalancer!
+(rebalancer.clj:428-581) and the lingering/straggler/cancelled killers
+(scheduler.clj:1114-1240).
+
+Design: all cycles are explicit `*_cycle()` methods driven either by the
+test/simulator harness (deterministic, faster than real time — the
+zz_simulator mode) or by the timer threads in `run()` (production mode,
+1 s match / 5 s rank cadence like make-trigger-chans mesos.clj:85-109).
+
+Exactly-once launch protocol (the kill-lock, compute_cluster.clj:21-42):
+the instance transaction is written to the store BEFORE launch_tasks is
+called on the backend; backend launch failures surface as status updates
+that consume a (mea-culpa) retry.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+from cook_tpu.backends.base import ClusterRegistry, LaunchSpec, Offer
+from cook_tpu.ops import cycle as cycle_ops
+from cook_tpu.ops import match as match_ops
+from cook_tpu.ops import rebalance as rb_ops
+from cook_tpu.scheduler import constraints as constraints_mod
+from cook_tpu.scheduler.tensorize import (
+    JobBatch, TaskBatch, UserInterner, bucket, quota_arrays, tensorize_jobs,
+    tensorize_tasks)
+from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
+from cook_tpu.state.model import InstanceStatus, Job, JobState, now_ms
+from cook_tpu.state.pools import PoolRegistry
+from cook_tpu.state.store import JobStore, TransactionError
+
+
+@dataclass
+class RebalancerParams:
+    """Runtime-tunable knobs, stored like the reference keeps them in
+    Datomic (rebalancer.clj:520-542, docs/rebalancer-config.adoc)."""
+
+    safe_dru_threshold: float = 1.0
+    min_dru_diff: float = 0.5
+    max_preemption: int = 64
+
+
+@dataclass
+class SchedulerConfig:
+    max_jobs_considered: int = 1024   # fenzo-max-jobs-considered
+    scaleback: float = 0.95           # considerable scaleback factor
+    match_interval_s: float = 1.0
+    rank_interval_s: float = 5.0
+    rebalancer_interval_s: float = 300.0
+    rebalancer: RebalancerParams = field(default_factory=RebalancerParams)
+    # batched matcher beyond this many considerable jobs
+    sequential_match_threshold: int = 2048
+
+
+@dataclass
+class MatchStats:
+    offers: int = 0
+    considerable: int = 0
+    matched: int = 0
+    head_matched: bool = True
+    cycle_ms: float = 0.0
+
+
+class Coordinator:
+    def __init__(self, store: JobStore, clusters: ClusterRegistry,
+                 shares: Optional[ShareStore] = None,
+                 quotas: Optional[QuotaStore] = None,
+                 pools: Optional[PoolRegistry] = None,
+                 config: Optional[SchedulerConfig] = None,
+                 launch_rate_limiter: Optional[RateLimiter] = None,
+                 user_launch_rate_limiter: Optional[RateLimiter] = None):
+        self.store = store
+        self.clusters = clusters
+        self.shares = shares or ShareStore()
+        self.quotas = quotas or QuotaStore()
+        self.pools = pools or PoolRegistry()
+        self.config = config or SchedulerConfig()
+        self.launch_rl = launch_rate_limiter or RateLimiter(enforce=False)
+        self.user_launch_rl = user_launch_rate_limiter or RateLimiter(enforce=False)
+        self.interner = UserInterner()
+        # rebalancer host reservations: job_uuid -> hostname
+        # (rebalancer.clj:413-426 <-> scheduler.clj:553-559)
+        self.reservations: dict[str, str] = {}
+        # per-pool adaptive considerable count (scaleback feedback,
+        # scheduler.clj:1002-1036)
+        self._num_considerable: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.metrics: dict[str, float] = {}
+        for cluster in clusters.all():
+            cluster.set_status_callback(self._on_status)
+
+    # ------------------------------------------------------------------
+    def _on_status(self, task_id: str, status: InstanceStatus,
+                   reason: Optional[int]) -> None:
+        preempted = reason == 2000
+        self.store.update_instance(task_id, status, reason_code=reason,
+                                   preempted=preempted)
+        # a launched job's reservation is spent
+        job_uuid = self.store.task_to_job.get(task_id)
+        if job_uuid and job_uuid in self.reservations and \
+                status == InstanceStatus.RUNNING:
+            self.reservations.pop(job_uuid, None)
+
+    def _purge_reservations(self) -> None:
+        """Drop reservations whose job is no longer waiting (killed,
+        completed, or already launched) so a dead reservation can't
+        blacklist a host forever."""
+        for uuid in list(self.reservations):
+            job = self.store.get_job(uuid)
+            if job is None or job.state != JobState.WAITING:
+                self.reservations.pop(uuid, None)
+
+    # ------------------------------------------------------------------
+    # match cycle (scheduler.clj:848-1036)
+    def match_cycle(self, pool: Optional[str] = None) -> MatchStats:
+        pool = pool or self.pools.default_pool
+        t0 = time.perf_counter()
+        stats = MatchStats()
+        self._purge_reservations()
+
+        # gather offers from every cluster (scheduler.clj:977-985)
+        offers: list[Offer] = []
+        offer_cluster: dict[str, str] = {}
+        for cluster in self.clusters.all():
+            for o in cluster.pending_offers(pool):
+                offers.append(o)
+                offer_cluster[o.hostname] = cluster.name
+        pending = self.store.pending_jobs(pool)
+        stats.offers = len(offers)
+        if not offers or not pending:
+            stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+            return stats
+
+        # per-user launch rate limit: drop whole users up front
+        # (pending-jobs->considerable-jobs scheduler.clj:627-657)
+        pending = [j for j in pending
+                   if self.user_launch_rl.would_allow(j.user)]
+        if not self.launch_rl.would_allow("global"):
+            pending = []
+        if not pending:
+            stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+            return stats
+
+        num_considerable = self._num_considerable.get(
+            pool, self.config.max_jobs_considered)
+
+        # tensorize
+        run_insts = [(i, self.store.jobs[i.job_uuid])
+                     for i in self.store.running_instances(pool)]
+        host_names = [o.hostname for o in offers]
+        host_ids = {h: i for i, h in enumerate(host_names)}
+        host_attrs = [o.attributes for o in offers]
+        tb = tensorize_tasks(run_insts, self.shares, pool,
+                             self.interner, host_ids)
+        jb = tensorize_jobs(pending, self.shares, pool, self.interner,
+                            groups=self.store.groups)
+        H = bucket(len(offers))
+        hosts = match_ops.make_hosts(
+            mem=_pad([o.mem for o in offers], H),
+            cpus=_pad([o.cpus for o in offers], H),
+            gpus=_pad([o.gpus for o in offers], H),
+            cap_mem=_pad([o.cap_mem or o.mem for o in offers], H),
+            cap_cpus=_pad([o.cap_cpus or o.cpus for o in offers], H),
+            cap_gpus=_pad([o.cap_gpus or o.gpus for o in offers], H),
+            valid=np.arange(H) < len(offers),
+        )
+        forb_small = constraints_mod.build_forbidden(
+            pending, host_names, host_attrs, self.reservations,
+            self._group_attr_pins(pending),
+            self._group_unique_hosts(pending))
+        forbidden = np.zeros((jb.user.shape[0], H), bool)
+        forbidden[:len(pending), :len(offers)] = forb_small
+        forbidden[:, len(offers):] = True
+        qm, qc, qn = quota_arrays(self.quotas, self.interner, pool)
+
+        C = min(bucket(self.config.max_jobs_considered), jb.user.shape[0])
+        res = cycle_ops.rank_and_match(
+            tb.user, tb.mem, tb.cpus, tb.priority, tb.start_time, tb.valid,
+            tb.mem_share, tb.cpus_share,
+            jb.user, jb.mem, jb.cpus, jb.gpus, jb.priority, jb.start_time,
+            jb.valid, jb.mem_share, jb.cpus_share, jb.group, jb.unique_group,
+            hosts, forbidden, qm, qc, qn,
+            num_considerable=C, num_groups=jb.num_groups,
+            sequential=C <= self.config.sequential_match_threshold,
+            considerable_limit=num_considerable)
+
+        job_host = np.asarray(res.job_host)
+        considerable = np.asarray(res.considerable)
+        queue_rank = np.asarray(res.queue_rank)
+        stats.considerable = int(considerable[:len(pending)].sum())
+
+        # launch matched tasks: store txn first, then backend launch
+        # (launch-matched-tasks! scheduler.clj:754-805)
+        by_cluster: dict[str, list[LaunchSpec]] = {}
+        launched = 0
+        for idx in np.argsort(queue_rank[:len(pending)]):
+            h = job_host[idx]
+            if h < 0 or h >= len(offers):
+                continue
+            job = pending[idx]
+            if not self.user_launch_rl.try_acquire(job.user):
+                continue
+            hostname = host_names[h]
+            try:
+                inst = self.store.create_instance(job.uuid, hostname,
+                                                  offer_cluster[hostname])
+            except TransactionError:
+                continue  # lost a race (job killed meanwhile)
+            by_cluster.setdefault(offer_cluster[hostname], []).append(
+                LaunchSpec(task_id=inst.task_id, job_uuid=job.uuid,
+                           hostname=hostname, command=job.command,
+                           mem=job.mem, cpus=job.cpus, gpus=job.gpus,
+                           env=job.env, container=job.container))
+            launched += 1
+            self.launch_rl.spend("global")
+            if job.uuid in self.reservations:
+                self.reservations.pop(job.uuid, None)
+        for cname, specs in by_cluster.items():
+            self.clusters.get(cname).launch_tasks(pool, specs)
+        stats.matched = launched
+
+        # placement-failure bookkeeping for /unscheduled_jobs
+        # (fenzo_utils.clj:74; record-placement-failures!)
+        for idx, job in enumerate(pending):
+            if considerable[idx] and job_host[idx] < 0:
+                job.last_placement_failure = {
+                    "reasons": ["no-host-with-sufficient-resources"],
+                    "at_ms": now_ms(),
+                }
+
+        # head-of-queue scaleback (scheduler.clj:1002-1036): if the head
+        # considerable job failed to place, shrink next cycle's batch.
+        head_matched = True
+        cons_idx = [i for i in range(len(pending)) if considerable[i]]
+        if cons_idx:
+            head = min(cons_idx, key=lambda i: queue_rank[i])
+            head_matched = job_host[head] >= 0
+        if head_matched:
+            self._num_considerable[pool] = self.config.max_jobs_considered
+        else:
+            self._num_considerable[pool] = max(
+                1, int(num_considerable * self.config.scaleback))
+        stats.head_matched = head_matched
+
+        # autoscaling hook (trigger-autoscaling! scheduler.clj:828-846)
+        queue_depth = len(pending) - launched
+        for cluster in self.clusters.all():
+            cluster.autoscale(pool, queue_depth)
+
+        stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
+        self.metrics[f"match.{pool}.matched"] = launched
+        return stats
+
+    def _group_attr_pins(self, pending: list[Job]) -> dict[str, dict[str, str]]:
+        pins: dict[str, dict[str, str]] = {}
+        all_attrs = self._all_host_attributes()
+        for job in pending:
+            if not job.group or job.group in pins:
+                continue
+            group = self.store.groups.get(job.group)
+            if group is None:
+                continue
+            cotask_attrs = []
+            for ju in group.jobs:
+                j = self.store.jobs.get(ju)
+                if not j:
+                    continue
+                for inst in j.active_instances:
+                    cotask_attrs.append(all_attrs.get(inst.hostname, {}))
+            req = constraints_mod.group_attr_requirements(group, cotask_attrs)
+            if req:
+                pins[job.group] = req
+        return pins
+
+    def _group_unique_hosts(self, pending: list[Job]) -> dict[str, set]:
+        """group uuid -> hosts already holding running cotasks of a
+        unique host-placement group (cross-cycle uniqueness)."""
+        out: dict[str, set] = {}
+        for job in pending:
+            if not job.group or job.group in out:
+                continue
+            group = self.store.groups.get(job.group)
+            if group is None or group.host_placement.get("type") != "unique":
+                continue
+            hosts = set()
+            for ju in group.jobs:
+                j = self.store.jobs.get(ju)
+                if not j:
+                    continue
+                for inst in j.active_instances:
+                    hosts.add(inst.hostname)
+            if hosts:
+                out[job.group] = hosts
+        return out
+
+    def _all_host_attributes(self) -> dict[str, dict[str, str]]:
+        attrs: dict[str, dict[str, str]] = {}
+        for cluster in self.clusters.all():
+            attrs.update(cluster.host_attributes())
+        return attrs
+
+    def _host_attrs_of(self, hostname: str) -> dict[str, str]:
+        return self._all_host_attributes().get(hostname, {})
+
+    # ------------------------------------------------------------------
+    # rebalancer cycle (rebalancer.clj:428-518)
+    def rebalance_cycle(self, pool: Optional[str] = None) -> dict:
+        pool = pool or self.pools.default_pool
+        params = self.config.rebalancer
+        self._purge_reservations()
+        pending = self.store.pending_jobs(pool)
+        if not pending:
+            return {"preempted": 0, "placed": 0}
+        run_insts = [(i, self.store.jobs[i.job_uuid])
+                     for i in self.store.running_instances(pool)]
+
+        # host universe: running hosts + current offers
+        offers: list[Offer] = []
+        for cluster in self.clusters.all():
+            offers.extend(cluster.pending_offers(pool))
+        host_names = sorted({i.hostname for i, _ in run_insts}
+                            | {o.hostname for o in offers})
+        host_ids = {h: i for i, h in enumerate(host_names)}
+        Hn = max(bucket(len(host_names)), 1)
+        spare_mem = np.zeros(Hn, np.float32)
+        spare_cpus = np.zeros(Hn, np.float32)
+        for o in offers:
+            spare_mem[host_ids[o.hostname]] += o.mem
+            spare_cpus[host_ids[o.hostname]] += o.cpus
+
+        P = min(params.max_preemption, len(pending))
+        # take the fair-queue head: sort pending by (priority desc, submit)
+        pending_sorted = sorted(
+            pending, key=lambda j: (-j.priority, j.submit_time_ms))[:P]
+        Pb = bucket(P)
+        tb = tensorize_tasks(run_insts, self.shares, pool,
+                             self.interner, host_ids, extra_slots=Pb)
+        jb = tensorize_jobs(pending_sorted, self.shares, pool, self.interner,
+                            groups=self.store.groups, pad_to=Pb)
+        all_attrs = self._all_host_attributes()
+        host_attrs = [all_attrs.get(h, {}) for h in host_names]
+        forb_small = constraints_mod.build_forbidden(
+            pending_sorted, host_names, host_attrs, self.reservations,
+            self._group_attr_pins(pending_sorted),
+            self._group_unique_hosts(pending_sorted))
+        host_forb = np.ones((Pb, Hn), bool)
+        host_forb[:len(pending_sorted), :len(host_names)] = forb_small
+        host_forb[:len(pending_sorted), len(host_names):] = True
+
+        qm, qc, qn = quota_arrays(self.quotas, self.interner, pool)
+        tasks = rb_ops.TaskState(
+            user=tb.user, mem=tb.mem, cpus=tb.cpus, priority=tb.priority,
+            start_time=tb.start_time, host=tb.host, valid=tb.valid,
+            mem_share=tb.mem_share, cpus_share=tb.cpus_share)
+        pend = rb_ops.PendingJobs(
+            user=jb.user, mem=jb.mem, cpus=jb.cpus, priority=jb.priority,
+            start_time=jb.start_time, valid=jb.valid,
+            mem_share=jb.mem_share, cpus_share=jb.cpus_share)
+        res = rb_ops.rebalance(
+            tasks, pend, spare_mem, spare_cpus, host_forb,
+            qm, qc, qn.astype(np.int32) if qn.dtype != np.int32 else qn,
+            params.safe_dru_threshold, params.min_dru_diff)
+
+        preempted_rows = np.flatnonzero(np.asarray(res.preempted)[:tb.n])
+        placed = np.asarray(res.job_placed)
+        job_hosts = np.asarray(res.job_host)
+
+        # kill victims (transact then kill: rebalancer.clj:498-518)
+        n_killed = 0
+        for row in preempted_rows:
+            task_id = tb.task_ids[row]
+            self.store.update_instance(task_id, InstanceStatus.FAILED,
+                                       reason_code=2000, preempted=True)
+            for cluster in self.clusters.all():
+                if hasattr(cluster, "preempt_task"):
+                    cluster.preempt_task(task_id)
+                else:
+                    cluster.kill_task(task_id)
+            n_killed += 1
+
+        # reserve hosts for jobs whose decision preempted >1 task
+        # (reserve-hosts! rebalancer.clj:413-426); single-kill decisions
+        # rely on the freed capacity next cycle.
+        decisions = []
+        for i, job in enumerate(pending_sorted):
+            if i < len(placed) and placed[i] and job_hosts[i] >= 0 \
+                    and job_hosts[i] < len(host_names):
+                decisions.append((job.uuid, host_names[int(job_hosts[i])]))
+        host_kill_count: dict[str, int] = {}
+        for row in preempted_rows:
+            inst = self.store.get_instance(tb.task_ids[row])
+            if inst:
+                host_kill_count[inst.hostname] = \
+                    host_kill_count.get(inst.hostname, 0) + 1
+        for job_uuid, hostname in decisions:
+            if host_kill_count.get(hostname, 0) > 1:
+                self.reservations[job_uuid] = hostname
+
+        self.metrics[f"rebalance.{pool}.preempted"] = n_killed
+        return {"preempted": n_killed, "placed": int(placed.sum()),
+                "decisions": decisions}
+
+    # ------------------------------------------------------------------
+    # watchdog killers (scheduler.clj:1114-1240, group.clj:17-45)
+    def watchdog_cycle(self, wall_ms: Optional[int] = None) -> dict:
+        wall_ms = wall_ms or now_ms()
+        killed_lingering, killed_straggler = [], []
+        for job in list(self.store.jobs.values()):
+            if job.state != JobState.RUNNING:
+                continue
+            for inst in job.active_instances:
+                runtime = wall_ms - inst.start_time_ms
+                if runtime > job.max_runtime_ms:
+                    self.store.update_instance(
+                        inst.task_id, InstanceStatus.FAILED, reason_code=4000)
+                    self._backend_kill(inst.task_id)
+                    killed_lingering.append(inst.task_id)
+        # stragglers: per group quantile-deviation (group.clj:17-45)
+        for group in self.store.groups.values():
+            sh = group.straggler_handling
+            if sh.get("type") != "quantile-deviation":
+                continue
+            params = sh.get("parameters", {})
+            quantile = float(params.get("quantile", 0.5))
+            mult = float(params.get("multiplier", 2.0))
+            runtimes = []
+            for ju in group.jobs:
+                j = self.store.jobs.get(ju)
+                if not j:
+                    continue
+                for inst in j.instances:
+                    if inst.status == InstanceStatus.SUCCESS and inst.end_time_ms:
+                        runtimes.append(inst.end_time_ms - inst.start_time_ms)
+            if not runtimes:
+                continue
+            threshold = float(np.quantile(runtimes, quantile)) * mult
+            for ju in group.jobs:
+                j = self.store.jobs.get(ju)
+                if not j:
+                    continue
+                for inst in j.active_instances:
+                    if wall_ms - inst.start_time_ms > threshold:
+                        self.store.update_instance(
+                            inst.task_id, InstanceStatus.FAILED,
+                            reason_code=4001)
+                        self._backend_kill(inst.task_id)
+                        killed_straggler.append(inst.task_id)
+        return {"lingering": killed_lingering, "stragglers": killed_straggler}
+
+    def _backend_kill(self, task_id: str) -> None:
+        for cluster in self.clusters.all():
+            cluster.kill_task(task_id)
+
+    # ------------------------------------------------------------------
+    # reconciliation (scheduler.clj:1041-1104): store vs backend resync
+    def reconcile(self) -> dict:
+        known = set()
+        for cluster in self.clusters.all():
+            known |= cluster.known_task_ids()
+        lost = []
+        for job in self.store.jobs.values():
+            for inst in job.active_instances:
+                # UNKNOWN = launch still in flight; only resync RUNNING
+                if inst.status != InstanceStatus.RUNNING:
+                    continue
+                if inst.task_id not in known:
+                    self.store.update_instance(
+                        inst.task_id, InstanceStatus.FAILED, reason_code=5000)
+                    lost.append(inst.task_id)
+        return {"lost": lost}
+
+    # ------------------------------------------------------------------
+    # production mode: timer threads (make-trigger-chans mesos.clj:85-109)
+    def run(self) -> None:
+        def loop(interval, fn, per_pool=True):
+            def body():
+                while not self._stop.wait(interval):
+                    try:
+                        if per_pool:
+                            for p in self.pools.active():
+                                fn(p.name)
+                        else:
+                            fn()
+                    except Exception:
+                        log.exception("scheduler cycle failed")
+            t = threading.Thread(target=body, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        loop(self.config.match_interval_s, self.match_cycle)
+        loop(self.config.rebalancer_interval_s, self.rebalance_cycle)
+        loop(60.0, self.watchdog_cycle, per_pool=False)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+def _pad(vals, size, fill=0.0):
+    a = np.full(size, fill, np.float32)
+    a[:len(vals)] = vals
+    return a

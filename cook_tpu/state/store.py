@@ -1,0 +1,435 @@
+"""Durable job store: the framework's single source of truth.
+
+Plays the role Datomic plays in the reference (datomic.clj, schema.clj
+transaction functions, metatransaction/): an in-memory entity map fed by
+*transaction functions* that enforce the legal state machines, an
+append-only event log for durability, snapshot+replay recovery, and a
+tx-report stream (listeners) that reacts to completed jobs the way
+monitor-tx-report-queue does (scheduler.clj:373-435).
+
+Storage layout: every mutation is appended as one JSON event to the log
+(cook_tpu.native.eventlog provides a C++ writer; the pure-Python writer
+is the fallback). A restarted leader replays snapshot + tail to rebuild
+all in-memory state — the reference's restart path (SURVEY.md §5
+checkpoint/resume).
+
+Concurrency: one writer lock around transactions (the reference
+serializes through the Datomic transactor + kill-lock,
+compute_cluster.clj:21-42); reads are dict reads of immutable-ish
+dataclasses and may be slightly stale, like Datomic's snapshot reads.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import asdict
+from typing import Any, Callable, Iterable, Optional
+
+from cook_tpu.state.model import (
+    Group, Instance, InstanceStatus, Job, JobState, REASON_BY_CODE,
+    REASON_UNKNOWN, VALID_INSTANCE_TRANSITIONS, new_uuid, now_ms,
+)
+
+
+class TransactionError(Exception):
+    """Illegal transition / constraint violation; transaction rejected."""
+
+
+class JobStore:
+    def __init__(self, log_path: Optional[str] = None,
+                 log_writer=None):
+        self._lock = threading.RLock()
+        self.jobs: dict[str, Job] = {}
+        self.groups: dict[str, Group] = {}
+        self.task_to_job: dict[str, str] = {}
+        self._listeners: list[Callable[[str, dict], None]] = []
+        self._log_path = log_path
+        self._log = log_writer
+        if log_path and log_writer is None:
+            self._log = _PyLogWriter(log_path)
+
+    # ------------------------------------------------------------------
+    # event log plumbing
+    def _append(self, kind: str, data: dict) -> None:
+        if self._log is not None and not getattr(self, "_replaying", False):
+            self._log.append(json.dumps({"t": now_ms(), "k": kind, **data},
+                                        separators=(",", ":")))
+
+    def _emit(self, kind: str, data: dict) -> None:
+        if getattr(self, "_replaying", False):
+            return
+        for fn in list(self._listeners):
+            try:
+                fn(kind, data)
+            except Exception:
+                pass
+
+    def add_listener(self, fn: Callable[[str, dict], None]) -> None:
+        """tx-report-queue equivalent: fn(kind, data) after each commit."""
+        self._listeners.append(fn)
+
+    # ------------------------------------------------------------------
+    # transaction functions (the schema.clj:949-1235 equivalents)
+    def create_jobs(self, jobs: Iterable[Job], groups: Iterable[Group] = (),
+                    committed: bool = True) -> list[str]:
+        """Batch submission with commit-latch semantics: either the whole
+        batch becomes visible (committed) or none of it does
+        (rest/api.clj:659 make-commit-latch, :1805 create-jobs!)."""
+        with self._lock:
+            jobs = list(jobs)
+            for g in groups:
+                if g.uuid in self.groups:
+                    existing = self.groups[g.uuid]
+                    existing.jobs.extend(j.uuid for j in jobs
+                                         if j.group == g.uuid)
+                else:
+                    self.groups[g.uuid] = g
+                    self._append("group", {"group": asdict(g)})
+            for job in jobs:
+                if job.uuid in self.jobs:
+                    raise TransactionError(f"duplicate job uuid {job.uuid}")
+            for job in jobs:
+                job.committed = committed
+                job.submit_time_ms = job.submit_time_ms or now_ms()
+                self.jobs[job.uuid] = job
+                self._append("job", _job_event(job))
+            return [j.uuid for j in jobs]
+
+    def commit_jobs(self, uuids: Iterable[str]) -> None:
+        """Flip the commit latch (metatransaction commit)."""
+        with self._lock:
+            for u in uuids:
+                job = self.jobs[u]
+                if not job.committed:
+                    job.committed = True
+                    self._append("commit", {"job": u})
+
+    def gc_uncommitted(self, older_than_ms: int) -> list[str]:
+        """Drop uncommitted jobs older than the cutoff
+        (clear-uncommitted-jobs-on-schedule, tools.clj:757)."""
+        with self._lock:
+            cutoff = now_ms() - older_than_ms
+            dead = [u for u, j in self.jobs.items()
+                    if not j.committed and j.submit_time_ms < cutoff]
+            for u in dead:
+                del self.jobs[u]
+                self._append("gc", {"job": u})
+            return dead
+
+    def allowed_to_start(self, job_uuid: str) -> bool:
+        """Guard evaluated inside the launch transaction
+        (:job/allowed-to-start? schema.clj:1170): job must exist, be
+        committed, waiting, and have no active instance."""
+        job = self.jobs.get(job_uuid)
+        return bool(job and job.committed and job.state == JobState.WAITING
+                    and not job.active_instances)
+
+    def create_instance(self, job_uuid: str, hostname: str, backend: str,
+                        task_id: Optional[str] = None) -> Instance:
+        """Atomically guard allowed-to-start and write the new instance +
+        job state (:instance/create schema.clj:949; launch txn
+        scheduler.clj:762-777)."""
+        with self._lock:
+            if not self.allowed_to_start(job_uuid):
+                raise TransactionError(f"job {job_uuid} not allowed to start")
+            job = self.jobs[job_uuid]
+            inst = Instance(task_id=task_id or new_uuid(), job_uuid=job_uuid,
+                            hostname=hostname, backend=backend,
+                            start_time_ms=now_ms())
+            job.instances.append(inst)
+            self.task_to_job[inst.task_id] = job_uuid
+            self._update_job_state(job)
+            self._append("inst", {"job": job_uuid, "task": inst.task_id,
+                                  "host": hostname, "backend": backend})
+            return inst
+
+    def update_instance(self, task_id: str, status: InstanceStatus,
+                        reason_code: Optional[int] = None,
+                        preempted: bool = False,
+                        exit_code: Optional[int] = None,
+                        sandbox: Optional[str] = None) -> Optional[Job]:
+        """The heart of the write path (:instance/update-state
+        schema.clj:1103 via write-status-to-datomic scheduler.clj:213):
+        apply a status update, ignore illegal transitions, recompute the
+        owning job's state in the same transaction."""
+        with self._lock:
+            job_uuid = self.task_to_job.get(task_id)
+            if job_uuid is None:
+                return None
+            job = self.jobs[job_uuid]
+            inst = next(i for i in job.instances if i.task_id == task_id)
+            if status == inst.status:
+                return job
+            if status not in VALID_INSTANCE_TRANSITIONS[inst.status]:
+                # illegal transition: drop, like the txn fn no-op
+                return job
+            inst.status = status
+            if reason_code is not None:
+                inst.reason_code = reason_code
+            if preempted:
+                inst.preempted = True
+            if exit_code is not None:
+                inst.exit_code = exit_code
+            if sandbox is not None:
+                inst.sandbox_directory = sandbox
+            if status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
+                inst.end_time_ms = now_ms()
+            was = job.state
+            self._update_job_state(job)
+            self._append("status", {"task": task_id, "s": status.value,
+                                    "r": reason_code, "p": preempted,
+                                    "e": exit_code})
+            if job.state == JobState.COMPLETED and was != JobState.COMPLETED:
+                self._emit("job-completed", {"job": job_uuid})
+            return job
+
+    def update_progress(self, task_id: str, sequence: int, percent: int,
+                        message: str) -> bool:
+        """Progress pipeline writeback (progress.clj:33-121): highest
+        sequence wins, duplicates dropped."""
+        with self._lock:
+            job_uuid = self.task_to_job.get(task_id)
+            if job_uuid is None:
+                return False
+            job = self.jobs[job_uuid]
+            inst = next(i for i in job.instances if i.task_id == task_id)
+            if sequence <= getattr(inst, "_progress_seq", -1):
+                return False
+            inst._progress_seq = sequence
+            inst.progress = percent
+            if message:
+                inst.progress_message = message
+            self._append("progress", {"task": task_id, "q": sequence,
+                                      "pc": percent, "m": message})
+            return True
+
+    def retry_job(self, job_uuid: str, retries: int,
+                  failed_only: bool = True) -> None:
+        """/retry endpoint semantics (rest/api.clj retries handler;
+        schema.clj:1213-1235 retry txn fns): raise max_retries and, if the
+        job completed with failures, reopen it as waiting."""
+        with self._lock:
+            job = self.jobs[job_uuid]
+            job.max_retries = retries
+            if (job.state == JobState.COMPLETED and not job.success
+                    and job.retries_remaining() > 0):
+                job.state = JobState.WAITING
+                job.success = None
+            self._append("retry", {"job": job_uuid, "n": retries})
+
+    def kill_job(self, job_uuid: str) -> list[str]:
+        """Mark a job killed: complete it and return active task ids the
+        backend must kill (kill-job mesos.clj:272)."""
+        with self._lock:
+            job = self.jobs.get(job_uuid)
+            if job is None or job.state == JobState.COMPLETED:
+                return []
+            to_kill = [i.task_id for i in job.active_instances]
+            job.state = JobState.COMPLETED
+            job.success = False
+            self._append("kill", {"job": job_uuid})
+            self._emit("job-completed", {"job": job_uuid})
+            return to_kill
+
+    # ------------------------------------------------------------------
+    def _update_job_state(self, job: Job) -> None:
+        """:job/update-state (schema.clj:1065): derive job state from its
+        instances + retry budget."""
+        if job.state == JobState.COMPLETED:
+            return
+        if any(i.active for i in job.instances):
+            job.state = JobState.RUNNING
+            return
+        if any(i.status == InstanceStatus.SUCCESS for i in job.instances):
+            job.state = JobState.COMPLETED
+            job.success = True
+            return
+        if job.retries_remaining() <= 0:
+            job.state = JobState.COMPLETED
+            job.success = False
+            return
+        job.state = JobState.WAITING
+
+    # ------------------------------------------------------------------
+    # queries (tools.clj:298-582 equivalents)
+    def pending_jobs(self, pool: Optional[str] = None) -> list[Job]:
+        return [j for j in self.jobs.values()
+                if j.committed and j.state == JobState.WAITING
+                and (pool is None or j.pool == pool)]
+
+    def running_jobs(self, pool: Optional[str] = None) -> list[Job]:
+        return [j for j in self.jobs.values()
+                if j.state == JobState.RUNNING
+                and (pool is None or j.pool == pool)]
+
+    def running_instances(self, pool: Optional[str] = None) -> list[Instance]:
+        return [i for j in self.running_jobs(pool) for i in j.active_instances]
+
+    def user_usage(self, pool: Optional[str] = None) -> dict[str, dict]:
+        """Per-user running resource totals (/usage, rest/api.clj:2648)."""
+        out: dict[str, dict] = {}
+        for j in self.running_jobs(pool):
+            u = out.setdefault(j.user, {"mem": 0.0, "cpus": 0.0, "gpus": 0.0,
+                                        "jobs": 0})
+            n_active = len(j.active_instances)
+            if n_active:
+                u["mem"] += j.mem
+                u["cpus"] += j.cpus
+                u["gpus"] += j.gpus
+                u["jobs"] += 1
+        return out
+
+    def get_job(self, uuid: str) -> Optional[Job]:
+        return self.jobs.get(uuid)
+
+    def get_instance(self, task_id: str) -> Optional[Instance]:
+        ju = self.task_to_job.get(task_id)
+        if ju is None:
+            return None
+        return next((i for i in self.jobs[ju].instances
+                     if i.task_id == task_id), None)
+
+    # ------------------------------------------------------------------
+    # snapshot / replay (checkpoint-resume; the restarted-leader path)
+    def snapshot(self, path: str) -> None:
+        """Atomic snapshot recording the current log position, so restore
+        replays only the tail written after this point."""
+        with self._lock:
+            data = {
+                "log_lines": self._log.lines() if self._log else 0,
+                "jobs": {u: _job_dict(j) for u, j in self.jobs.items()},
+                "groups": {u: asdict(g) for u, g in self.groups.items()},
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, path)
+
+    @classmethod
+    def restore(cls, path: Optional[str] = None,
+                log_path: Optional[str] = None) -> "JobStore":
+        """Rebuild: snapshot (if any) + replay of the event-log tail
+        beyond the snapshot's recorded position. With no snapshot the
+        whole log replays from empty."""
+        offset = 0
+        store = cls()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            offset = int(data.get("log_lines", 0))
+            for u, jd in data["jobs"].items():
+                job = _job_from_dict(jd)
+                store.jobs[u] = job
+                for inst in job.instances:
+                    store.task_to_job[inst.task_id] = u
+            for u, gd in data["groups"].items():
+                store.groups[u] = Group(**gd)
+        if log_path and os.path.exists(log_path):
+            store._replay(log_path, offset)
+        if log_path:
+            store._log_path = log_path
+            store._log = _PyLogWriter(log_path)
+        return store
+
+    def _replay(self, log_path: str, offset: int) -> None:
+        """Apply events [offset:] through the normal transaction fns with
+        logging/listeners suppressed."""
+        self._replaying = True
+        try:
+            with open(log_path) as f:
+                for lineno, line in enumerate(f):
+                    if lineno < offset or not line.strip():
+                        continue
+                    ev = json.loads(line)
+                    self._apply_event(ev)
+        finally:
+            self._replaying = False
+
+    def _apply_event(self, ev: dict) -> None:
+        k = ev["k"]
+        if k == "job":
+            job = _job_from_dict(ev["job"])
+            if job.uuid not in self.jobs:
+                self.jobs[job.uuid] = job
+                for inst in job.instances:
+                    self.task_to_job[inst.task_id] = job.uuid
+        elif k == "group":
+            g = Group(**ev["group"])
+            if g.uuid not in self.groups:
+                self.groups[g.uuid] = g
+        elif k == "commit":
+            job = self.jobs.get(ev["job"])
+            if job:
+                job.committed = True
+        elif k == "gc":
+            self.jobs.pop(ev["job"], None)
+        elif k == "inst":
+            job = self.jobs.get(ev["job"])
+            if job and not any(i.task_id == ev["task"] for i in job.instances):
+                inst = Instance(task_id=ev["task"], job_uuid=ev["job"],
+                                hostname=ev["host"], backend=ev["backend"],
+                                start_time_ms=ev.get("t", 0))
+                job.instances.append(inst)
+                self.task_to_job[inst.task_id] = job.uuid
+                self._update_job_state(job)
+        elif k == "status":
+            self.update_instance(ev["task"], InstanceStatus(ev["s"]),
+                                 reason_code=ev.get("r"),
+                                 preempted=bool(ev.get("p")),
+                                 exit_code=ev.get("e"))
+        elif k == "progress":
+            self.update_progress(ev["task"], ev["q"], ev["pc"], ev.get("m", ""))
+        elif k == "retry":
+            if ev["job"] in self.jobs:
+                self.retry_job(ev["job"], ev["n"])
+        elif k == "kill":
+            self.kill_job(ev["job"])
+
+
+def _job_event(job: Job) -> dict:
+    d = _job_dict(job)
+    return {"job": d}
+
+
+def _job_dict(job: Job) -> dict:
+    d = asdict(job)
+    d["state"] = job.state.value
+    for i, inst in enumerate(d["instances"]):
+        inst["status"] = job.instances[i].status.value
+    return d
+
+
+def _job_from_dict(d: dict) -> Job:
+    insts = [
+        Instance(**{**i, "status": InstanceStatus(i["status"])})
+        for i in d.pop("instances", [])
+    ]
+    d["state"] = JobState(d["state"])
+    job = Job(**{**d, "instances": insts})
+    return job
+
+
+class _PyLogWriter:
+    """Fallback pure-Python append-only log (the C++ writer in
+    cook_tpu/native is preferred; see native/eventlog.cpp)."""
+
+    def __init__(self, path: str):
+        self._n = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                self._n = sum(1 for _ in f)
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def append(self, line: str) -> None:
+        with self._lock:
+            self._f.write(line + "\n")
+            self._n += 1
+
+    def lines(self) -> int:
+        with self._lock:
+            return self._n
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,290 @@
+"""End-to-end scheduling slice on the mock backend.
+
+The reproduction of the reference's faster-than-real-time simulator flow
+(zz_simulator.clj + mesos_mock.clj): submit -> rank/match kernels ->
+launch on mock cluster -> virtual-clock completion -> status writeback.
+"""
+import numpy as np
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.scheduler.coordinator import (Coordinator, RebalancerParams,
+                                            SchedulerConfig)
+from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
+from cook_tpu.state.model import Group, InstanceStatus, Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+
+
+def mkjob(user="alice", mem=100, cpus=1, **kw):
+    return Job(uuid=new_uuid(), user=user, command="true", mem=mem, cpus=cpus,
+               **kw)
+
+
+def build(hosts=None, runtime_fn=None, config=None, shares=None, quotas=None,
+          **coord_kw):
+    store = JobStore()
+    cluster = MockCluster(hosts or [
+        MockHost("h0", mem=1000, cpus=16),
+        MockHost("h1", mem=1000, cpus=16),
+    ], runtime_fn=runtime_fn)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, shares=shares, quotas=quotas,
+                        config=config, **coord_kw)
+    return store, cluster, coord
+
+
+def test_submit_match_run_complete():
+    store, cluster, coord = build()
+    job = mkjob()
+    store.create_jobs([job])
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+    assert job.state == JobState.RUNNING
+    assert job.instances[0].status == InstanceStatus.RUNNING
+    cluster.advance(120.0)
+    assert job.state == JobState.COMPLETED and job.success
+
+
+def test_failure_retry_then_success():
+    fates = iter([(10.0, False, 1003), (10.0, True, None)])
+    store, cluster, coord = build(runtime_fn=lambda spec: next(fates))
+    job = mkjob(max_retries=2)
+    store.create_jobs([job])
+    coord.match_cycle()
+    cluster.advance(11)
+    assert job.state == JobState.WAITING
+    coord.match_cycle()
+    cluster.advance(11)
+    assert job.state == JobState.COMPLETED and job.success
+    assert len(job.instances) == 2
+
+
+def test_capacity_respected_and_queue_drains():
+    # 2 hosts x 16 cpus; 40 jobs of 1 cpu each: two waves then rest.
+    store, cluster, coord = build()
+    jobs = [mkjob(cpus=1, mem=10) for _ in range(40)]
+    store.create_jobs(jobs)
+    s1 = coord.match_cycle()
+    assert s1.matched == 32          # fills both hosts
+    s2 = coord.match_cycle()
+    assert s2.matched == 0           # no capacity left
+    cluster.advance(61)              # first wave completes
+    s3 = coord.match_cycle()
+    assert s3.matched == 8
+    running = [j for j in jobs if j.state == JobState.RUNNING]
+    assert len(running) == 8
+
+
+def test_fair_share_order():
+    # alice has 30 running-equivalents queued; bob submits 1: bob's first
+    # job must be matched when capacity only fits part of the queue.
+    store, cluster, coord = build(hosts=[MockHost("h0", mem=100, cpus=4)])
+    shares = coord.shares
+    shares.set("default", "default", mem=1000, cpus=1000)
+    alice_jobs = [mkjob(user="alice", mem=10, cpus=1) for _ in range(10)]
+    bob_job = mkjob(user="bob", mem=10, cpus=1)
+    store.create_jobs(alice_jobs + [bob_job])
+    stats = coord.match_cycle()
+    assert stats.matched == 4
+    # bob's single job has lower DRU than alice's 2nd..4th: it must run
+    assert bob_job.state == JobState.RUNNING
+
+
+def test_quota_blocks_considerable():
+    quotas = QuotaStore()
+    quotas.set("alice", "default", count=2, mem=1e9, cpus=1e9)
+    store, cluster, coord = build(quotas=quotas)
+    jobs = [mkjob() for _ in range(5)]
+    store.create_jobs(jobs)
+    stats = coord.match_cycle()
+    assert stats.matched == 2
+
+
+def test_user_launch_rate_limit():
+    t = [0.0]
+    rl = RateLimiter(tokens_per_sec=0.0001, max_tokens=1, clock=lambda: t[0])
+    store, cluster, coord = build(user_launch_rate_limiter=rl)
+    jobs = [mkjob() for _ in range(3)]
+    store.create_jobs(jobs)
+    stats = coord.match_cycle()
+    assert stats.matched == 1        # one token, one launch
+    stats = coord.match_cycle()
+    assert stats.matched == 0        # bucket empty -> user filtered
+
+
+def test_preemption_end_to_end():
+    # greedy user fills the cluster; poor user's job preempts via
+    # rebalancer once their DRU dominates.
+    store, cluster, coord = build(
+        hosts=[MockHost("h0", mem=100, cpus=10)],
+        config=SchedulerConfig(
+            rebalancer=RebalancerParams(safe_dru_threshold=0.0,
+                                        min_dru_diff=0.1,
+                                        max_preemption=4)))
+    coord.shares.set("default", "default", mem=100, cpus=10)
+    greedy = [mkjob(user="greedy", mem=20, cpus=2) for _ in range(5)]
+    store.create_jobs(greedy)
+    coord.match_cycle()
+    assert all(j.state == JobState.RUNNING for j in greedy)
+    poor = mkjob(user="poor", mem=20, cpus=2)
+    store.create_jobs([poor])
+    assert coord.match_cycle().matched == 0   # cluster full
+    res = coord.rebalance_cycle()
+    assert res["preempted"] >= 1
+    # the freed capacity lets the poor job match next cycle
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+    assert poor.state == JobState.RUNNING
+    # preempted greedy job got a mea-culpa failure (no retry consumed)
+    preempted = [j for j in greedy if any(i.preempted for i in j.instances)]
+    assert preempted and all(j.state == JobState.WAITING for j in preempted)
+
+
+def test_watchdog_max_runtime():
+    store, cluster, coord = build()
+    job = mkjob(max_runtime_ms=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    import time
+    time.sleep(0.01)
+    out = coord.watchdog_cycle()
+    assert out["lingering"]
+    assert job.state == JobState.COMPLETED
+    assert job.instances[0].reason_code == 4000
+
+
+def test_straggler_kill():
+    store, cluster, coord = build()
+    g = Group(uuid=new_uuid(), user="alice",
+              straggler_handling={"type": "quantile-deviation",
+                                  "parameters": {"quantile": 0.5,
+                                                 "multiplier": 1.5}})
+    jobs = [mkjob(group=g.uuid) for _ in range(4)]
+    for j in jobs:
+        j.group = g.uuid
+    g.jobs = [j.uuid for j in jobs]
+    store.create_jobs(jobs, groups=[g])
+    coord.match_cycle()
+    # complete 3 quickly (runtime ~0 ms), leave 1 running
+    for j in jobs[:3]:
+        store.update_instance(j.instances[0].task_id, InstanceStatus.SUCCESS)
+    out = coord.watchdog_cycle(wall_ms=jobs[3].instances[0].start_time_ms
+                               + 10_000)
+    assert out["stragglers"] == [jobs[3].instances[0].task_id]
+    assert jobs[3].instances[0].reason_code == 4001
+    # straggler is mea-culpa: job requeues
+    assert jobs[3].state == JobState.WAITING
+
+
+def test_novel_host_constraint():
+    # job fails on h0 -> next attempt must go to h1
+    fates = iter([(5.0, False, 1003), (5.0, True, None)])
+    store, cluster, coord = build(runtime_fn=lambda s: next(fates))
+    job = mkjob(max_retries=2)
+    store.create_jobs([job])
+    coord.match_cycle()
+    first_host = job.instances[0].hostname
+    cluster.advance(6)
+    coord.match_cycle()
+    assert job.instances[1].hostname != first_host
+
+
+def test_attribute_constraint():
+    store, cluster, coord = build(hosts=[
+        MockHost("h0", mem=1000, cpus=16, attributes={"zone": "us-east"}),
+        MockHost("h1", mem=1000, cpus=16, attributes={"zone": "us-west"}),
+    ])
+    job = mkjob(constraints=[("zone", "EQUALS", "us-west")])
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert job.instances[0].hostname == "h1"
+
+
+def test_unique_group_placement():
+    store, cluster, coord = build()
+    g = Group(uuid=new_uuid(), user="alice",
+              host_placement={"type": "unique"})
+    jobs = [mkjob(group=g.uuid) for _ in range(3)]
+    g.jobs = [j.uuid for j in jobs]
+    store.create_jobs(jobs, groups=[g])
+    stats = coord.match_cycle()
+    hosts = [j.instances[0].hostname for j in jobs if j.instances]
+    assert stats.matched == 2            # only 2 hosts
+    assert len(set(hosts)) == len(hosts)
+
+
+def test_unique_group_across_cycles():
+    # two hosts: cycle 1 places 2 unique-group jobs; after capacity frees
+    # the 3rd job must still avoid hosts with running cotasks
+    store, cluster, coord = build()
+    g = Group(uuid=new_uuid(), user="alice", host_placement={"type": "unique"})
+    jobs = [mkjob(group=g.uuid) for _ in range(3)]
+    g.jobs = [j.uuid for j in jobs]
+    store.create_jobs(jobs, groups=[g])
+    coord.match_cycle()
+    placed = [j for j in jobs if j.state == JobState.RUNNING]
+    assert len(placed) == 2
+    # plenty of capacity remains on both hosts; the third job must NOT
+    # match while its cotasks hold both hosts
+    s2 = coord.match_cycle()
+    assert s2.matched == 0
+
+
+def test_reservation_purged_when_job_killed():
+    store, cluster, coord = build(hosts=[MockHost("h0", mem=100, cpus=10)])
+    coord.shares.set("default", "default", mem=100, cpus=10)
+    greedy = [mkjob(user="greedy", mem=20, cpus=2) for _ in range(5)]
+    store.create_jobs(greedy)
+    coord.match_cycle()
+    poor = mkjob(user="poor", mem=40, cpus=4)
+    store.create_jobs([poor])
+    coord.config.rebalancer.safe_dru_threshold = 0.0
+    coord.config.rebalancer.min_dru_diff = 0.01
+    res = coord.rebalance_cycle()
+    if poor.uuid in coord.reservations:
+        store.kill_job(poor.uuid)
+        coord.match_cycle()
+        assert poor.uuid not in coord.reservations
+
+
+def test_scaleback_on_head_miss():
+    # head job too big to ever match -> considerable shrinks
+    store, cluster, coord = build()
+    big = mkjob(mem=10_000, cpus=100, priority=99)
+    small = [mkjob(mem=1, cpus=0.1) for _ in range(3)]
+    store.create_jobs([big] + small)
+    s = coord.match_cycle()
+    assert not s.head_matched
+    assert coord._num_considerable["default"] < coord.config.max_jobs_considered
+    # matching still proceeds below the head
+    assert s.matched == 3
+
+
+def test_reconcile_lost_tasks():
+    store, cluster, coord = build()
+    job = mkjob(max_retries=5)
+    store.create_jobs([job])
+    coord.match_cycle()
+    task_id = job.instances[0].task_id
+    # backend forgets the task (e.g. agent wiped) without a status
+    cluster.tasks.pop(task_id)
+    out = coord.reconcile()
+    assert out["lost"] == [task_id]
+    assert job.state == JobState.WAITING  # host-lost is mea-culpa
+
+
+def test_host_loss_fails_tasks_mea_culpa():
+    store, cluster, coord = build()
+    job = mkjob(max_retries=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    host = job.instances[0].hostname
+    cluster.remove_host(host)
+    assert job.instances[0].status == InstanceStatus.FAILED
+    assert job.state == JobState.WAITING  # mea-culpa, no retry consumed
+    # and the job can match again on the surviving host
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+    assert job.instances[1].hostname != host

@@ -1,0 +1,294 @@
+"""State store: job/instance state machines, commit latch, mea-culpa
+retries, shares/quotas/rate limits, snapshot/restore.
+
+Mirrors the reference's transaction-function unit tests
+(test/cook/test/schema.clj style: legal/illegal transitions, retry
+accounting)."""
+import math
+import os
+
+import pytest
+
+from cook_tpu.state.limits import (QuotaStore, RateLimiter, ShareStore,
+                                   TokenBucket, below_quota)
+from cook_tpu.state.model import (Instance, InstanceStatus, Job, JobState,
+                                  new_uuid)
+from cook_tpu.state.pools import DruMode, Pool, PoolRegistry
+from cook_tpu.state.store import JobStore, TransactionError
+
+
+def mkjob(user="alice", retries=1, **kw):
+    return Job(uuid=new_uuid(), user=user, command="true", mem=100, cpus=1,
+               max_retries=retries, **kw)
+
+
+def test_lifecycle_success():
+    s = JobStore()
+    job = mkjob()
+    s.create_jobs([job])
+    assert s.pending_jobs() == [job]
+    inst = s.create_instance(job.uuid, "host1", "mock")
+    assert job.state == JobState.RUNNING
+    assert not s.pending_jobs()
+    s.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    s.update_instance(inst.task_id, InstanceStatus.SUCCESS)
+    assert job.state == JobState.COMPLETED and job.success
+
+
+def test_failure_consumes_retry_and_requeues():
+    s = JobStore()
+    job = mkjob(retries=2)
+    s.create_jobs([job])
+    i1 = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(i1.task_id, InstanceStatus.FAILED, reason_code=1003)
+    assert job.state == JobState.WAITING  # 1 of 2 retries consumed
+    i2 = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(i2.task_id, InstanceStatus.FAILED, reason_code=1003)
+    assert job.state == JobState.COMPLETED and job.success is False
+
+
+def test_mea_culpa_failures_are_free():
+    s = JobStore()
+    job = mkjob(retries=1)
+    s.create_jobs([job])
+    for _ in range(3):
+        inst = s.create_instance(job.uuid, "h", "mock")
+        # preemption (mea-culpa, unlimited free retries)
+        s.update_instance(inst.task_id, InstanceStatus.FAILED,
+                          reason_code=2000, preempted=True)
+        assert job.state == JobState.WAITING
+    # real failure consumes the single retry
+    inst = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(inst.task_id, InstanceStatus.FAILED, reason_code=1003)
+    assert job.state == JobState.COMPLETED
+
+
+def test_mea_culpa_failure_limit():
+    s = JobStore()
+    job = mkjob(retries=1)
+    s.create_jobs([job])
+    # heartbeat-lost has failure_limit 3: the 4th+ counts against retries
+    for i in range(4):
+        inst = s.create_instance(job.uuid, "h", "mock")
+        s.update_instance(inst.task_id, InstanceStatus.FAILED,
+                          reason_code=3000)
+    assert job.state == JobState.COMPLETED
+
+
+def test_disable_mea_culpa():
+    s = JobStore()
+    job = mkjob(retries=1, disable_mea_culpa_retries=True)
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(inst.task_id, InstanceStatus.FAILED, reason_code=2000)
+    assert job.state == JobState.COMPLETED
+
+
+def test_illegal_transition_ignored():
+    s = JobStore()
+    job = mkjob()
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(inst.task_id, InstanceStatus.SUCCESS)
+    # terminal is immutable (schema.clj:1119-1124)
+    s.update_instance(inst.task_id, InstanceStatus.FAILED)
+    assert inst.status == InstanceStatus.SUCCESS
+    assert job.state == JobState.COMPLETED and job.success
+
+
+def test_allowed_to_start_guard():
+    s = JobStore()
+    job = mkjob()
+    s.create_jobs([job])
+    s.create_instance(job.uuid, "h", "mock")
+    with pytest.raises(TransactionError):
+        s.create_instance(job.uuid, "h2", "mock")  # already has active
+
+
+def test_commit_latch():
+    s = JobStore()
+    job = mkjob()
+    s.create_jobs([job], committed=False)
+    assert s.pending_jobs() == []          # invisible until committed
+    assert not s.allowed_to_start(job.uuid)
+    s.commit_jobs([job.uuid])
+    assert s.pending_jobs() == [job]
+    # uncommitted jobs get GC'd
+    j2 = mkjob()
+    s.create_jobs([j2], committed=False)
+    j2.submit_time_ms -= 10_000
+    assert s.gc_uncommitted(5_000) == [j2.uuid]
+
+
+def test_kill_job_returns_tasks():
+    s = JobStore()
+    job = mkjob()
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    tasks = s.kill_job(job.uuid)
+    assert tasks == [inst.task_id]
+    assert job.state == JobState.COMPLETED and job.success is False
+
+
+def test_retry_reopens_failed_job():
+    s = JobStore()
+    job = mkjob(retries=1)
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(inst.task_id, InstanceStatus.FAILED, reason_code=1003)
+    assert job.state == JobState.COMPLETED
+    s.retry_job(job.uuid, retries=3)
+    assert job.state == JobState.WAITING
+
+
+def test_completion_listener():
+    s = JobStore()
+    seen = []
+    s.add_listener(lambda k, d: seen.append((k, d)))
+    job = mkjob()
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(inst.task_id, InstanceStatus.SUCCESS)
+    assert ("job-completed", {"job": job.uuid}) in seen
+
+
+def test_progress_dedupe():
+    s = JobStore()
+    job = mkjob()
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    assert s.update_progress(inst.task_id, 1, 10, "a")
+    assert not s.update_progress(inst.task_id, 1, 20, "b")  # same seq
+    assert not s.update_progress(inst.task_id, 0, 30, "c")  # lower seq
+    assert inst.progress == 10
+    assert s.update_progress(inst.task_id, 2, 50, "")
+    assert inst.progress == 50 and inst.progress_message == "a"
+
+
+def test_snapshot_restore(tmp_path):
+    s = JobStore(log_path=str(tmp_path / "log.jsonl"))
+    job = mkjob(retries=2)
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    snap = str(tmp_path / "snap.json")
+    s.snapshot(snap)
+    s2 = JobStore.restore(snap)
+    j2 = s2.get_job(job.uuid)
+    assert j2.state == JobState.RUNNING
+    assert s2.get_instance(inst.task_id).status == InstanceStatus.RUNNING
+    # restored store keeps enforcing the state machine
+    s2.update_instance(inst.task_id, InstanceStatus.SUCCESS)
+    assert j2.state == JobState.COMPLETED
+    assert os.path.getsize(tmp_path / "log.jsonl") > 0
+
+
+def test_log_replay_after_snapshot(tmp_path):
+    # snapshot at T0, keep mutating, crash, restore: the log tail must
+    # replay so no transition is lost
+    log = str(tmp_path / "log.jsonl")
+    snap = str(tmp_path / "snap.json")
+    s = JobStore(log_path=log)
+    j1, j2 = mkjob(), mkjob(retries=2)
+    s.create_jobs([j1, j2])
+    i1 = s.create_instance(j1.uuid, "h", "mock")
+    s.snapshot(snap)
+    # post-snapshot activity
+    s.update_instance(i1.task_id, InstanceStatus.SUCCESS)
+    i2 = s.create_instance(j2.uuid, "h2", "mock")
+    s.update_instance(i2.task_id, InstanceStatus.FAILED, reason_code=1003)
+    j3 = mkjob()
+    s.create_jobs([j3])
+    # "crash" + restore
+    s2 = JobStore.restore(snap, log_path=log)
+    assert s2.get_job(j1.uuid).state == JobState.COMPLETED
+    assert s2.get_job(j1.uuid).success
+    r2 = s2.get_job(j2.uuid)
+    assert r2.state == JobState.WAITING and len(r2.instances) == 1
+    assert s2.get_job(j3.uuid) is not None
+    # restored store appends to the same log without clobbering history
+    i3 = s2.create_instance(j3.uuid, "h", "mock")
+    s3 = JobStore.restore(snap, log_path=log)
+    assert s3.get_instance(i3.task_id) is not None
+
+
+def test_full_log_replay_without_snapshot(tmp_path):
+    log = str(tmp_path / "log.jsonl")
+    s = JobStore(log_path=log)
+    job = mkjob(retries=2)
+    s.create_jobs([job])
+    inst = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(inst.task_id, InstanceStatus.FAILED, reason_code=1003)
+    s.kill_job(job.uuid)
+    s2 = JobStore.restore(log_path=log)
+    j2 = s2.get_job(job.uuid)
+    assert j2.state == JobState.COMPLETED and j2.success is False
+
+
+def test_user_usage():
+    s = JobStore()
+    j1, j2 = mkjob(), mkjob()
+    s.create_jobs([j1, j2])
+    s.create_instance(j1.uuid, "h", "mock")
+    s.create_instance(j2.uuid, "h", "mock")
+    usage = s.user_usage()
+    assert usage["alice"]["jobs"] == 2
+    assert usage["alice"]["mem"] == 200.0
+
+
+# ---------------------------------------------------------------- limits
+def test_share_default_fallback():
+    shares = ShareStore()
+    shares.set("default", "default", mem=1000, cpus=100)
+    assert shares.get("bob", "default")["mem"] == 1000
+    shares.set("bob", "default", mem=50, cpus=5)
+    assert shares.get("bob", "default")["mem"] == 50
+    shares.retract("bob", "default")
+    assert shares.get("bob", "default")["mem"] == 1000
+    assert shares.get("bob", "otherpool")["mem"] == math.inf
+
+
+def test_quota_count_dimension():
+    q = QuotaStore()
+    q.set("alice", "default", count=2, mem=1000, cpus=10)
+    quota = q.get("alice", "default")
+    assert below_quota(quota, {"mem": 100, "cpus": 1, "count": 2})
+    assert not below_quota(quota, {"mem": 100, "cpus": 1, "count": 3})
+    assert not below_quota(quota, {"mem": 2000, "cpus": 1, "count": 1})
+
+
+def test_token_bucket():
+    t = [0.0]
+    tb = TokenBucket(tokens_per_sec=1.0, max_tokens=5, initial=2,
+                     clock=lambda: t[0])
+    assert tb.try_spend(2)
+    assert not tb.try_spend(1)
+    t[0] += 3.0
+    assert tb.try_spend(3)
+    tb.spend(10)           # forced spend goes negative
+    assert tb.available() < 0
+    t[0] += 100.0
+    assert tb.available() == 5  # capped at max
+
+
+def test_rate_limiter_per_key():
+    t = [0.0]
+    rl = RateLimiter(tokens_per_sec=1, max_tokens=2, clock=lambda: t[0])
+    assert rl.try_acquire("alice")
+    assert rl.try_acquire("alice")
+    assert not rl.try_acquire("alice")
+    assert rl.try_acquire("bob")       # separate bucket
+    nolimit = RateLimiter(enforce=False)
+    for _ in range(100):
+        assert nolimit.try_acquire("x")
+
+
+def test_pool_registry():
+    pr = PoolRegistry()
+    pr.add(Pool(name="gpu-pool", dru_mode=DruMode.GPU))
+    pr.add(Pool(name="dead", state="inactive"))
+    assert pr.resolve(None) == "default"
+    assert pr.resolve("gpu-pool") == "gpu-pool"
+    assert pr.resolve("nonexistent") == "default"
+    assert not pr.accepts_submissions("dead")
+    assert {p.name for p in pr.active()} == {"default", "gpu-pool"}
