@@ -1,0 +1,86 @@
+"""ctypes binding for the native group-commit event log (eventlog.cpp).
+
+Drop-in replacement for state.store._PyLogWriter with one addition:
+`sync()` — the durability barrier the commit latch uses before
+acknowledging a batch submission (the reference gets this from Datomic's
+transactor ack; here it is an explicit fdatasync watermark wait).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from cook_tpu import native as _native
+
+_lib = None
+_lib_failed = False
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    so = _native.build("eventlog")
+    if so is None:
+        _lib_failed = True
+        return None
+    lib = ctypes.CDLL(so)
+    lib.el_open.argtypes = [ctypes.c_char_p]
+    lib.el_open.restype = ctypes.c_int64
+    lib.el_append.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+    lib.el_append.restype = ctypes.c_int64
+    lib.el_lines.argtypes = [ctypes.c_int64]
+    lib.el_lines.restype = ctypes.c_int64
+    lib.el_sync.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.el_sync.restype = ctypes.c_int
+    lib.el_close.argtypes = [ctypes.c_int64]
+    lib.el_close.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+class NativeLogWriter:
+    """Append-only log backed by the C++ group-commit writer."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise OSError("native eventlog unavailable")
+        self._lib = lib
+        self._h = lib.el_open(path.encode())
+        if self._h == 0:
+            raise OSError(f"el_open failed for {path}")
+
+    def append(self, line: str) -> None:
+        b = line.encode()
+        if self._lib.el_append(self._h, b, len(b)) < 0:
+            raise OSError("el_append failed")
+
+    def lines(self) -> int:
+        return int(self._lib.el_lines(self._h))
+
+    def sync(self, timeout_ms: int = 10_000) -> None:
+        rc = self._lib.el_sync(self._h, timeout_ms)
+        if rc != 0:
+            raise OSError("el_sync timed out — log not durable"
+                          if rc == 1 else "el_sync failed")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.el_close(self._h)
+            self._h = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_log_writer(path: str):
+    """Best writer available: native group-commit, else pure Python."""
+    try:
+        return NativeLogWriter(path)
+    except Exception:
+        from cook_tpu.state.store import _PyLogWriter
+        return _PyLogWriter(path)

@@ -47,7 +47,7 @@ class JobStore:
         self._log_path = log_path
         self._log = log_writer
         if log_path and log_writer is None:
-            self._log = _PyLogWriter(log_path)
+            self._log = _make_log_writer(log_path)
 
     # ------------------------------------------------------------------
     # event log plumbing
@@ -64,6 +64,16 @@ class JobStore:
                 fn(kind, data)
             except Exception:
                 pass
+
+    def _barrier(self) -> None:
+        """Durability barrier, called once at the end of every public
+        transaction: with the native group-commit writer, block until
+        everything appended so far is fdatasync'd (the transactor ack
+        the reference relies on before HTTP 201-ing a submission).  The
+        Python fallback writer is line-buffered and has no sync()."""
+        if self._log is not None and hasattr(self._log, "sync") \
+                and not getattr(self, "_replaying", False):
+            self._log.sync()
 
     def add_listener(self, fn: Callable[[str, dict], None]) -> None:
         """tx-report-queue equivalent: fn(kind, data) after each commit."""
@@ -94,6 +104,7 @@ class JobStore:
                 job.submit_time_ms = job.submit_time_ms or now_ms()
                 self.jobs[job.uuid] = job
                 self._append("job", _job_event(job))
+            self._barrier()
             return [j.uuid for j in jobs]
 
     def commit_jobs(self, uuids: Iterable[str]) -> None:
@@ -104,6 +115,7 @@ class JobStore:
                 if not job.committed:
                     job.committed = True
                     self._append("commit", {"job": u})
+            self._barrier()
 
     def gc_uncommitted(self, older_than_ms: int) -> list[str]:
         """Drop uncommitted jobs older than the cutoff
@@ -115,6 +127,7 @@ class JobStore:
             for u in dead:
                 del self.jobs[u]
                 self._append("gc", {"job": u})
+            self._barrier()
             return dead
 
     def allowed_to_start(self, job_uuid: str) -> bool:
@@ -142,6 +155,7 @@ class JobStore:
             self._update_job_state(job)
             self._append("inst", {"job": job_uuid, "task": inst.task_id,
                                   "host": hostname, "backend": backend})
+            self._barrier()
             return inst
 
     def update_instance(self, task_id: str, status: InstanceStatus,
@@ -180,6 +194,7 @@ class JobStore:
             self._append("status", {"task": task_id, "s": status.value,
                                     "r": reason_code, "p": preempted,
                                     "e": exit_code})
+            self._barrier()
             if job.state == JobState.COMPLETED and was != JobState.COMPLETED:
                 self._emit("job-completed", {"job": job_uuid})
             return job
@@ -202,6 +217,7 @@ class JobStore:
                 inst.progress_message = message
             self._append("progress", {"task": task_id, "q": sequence,
                                       "pc": percent, "m": message})
+            self._barrier()
             return True
 
     def retry_job(self, job_uuid: str, retries: int,
@@ -217,6 +233,7 @@ class JobStore:
                 job.state = JobState.WAITING
                 job.success = None
             self._append("retry", {"job": job_uuid, "n": retries})
+            self._barrier()
 
     def kill_job(self, job_uuid: str) -> list[str]:
         """Mark a job killed: complete it and return active task ids the
@@ -229,6 +246,7 @@ class JobStore:
             job.state = JobState.COMPLETED
             job.success = False
             self._append("kill", {"job": job_uuid})
+            self._barrier()
             self._emit("job-completed", {"job": job_uuid})
             return to_kill
 
@@ -329,7 +347,7 @@ class JobStore:
             store._replay(log_path, offset)
         if log_path:
             store._log_path = log_path
-            store._log = _PyLogWriter(log_path)
+            store._log = _make_log_writer(log_path)
         return store
 
     def _replay(self, log_path: str, offset: int) -> None:
@@ -408,6 +426,16 @@ def _job_from_dict(d: dict) -> Job:
     d["state"] = JobState(d["state"])
     job = Job(**{**d, "instances": insts})
     return job
+
+
+def _make_log_writer(path: str):
+    """Prefer the native C++ group-commit writer (native/eventlog.cpp);
+    fall back to the pure-Python writer if the toolchain is missing."""
+    try:
+        from cook_tpu.native.eventlog import NativeLogWriter
+        return NativeLogWriter(path)
+    except Exception:
+        return _PyLogWriter(path)
 
 
 class _PyLogWriter:
