@@ -1,0 +1,767 @@
+"""The REST API: every user-facing endpoint of the framework.
+
+Equivalent of cook.rest.api (rest/api.clj, 3343 LoC; route table
+:3058-3340).  Framework-free: a small Router dispatches (method, path)
+to handler methods on CookApi; cook_tpu.rest.server mounts it on a
+stdlib ThreadingHTTPServer.  Endpoint parity:
+
+  POST/GET/DELETE /jobs (+ /jobs/:uuid)      submission/query/kill
+  POST/GET/DELETE /rawscheduler              deprecated alias
+  GET /instances/:uuid, DELETE /instances    instance query/kill
+  GET/POST/DELETE /share /quota              fair-share & quota admin
+  GET /usage                                 per-user running usage
+  POST/GET /retry                            retry management
+  GET /group                                 group status
+  GET /failure_reasons /settings /pools /info
+  GET /unscheduled_jobs                      why-pending explainer
+  GET /stats/instances                       runtime percentiles
+  POST /progress/:uuid                       sidecar progress intake
+  GET /queue /running /list                  scheduler introspection
+
+Submission semantics (create-jobs! rest/api.clj:1805): validate every
+job, write the batch uncommitted, then flip the commit latch — the
+store's create_jobs/commit_jobs reproduce make-commit-latch
+(rest/api.clj:659).  Per-user submission rate limiting returns 429
+(rate_limit.clj:28).
+"""
+from __future__ import annotations
+
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from cook_tpu import __version__ as VERSION
+from cook_tpu.rest.auth import (AuthConfig, AuthError, authenticate,
+                                require_authorized)
+from cook_tpu.scheduler import unscheduled
+from cook_tpu.state import task_stats
+from cook_tpu.state.limits import UNLIMITED
+from cook_tpu.state.model import (Group, Instance, InstanceStatus, Job,
+                                  JobState, REASONS,
+                                  REASON_BY_CODE as _REASON_BY_CODE,
+                                  new_uuid, now_ms)
+from cook_tpu.state.store import TransactionError
+
+_UUID_RE = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$", re.I)
+_NAME_RE = re.compile(r"^[\.a-zA-Z0-9_-]{0,128}$")
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message, data: Optional[dict] = None):
+        super().__init__(str(message))
+        self.status = status
+        self.body = {"error": message, **(data or {})}
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict            # str -> list[str]
+    body: Any              # parsed JSON or None
+    headers: dict          # lower-cased keys
+    user: str = ""
+
+    def qp(self, key: str, default=None) -> Optional[str]:
+        vals = self.query.get(key)
+        return vals[0] if vals else default
+
+    def qlist(self, *keys) -> list[str]:
+        out = []
+        for k in keys:
+            out.extend(self.query.get(k, []))
+        return out
+
+
+@dataclass
+class Response:
+    status: int
+    body: Any = None
+    headers: dict = field(default_factory=dict)
+
+
+@dataclass
+class TaskConstraints:
+    """Per-task resource ceilings enforced at submission
+    (config :task-constraints, config.clj:232-247)."""
+
+    max_mem_mb: float = 256 * 1024
+    max_cpus: float = 128
+    max_gpus: float = 8
+    max_retries: int = 1000
+    max_expected_runtime_ms: int = 10 * 24 * 3600 * 1000
+
+
+class Router:
+    def __init__(self):
+        self._routes: list[tuple[str, re.Pattern, Callable]] = []
+
+    def add(self, method: str, pattern: str, handler: Callable) -> None:
+        # pattern like "/jobs/:uuid" — ":name" captures a path segment
+        regex = re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern)
+        self._routes.append((method, re.compile(f"^{regex}$"), handler))
+
+    def dispatch(self, req: Request) -> Response:
+        path_matched = False
+        for method, regex, handler in self._routes:
+            m = regex.match(req.path)
+            if not m:
+                continue
+            path_matched = True
+            if method != req.method:
+                continue
+            return handler(req, **m.groupdict())
+        if path_matched:
+            return Response(405, {"error": "method not allowed"})
+        return Response(404, {"error": f"unknown path {req.path}"})
+
+
+class CookApi:
+    """All endpoint handlers, bound to the live scheduler objects."""
+
+    def __init__(self, store, coordinator=None, shares=None, quotas=None,
+                 pools=None, auth: Optional[AuthConfig] = None,
+                 task_constraints: Optional[TaskConstraints] = None,
+                 submission_rate_limiter=None, settings: Optional[dict] = None,
+                 leader_url: str = ""):
+        self.store = store
+        self.coord = coordinator
+        self.shares = shares if shares is not None else \
+            getattr(coordinator, "shares", None)
+        self.quotas = quotas if quotas is not None else \
+            getattr(coordinator, "quotas", None)
+        self.pools = pools if pools is not None else \
+            getattr(coordinator, "pools", None)
+        self.auth = auth or AuthConfig()
+        self.tc = task_constraints or TaskConstraints()
+        self.submit_rl = submission_rate_limiter
+        self.settings = settings or {}
+        self.leader_url = leader_url
+        self.started_ms = now_ms()
+        self.router = self._build_router()
+
+    # ------------------------------------------------------------------
+    def handle(self, method: str, path: str, query: dict, body: Any,
+               headers: dict) -> Response:
+        req = Request(method=method, path=path, query=query, body=body,
+                      headers=headers)
+        try:
+            if path not in ("/info", "/debug"):  # conditional-auth-bypass
+                req.user = authenticate(self.auth, headers)
+            return self.router.dispatch(req)
+        except AuthError as e:
+            return Response(e.status, {"error": e.message})
+        except ApiError as e:
+            return Response(e.status, e.body)
+        except Exception as e:  # logging-exception-handler equivalent
+            return Response(500, {"error": f"internal error: {e!r}"})
+
+    def _build_router(self) -> Router:
+        r = Router()
+        r.add("POST", "/jobs", self.create_jobs)
+        r.add("GET", "/jobs", self.read_jobs)
+        r.add("DELETE", "/jobs", self.destroy_jobs)
+        r.add("GET", "/jobs/:uuid", self.read_job_single)
+        r.add("POST", "/rawscheduler", self.create_jobs)
+        r.add("GET", "/rawscheduler", self.read_jobs_deprecated)
+        r.add("DELETE", "/rawscheduler", self.destroy_jobs)
+        r.add("GET", "/instances/:uuid", self.read_instance)
+        r.add("DELETE", "/instances", self.kill_instances)
+        r.add("GET", "/share", self.get_share)
+        r.add("POST", "/share", self.set_share)
+        r.add("DELETE", "/share", self.retract_share)
+        r.add("GET", "/quota", self.get_quota)
+        r.add("POST", "/quota", self.set_quota)
+        r.add("DELETE", "/quota", self.retract_quota)
+        r.add("GET", "/usage", self.get_usage)
+        r.add("GET", "/retry", self.get_retry)
+        r.add("POST", "/retry", self.post_retry)
+        r.add("PUT", "/retry", self.post_retry)
+        r.add("GET", "/group", self.read_groups)
+        r.add("GET", "/failure_reasons", self.failure_reasons)
+        r.add("GET", "/settings", self.get_settings)
+        r.add("GET", "/pools", self.get_pools)
+        r.add("GET", "/unscheduled_jobs", self.unscheduled_jobs)
+        r.add("GET", "/stats/instances", self.stats_instances)
+        r.add("POST", "/progress/:uuid", self.post_progress)
+        r.add("GET", "/queue", self.get_queue)
+        r.add("GET", "/running", self.get_running)
+        r.add("GET", "/list", self.list_jobs)
+        r.add("GET", "/info", self.get_info)
+        r.add("GET", "/debug", self.get_debug)
+        return r
+
+    # ------------------------------------------------------------------
+    # submission (create-jobs! rest/api.clj:1805; validation :523+)
+    def create_jobs(self, req: Request) -> Response:
+        body = req.body
+        if not isinstance(body, dict) or not isinstance(
+                body.get("jobs"), list) or not body["jobs"]:
+            raise ApiError(400, "malformed request: body must contain a "
+                                "non-empty 'jobs' list")
+        if self.submit_rl is not None and \
+                not self.submit_rl.try_acquire(req.user, len(body["jobs"])):
+            raise ApiError(429, "User submission rate limit exceeded")
+
+        pool_name = body.get("pool")
+        if self.pools is not None:
+            if pool_name and self.pools.get(pool_name).name != pool_name:
+                raise ApiError(400, f"pool {pool_name} does not exist")
+            if not self.pools.accepts_submissions(pool_name):
+                raise ApiError(400, f"pool {pool_name} is not accepting "
+                                    "job submissions")
+            pool_name = self.pools.resolve(pool_name)
+
+        groups = [self._parse_group(g, req.user)
+                  for g in body.get("groups", [])]
+        group_uuids = {g.uuid for g in groups} | set(self.store.groups)
+        jobs = [self._parse_job(j, req.user, pool_name, group_uuids)
+                for j in body["jobs"]]
+
+        dupes = [j.uuid for j in jobs if j.uuid in self.store.jobs]
+        if dupes:
+            raise ApiError(409, {"message": "The following job UUIDs were "
+                                            "already used", "data": dupes})
+        # commit-latch: write uncommitted, then commit the whole batch
+        try:
+            uuids = self.store.create_jobs(jobs, groups, committed=False)
+            self.store.commit_jobs(uuids)
+        except TransactionError as e:
+            raise ApiError(409, str(e))
+        return Response(201, {"jobs": uuids})
+
+    def _parse_job(self, spec: dict, user: str, pool: Optional[str],
+                   group_uuids: set) -> Job:
+        if not isinstance(spec, dict):
+            raise ApiError(400, "each job must be an object")
+        uuid = str(spec.get("uuid") or new_uuid()).lower()
+        if not _UUID_RE.match(uuid):
+            raise ApiError(400, f"invalid job uuid {uuid!r}")
+        command = spec.get("command")
+        if not command or not isinstance(command, str):
+            raise ApiError(400, f"job {uuid}: 'command' is required")
+        try:
+            mem = float(spec.get("mem", 0))
+            cpus = float(spec.get("cpus", 0))
+            gpus = float(spec.get("gpus", 0))
+        except (TypeError, ValueError):
+            raise ApiError(400, f"job {uuid}: mem/cpus/gpus must be numbers")
+        if mem <= 0 or cpus <= 0:
+            raise ApiError(400, f"job {uuid}: mem and cpus must be positive")
+        if mem > self.tc.max_mem_mb:
+            raise ApiError(400, f"job {uuid}: mem {mem} exceeds max "
+                                f"{self.tc.max_mem_mb} MB")
+        if cpus > self.tc.max_cpus:
+            raise ApiError(400, f"job {uuid}: cpus {cpus} exceeds max "
+                                f"{self.tc.max_cpus}")
+        if gpus < 0 or gpus > self.tc.max_gpus or gpus != int(gpus):
+            raise ApiError(400, f"job {uuid}: gpus must be a non-negative "
+                                f"integer <= {self.tc.max_gpus}")
+        name = spec.get("name", "cookjob")
+        if not _NAME_RE.match(name):
+            raise ApiError(400, f"job {uuid}: invalid name {name!r}")
+        priority = int(spec.get("priority", 50))
+        if not 0 <= priority <= 100:
+            raise ApiError(400, f"job {uuid}: priority must be in [0, 100]")
+        max_retries = int(spec.get("max_retries", spec.get("max-retries", 1)))
+        if not 1 <= max_retries <= self.tc.max_retries:
+            raise ApiError(400, f"job {uuid}: max_retries must be in "
+                                f"[1, {self.tc.max_retries}]")
+        group = spec.get("group")
+        if group is not None:
+            group = str(group).lower()
+            if group not in group_uuids:
+                raise ApiError(400, f"job {uuid}: group {group} is not "
+                                    "defined in this request or the system")
+        constraints = []
+        for c in spec.get("constraints", []):
+            if not (isinstance(c, (list, tuple)) and len(c) == 3):
+                raise ApiError(400, f"job {uuid}: constraints must be "
+                                    "[attribute, operator, pattern] triples")
+            attr, op, pat = c
+            if str(op).upper() != "EQUALS":
+                raise ApiError(400, f"job {uuid}: only EQUALS constraints "
+                                    "are supported")
+            constraints.append((str(attr), "EQUALS", str(pat)))
+        env = {str(k): str(v) for k, v in (spec.get("env") or {}).items()}
+        labels = {str(k): str(v)
+                  for k, v in (spec.get("labels") or {}).items()}
+        max_runtime = int(spec.get("max_runtime", spec.get("max-runtime",
+                                                           2 ** 53)))
+        return Job(
+            uuid=uuid, user=user, command=command, mem=mem, cpus=cpus,
+            gpus=gpus, name=name, priority=priority, max_retries=max_retries,
+            max_runtime_ms=max_runtime,
+            expected_runtime_ms=spec.get("expected_runtime"),
+            pool=pool or "default", group=group, env=env, labels=labels,
+            constraints=constraints, uris=spec.get("uris", []),
+            container=spec.get("container"),
+            application=spec.get("application"),
+            progress_output_file=spec.get("progress_output_file", ""),
+            progress_regex_string=spec.get("progress_regex_string", ""),
+            checkpoint=spec.get("checkpoint"),
+            disable_mea_culpa_retries=bool(
+                spec.get("disable_mea_culpa_retries", False)),
+            datasets=spec.get("datasets", []),
+        )
+
+    def _parse_group(self, spec: dict, user: str) -> Group:
+        uuid = str(spec.get("uuid") or new_uuid()).lower()
+        if not _UUID_RE.match(uuid):
+            raise ApiError(400, f"invalid group uuid {uuid!r}")
+        name = spec.get("name", "defaultgroup")
+        if not _NAME_RE.match(name):
+            raise ApiError(400, f"group {uuid}: invalid name {name!r}")
+        hp = spec.get("host_placement", spec.get("host-placement",
+                                                 {"type": "all"}))
+        if hp.get("type") not in ("all", "balanced", "unique",
+                                  "attribute-equals"):
+            raise ApiError(400, f"group {uuid}: unknown host-placement type")
+        sh = spec.get("straggler_handling", spec.get("straggler-handling",
+                                                     {"type": "none"}))
+        if sh.get("type") not in ("none", "quantile-deviation"):
+            raise ApiError(400, f"group {uuid}: unknown straggler-handling "
+                                "type")
+        return Group(uuid=uuid, name=name, user=user, host_placement=hp,
+                     straggler_handling=sh)
+
+    # ------------------------------------------------------------------
+    # queries
+    def _authorized_job(self, req: Request, uuid: str, verb="read") -> Job:
+        job = self.store.get_job(uuid.lower())
+        if job is None:
+            raise ApiError(404, f"unknown job {uuid}")
+        require_authorized(self.auth, req.user, verb, job.user)
+        return job
+
+    def read_jobs(self, req: Request) -> Response:
+        uuids = req.qlist("uuid", "job")
+        if uuids:
+            jobs = [self._authorized_job(req, u) for u in uuids]
+        else:
+            user = req.qp("user", req.user)
+            require_authorized(self.auth, req.user, "read", user)
+            states = set((req.qp("state") or
+                          "waiting+running+completed").split("+"))
+            start = int(req.qp("start", 0) or 0)
+            end = int(req.qp("end", 2 ** 62) or 2 ** 62)
+            name_pat = req.qp("name")
+            pool = req.qp("pool")
+            jobs = [j for j in self.store.jobs.values()
+                    if j.user == user and _job_status(j) in states
+                    and start <= j.submit_time_ms < end
+                    and (pool is None or j.pool == pool)
+                    and (name_pat is None or
+                         re.fullmatch(name_pat.replace("*", ".*"), j.name))]
+        return Response(200, [job_response(j, self.store) for j in jobs])
+
+    def read_jobs_deprecated(self, req: Request) -> Response:
+        return self.read_jobs(req)
+
+    def read_job_single(self, req: Request, uuid: str) -> Response:
+        if not _UUID_RE.match(uuid):
+            raise ApiError(400, f"invalid uuid {uuid!r}")
+        return Response(200, job_response(
+            self._authorized_job(req, uuid), self.store))
+
+    def destroy_jobs(self, req: Request) -> Response:
+        uuids = req.qlist("uuid", "job")
+        if not uuids:
+            raise ApiError(400, "no job uuids supplied")
+        jobs = [self._authorized_job(req, u, verb="kill") for u in uuids]
+        for job in jobs:
+            to_kill = self.store.kill_job(job.uuid)
+            for tid in to_kill:
+                self.store.update_instance(tid, InstanceStatus.FAILED,
+                                           reason_code=1004)
+                if self.coord is not None:
+                    self.coord._backend_kill(tid)
+        return Response(204)
+
+    def read_instance(self, req: Request, uuid: str) -> Response:
+        inst = self.store.get_instance(uuid)
+        if inst is None:
+            raise ApiError(404, f"unknown instance {uuid}")
+        job = self.store.get_job(inst.job_uuid)
+        require_authorized(self.auth, req.user, "read", job.user)
+        return Response(200, instance_response(inst, job))
+
+    def kill_instances(self, req: Request) -> Response:
+        task_ids = req.qlist("uuid", "instance")
+        if not task_ids:
+            raise ApiError(400, "no instance uuids supplied")
+        for tid in task_ids:
+            inst = self.store.get_instance(tid)
+            if inst is None:
+                raise ApiError(404, f"unknown instance {tid}")
+            job = self.store.get_job(inst.job_uuid)
+            require_authorized(self.auth, req.user, "kill", job.user)
+            self.store.update_instance(tid, InstanceStatus.FAILED,
+                                       reason_code=1004)
+            if self.coord is not None:
+                self.coord._backend_kill(tid)
+        return Response(204)
+
+    # ------------------------------------------------------------------
+    # share / quota (share.clj, quota.clj endpoint semantics)
+    def _limit_params(self, req: Request, write: bool):
+        user = req.qp("user") or (req.body or {}).get("user")
+        if not user:
+            raise ApiError(400, "user parameter is required")
+        pool = req.qp("pool") or (req.body or {}).get("pool") or \
+            (self.pools.default_pool if self.pools else "default")
+        if write:
+            require_authorized(self.auth, req.user, "update", None)
+        return user, pool
+
+    def get_share(self, req: Request) -> Response:
+        user, pool = self._limit_params(req, write=False)
+        return Response(200, _jsonable_limits(self.shares.get(user, pool)))
+
+    def set_share(self, req: Request) -> Response:
+        user, pool = self._limit_params(req, write=True)
+        vals = (req.body or {}).get("share", {})
+        if not vals:
+            raise ApiError(400, "body must contain a 'share' object")
+        try:
+            self.shares.set(user, pool, **{k: float(v)
+                                           for k, v in vals.items()})
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return Response(201, _jsonable_limits(self.shares.get(user, pool)))
+
+    def retract_share(self, req: Request) -> Response:
+        user, pool = self._limit_params(req, write=True)
+        self.shares.retract(user, pool)
+        return Response(204)
+
+    def get_quota(self, req: Request) -> Response:
+        user, pool = self._limit_params(req, write=False)
+        return Response(200, _jsonable_limits(self.quotas.get(user, pool)))
+
+    def set_quota(self, req: Request) -> Response:
+        user, pool = self._limit_params(req, write=True)
+        vals = (req.body or {}).get("quota", {})
+        if not vals:
+            raise ApiError(400, "body must contain a 'quota' object")
+        try:
+            self.quotas.set(user, pool, **{k: float(v)
+                                           for k, v in vals.items()})
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return Response(201, _jsonable_limits(self.quotas.get(user, pool)))
+
+    def retract_quota(self, req: Request) -> Response:
+        user, pool = self._limit_params(req, write=True)
+        self.quotas.retract(user, pool)
+        return Response(204)
+
+    def get_usage(self, req: Request) -> Response:
+        """Per-user running usage, grouped by pool (+ per-group breakdown
+        like rest/api.clj:2648)."""
+        user = req.qp("user", req.user)
+        require_authorized(self.auth, req.user, "read", user)
+        pools = [p.name for p in self.pools.all()] if self.pools else \
+            ["default"]
+        by_pool = {}
+        for pool in pools:
+            u = self.store.user_usage(pool).get(
+                user, {"mem": 0.0, "cpus": 0.0, "gpus": 0.0, "jobs": 0})
+            by_pool[pool] = {"total_usage": u}
+        total = {"mem": sum(p["total_usage"]["mem"] for p in by_pool.values()),
+                 "cpus": sum(p["total_usage"]["cpus"]
+                             for p in by_pool.values()),
+                 "gpus": sum(p["total_usage"]["gpus"]
+                             for p in by_pool.values()),
+                 "jobs": sum(p["total_usage"]["jobs"]
+                             for p in by_pool.values())}
+        return Response(200, {"total_usage": total, "pools": by_pool})
+
+    # ------------------------------------------------------------------
+    def get_retry(self, req: Request) -> Response:
+        uuid = req.qp("job")
+        if not uuid:
+            raise ApiError(400, "job parameter is required")
+        job = self._authorized_job(req, uuid)
+        return Response(200, job.max_retries)
+
+    def post_retry(self, req: Request) -> Response:
+        body = req.body or {}
+        uuids = req.qlist("job", "jobs") or \
+            ([body["job"]] if "job" in body else body.get("jobs", []))
+        retries = body.get("retries")
+        increment = body.get("increment")
+        if retries is None and increment is None:
+            raise ApiError(400, "retries or increment is required")
+        if not uuids:
+            raise ApiError(400, "job uuid(s) required")
+        out = []
+        for u in uuids:
+            job = self._authorized_job(req, u, verb="retry")
+            n = int(retries) if retries is not None else \
+                job.max_retries + int(increment)
+            if not 1 <= n <= self.tc.max_retries:
+                raise ApiError(400, f"retries must be in "
+                                    f"[1, {self.tc.max_retries}]")
+            self.store.retry_job(job.uuid, n, failed_only=True)
+            out.append(job.uuid)
+        return Response(201, out)
+
+    def read_groups(self, req: Request) -> Response:
+        uuids = req.qlist("uuid")
+        if not uuids:
+            raise ApiError(400, "uuid parameter is required")
+        detailed = (req.qp("detailed", "false") or "").lower() == "true"
+        out = []
+        for u in uuids:
+            group = self.store.groups.get(u.lower())
+            if group is None:
+                raise ApiError(404, f"unknown group {u}")
+            require_authorized(self.auth, req.user, "read", group.user)
+            jobs = [self.store.jobs[j] for j in group.jobs
+                    if j in self.store.jobs]
+            resp = {
+                "uuid": group.uuid, "name": group.name,
+                "host_placement": group.host_placement,
+                "straggler_handling": group.straggler_handling,
+                "waiting": [j.uuid for j in jobs
+                            if j.state == JobState.WAITING],
+                "running": [j.uuid for j in jobs
+                            if j.state == JobState.RUNNING],
+                "completed": [j.uuid for j in jobs
+                              if j.state == JobState.COMPLETED],
+            }
+            if detailed:
+                resp["jobs"] = [job_response(j, self.store) for j in jobs]
+            out.append(resp)
+        return Response(200, out)
+
+    # ------------------------------------------------------------------
+    def failure_reasons(self, req: Request) -> Response:
+        return Response(200, [{"code": r.code, "name": r.name,
+                               "description": r.string,
+                               "mea_culpa": r.mea_culpa,
+                               "failure_limit": r.failure_limit}
+                              for r in REASONS])
+
+    def get_settings(self, req: Request) -> Response:
+        require_authorized(self.auth, req.user, "read", None)
+        return Response(200, self.settings)
+
+    def get_pools(self, req: Request) -> Response:
+        if self.pools is None:
+            return Response(200, [])
+        return Response(200, [{"name": p.name, "purpose": p.purpose,
+                               "state": p.state,
+                               "dru-mode": p.dru_mode.value}
+                              for p in self.pools.all()])
+
+    def unscheduled_jobs(self, req: Request) -> Response:
+        uuids = req.qlist("job", "uuid")
+        if not uuids:
+            raise ApiError(400, "job parameter is required")
+        out = []
+        for u in uuids:
+            job = self._authorized_job(req, u)
+            qpos = self._queue_position(job)
+            rl = getattr(self.coord, "user_launch_rl", None)
+            out.append({
+                "uuid": job.uuid,
+                "reasons": [{"reason": r, "data": d} for r, d in
+                            unscheduled.reasons(self.store, job, self.quotas,
+                                                self.shares,
+                                                user_launch_rl=rl,
+                                                queue_position=qpos)],
+            })
+        return Response(200, out)
+
+    def _queue_position(self, job: Job) -> int:
+        ahead = 0
+        for other in self.store.pending_jobs(job.pool):
+            if other.user != job.user or other.uuid == job.uuid:
+                continue
+            if (-other.priority, other.submit_time_ms) < \
+                    (-job.priority, job.submit_time_ms):
+                ahead += 1
+        return ahead
+
+    def stats_instances(self, req: Request) -> Response:
+        require_authorized(self.auth, req.user, "read", None)
+        status = req.qp("status")
+        start = req.qp("start")
+        end = req.qp("end")
+        if not (status and start and end):
+            raise ApiError(400, "status, start and end are required")
+        if status not in ("success", "failed"):
+            raise ApiError(400, "status must be success or failed")
+        return Response(200, task_stats.get_stats(
+            self.store, status, _parse_time(start), _parse_time(end),
+            name_filter=req.qp("name")))
+
+    def post_progress(self, req: Request, uuid: str) -> Response:
+        """Sidecar progress intake (rest/api.clj:3298-3315)."""
+        body = req.body or {}
+        seq = body.get("progress_sequence", body.get("progress-sequence"))
+        percent = body.get("progress_percent", body.get("progress-percent"))
+        message = body.get("progress_message", body.get("progress-message"))
+        if seq is None or (percent is None and message is None):
+            raise ApiError(400, "progress_sequence and one of "
+                                "progress_percent/progress_message required")
+        inst = self.store.get_instance(uuid)
+        if inst is None:
+            raise ApiError(404, f"unknown instance {uuid}")
+        accepted = self.store.update_progress(
+            uuid, int(seq), int(percent if percent is not None
+                                else inst.progress), message or "")
+        return Response(202, {"accepted": accepted,
+                              "instance": uuid})
+
+    # ------------------------------------------------------------------
+    def get_queue(self, req: Request) -> Response:
+        require_authorized(self.auth, req.user, "read", None)
+        limit = int(req.qp("limit", 100) or 100)
+        out = {}
+        pools = [p.name for p in self.pools.all()] if self.pools else \
+            ["default"]
+        for pool in pools:
+            pending = sorted(self.store.pending_jobs(pool),
+                             key=lambda j: (-j.priority, j.submit_time_ms))
+            out[pool] = [job_response(j, self.store)
+                         for j in pending[:limit]]
+        return Response(200, out)
+
+    def get_running(self, req: Request) -> Response:
+        require_authorized(self.auth, req.user, "read", None)
+        out = []
+        for job in self.store.running_jobs():
+            for inst in job.active_instances:
+                out.append(instance_response(inst, job))
+        return Response(200, out)
+
+    def list_jobs(self, req: Request) -> Response:
+        user = req.qp("user")
+        if not user:
+            raise ApiError(400, "user parameter is required")
+        require_authorized(self.auth, req.user, "read", user)
+        states = set((req.qp("state") or "").split("+")) - {""}
+        if not states:
+            raise ApiError(400, "state parameter is required")
+        if "success" in states or "failed" in states:
+            states.add("completed")
+        start = int(req.qp("start-ms", req.qp("start_ms", 0)) or 0)
+        end = int(req.qp("end-ms", req.qp("end_ms", 2 ** 62)) or 2 ** 62)
+        limit = int(req.qp("limit", 150) or 150)
+        name_pat = req.qp("name")
+        jobs = []
+        for j in self.store.jobs.values():
+            if j.user != user or not j.committed:
+                continue
+            status = _job_status(j)
+            fine = _job_state(j)
+            if status not in states and fine not in states:
+                continue
+            if not (start <= j.submit_time_ms < end):
+                continue
+            if name_pat and not re.fullmatch(
+                    name_pat.replace("*", ".*"), j.name):
+                continue
+            jobs.append(j)
+        jobs.sort(key=lambda j: -j.submit_time_ms)
+        return Response(200, [job_response(j, self.store)
+                              for j in jobs[:limit]])
+
+    def get_info(self, req: Request) -> Response:
+        return Response(200, {
+            "authentication-scheme": self.auth.scheme,
+            "commit": VERSION,
+            "version": VERSION,
+            "start-time": self.started_ms,
+            "leader-url": self.leader_url,
+        })
+
+    def get_debug(self, req: Request) -> Response:
+        return Response(200, {"healthy": True, "version": VERSION})
+
+
+# ----------------------------------------------------------------------
+# response shaping (the JobResponse/InstanceResponse schemas)
+def _job_status(job: Job) -> str:
+    return job.state.value
+
+
+def _job_state(job: Job) -> str:
+    """Fine-grained state: waiting|running|success|failed."""
+    if job.state == JobState.COMPLETED:
+        return "success" if job.success else "failed"
+    return job.state.value
+
+
+def job_response(job: Job, store) -> dict:
+    return {
+        "uuid": job.uuid,
+        "name": job.name,
+        "command": job.command,
+        "user": job.user,
+        "status": _job_status(job),
+        "state": _job_state(job),
+        "priority": job.priority,
+        "mem": job.mem,
+        "cpus": job.cpus,
+        "gpus": job.gpus,
+        "max_retries": job.max_retries,
+        "max_runtime": job.max_runtime_ms,
+        "retries_remaining": job.retries_remaining(),
+        "submit_time": job.submit_time_ms,
+        "pool": job.pool,
+        "env": job.env,
+        "labels": job.labels,
+        "constraints": [list(c) for c in job.constraints],
+        "uris": job.uris,
+        "container": job.container,
+        "application": job.application,
+        "groups": [job.group] if job.group else [],
+        "instances": [instance_response(i, job) for i in job.instances],
+    }
+
+
+def instance_response(inst: Instance, job: Job) -> dict:
+    reason = _REASON_BY_CODE.get(inst.reason_code or -1)
+    out = {
+        "task_id": inst.task_id,
+        "job_uuid": inst.job_uuid,
+        "status": inst.status.value,
+        "hostname": inst.hostname,
+        "backend": inst.backend,
+        "start_time": inst.start_time_ms,
+        "end_time": inst.end_time_ms,
+        "progress": inst.progress,
+        "progress_message": inst.progress_message,
+        "exit_code": inst.exit_code,
+        "sandbox_directory": inst.sandbox_directory,
+        "preempted": inst.preempted,
+        "ports": inst.ports,
+    }
+    if reason is not None:
+        out["reason_code"] = reason.code
+        out["reason_string"] = reason.string
+        out["reason_mea_culpa"] = reason.mea_culpa
+    return out
+
+
+def _jsonable_limits(d: dict) -> dict:
+    return {k: ("unlimited" if v == UNLIMITED else v) for k, v in d.items()}
+
+
+def _parse_time(s: str) -> int:
+    """Epoch-millis or ISO date."""
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return int(time.mktime(time.strptime(s, "%Y-%m-%d")) * 1000)
+    except ValueError:
+        raise ApiError(400, f"unparseable time {s!r}")

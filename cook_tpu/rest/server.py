@@ -1,0 +1,203 @@
+"""HTTP server mounting the CookApi on a stdlib ThreadingHTTPServer.
+
+The reference embeds Jetty with a middleware stack
+(components.clj:239-275); here a threaded stdlib server carries the same
+surface: JSON in/out, CORS preflight, NCSA-style access log.
+
+Run a full single-process scheduler (REST + coordinator + mock backend):
+
+    python -m cook_tpu.rest.server --port 12321 [--config cfg.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from cook_tpu.rest.api import CookApi, Response
+from cook_tpu.rest.auth import cors_headers
+
+log = logging.getLogger("cook_tpu.rest.access")
+
+
+def make_handler(api: CookApi):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _dispatch(self, method: str) -> None:
+            t0 = time.perf_counter()
+            parts = urlsplit(self.path)
+            query = parse_qs(parts.query)
+            body = None
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                raw = self.rfile.read(length)
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    self._reply(Response(400, {"error": "malformed JSON"}))
+                    return
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            if method == "OPTIONS":
+                resp = Response(200, None,
+                                cors_headers(api.auth,
+                                             headers.get("origin")))
+            else:
+                resp = api.handle(method, parts.path, query, body, headers)
+                resp.headers.update(
+                    cors_headers(api.auth, headers.get("origin")))
+            self._reply(resp)
+            # NCSA-ish access log (components.clj:188-201)
+            log.info('%s "%s %s" %d %.1fms', self.client_address[0],
+                     method, self.path, resp.status,
+                     (time.perf_counter() - t0) * 1e3)
+
+        def _reply(self, resp: Response) -> None:
+            payload = b""
+            if resp.body is not None:
+                payload = json.dumps(resp.body).encode()
+            self.send_response(resp.status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in resp.headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if payload:
+                self.wfile.write(payload)
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+        def do_PUT(self):
+            self._dispatch("PUT")
+
+        def do_DELETE(self):
+            self._dispatch("DELETE")
+
+        def do_OPTIONS(self):
+            self._dispatch("OPTIONS")
+
+        def log_message(self, *args):  # silenced; we log above
+            pass
+
+    return Handler
+
+
+class ApiServer:
+    """Embedded server (run-test-server-in-thread, testutil.clj:126)."""
+
+    def __init__(self, api: CookApi, port: int = 0, host: str = "127.0.0.1"):
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(api))
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "ApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def build_scheduler(config: dict):
+    """Assemble a full single-process scheduler from a config dict (the
+    components.clj scheduler-server graph equivalent)."""
+    from cook_tpu.backends.base import ClusterRegistry
+    from cook_tpu.backends.mock import MockCluster, MockHost
+    from cook_tpu.scheduler.coordinator import Coordinator, SchedulerConfig
+    from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
+    from cook_tpu.state.pools import Pool, PoolRegistry
+    from cook_tpu.state.store import JobStore
+
+    store = JobStore.restore(config.get("snapshot_path"),
+                             log_path=config.get("log_path"))
+    pools = PoolRegistry(config.get("default_pool", "default"))
+    for p in config.get("pools", []):
+        pools.add(Pool(name=p["name"], purpose=p.get("purpose", "")))
+    clusters = ClusterRegistry()
+    for c in config.get("clusters", [{"kind": "mock", "name": "mock",
+                                      "hosts": 4}]):
+        if c.get("kind", "mock") == "mock":
+            name = c.get("name", "mock")
+            hosts = [MockHost(hostname=f"{name}-host-{i}",
+                              mem=float(c.get("host_mem", 32_768)),
+                              cpus=float(c.get("host_cpus", 16)),
+                              gpus=float(c.get("host_gpus", 0)),
+                              pool=c.get("pool", pools.default_pool))
+                     for i in range(int(c.get("hosts", 4)))]
+            clusters.register(MockCluster(hosts, name=name))
+        else:
+            raise ValueError(f"unknown cluster kind {c.get('kind')}")
+    rl_cfg = config.get("rate_limits", {})
+    coord = Coordinator(
+        store, clusters,
+        shares=ShareStore(), quotas=QuotaStore(), pools=pools,
+        config=SchedulerConfig(**config.get("scheduler", {})),
+        launch_rate_limiter=RateLimiter(
+            **rl_cfg.get("global_launch", {"enforce": False})),
+        user_launch_rate_limiter=RateLimiter(
+            **rl_cfg.get("user_launch", {"enforce": False})))
+    submit_rl = RateLimiter(**rl_cfg.get("user_submit", {"enforce": False}))
+    api = CookApi(store, coordinator=coord,
+                  submission_rate_limiter=submit_rl,
+                  settings=_public_settings(config))
+    return store, coord, api
+
+
+def _public_settings(config: dict) -> dict:
+    """Sanitized config for GET /settings."""
+    return {k: v for k, v in config.items()
+            if k not in ("auth", "secrets")}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="cook_tpu scheduler")
+    parser.add_argument("--port", type=int, default=12321)
+    parser.add_argument("--config", default=None,
+                        help="JSON config file (pools, clusters, limits)")
+    parser.add_argument("--no-cycles", action="store_true",
+                        help="API only; don't start scheduling loops")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = {}
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+    store, coord, api = build_scheduler(config)
+    if not args.no_cycles:
+        for cluster in coord.clusters.all():
+            cluster.initialize()
+        coord.run()
+        # drive any mock clusters' virtual clocks in real time
+        def tick():
+            while True:
+                time.sleep(1.0)
+                for cluster in coord.clusters.all():
+                    if hasattr(cluster, "advance"):
+                        cluster.advance(1.0)
+        threading.Thread(target=tick, daemon=True).start()
+    server = ApiServer(api, port=args.port).start()
+    log.info("cook_tpu scheduler listening on %s", server.url)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+        coord.stop()
+
+
+if __name__ == "__main__":
+    main()

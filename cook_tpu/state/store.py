@@ -94,8 +94,16 @@ class JobStore:
                     existing.jobs.extend(j.uuid for j in jobs
                                          if j.group == g.uuid)
                 else:
+                    g.jobs.extend(j.uuid for j in jobs
+                                  if j.group == g.uuid)
                     self.groups[g.uuid] = g
                     self._append("group", {"group": asdict(g)})
+            # jobs referencing an existing group not named in this batch
+            batch_groups = {g.uuid for g in groups}
+            for job in jobs:
+                if job.group and job.group not in batch_groups \
+                        and job.group in self.groups:
+                    self.groups[job.group].jobs.append(job.uuid)
             for job in jobs:
                 if job.uuid in self.jobs:
                     raise TransactionError(f"duplicate job uuid {job.uuid}")

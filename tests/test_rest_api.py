@@ -1,0 +1,363 @@
+"""REST API tests: submission/validation, queries, kill, retry, limits,
+progress, unscheduled reasons, stats, auth/impersonation — driven both
+through CookApi.handle directly and over real HTTP via ApiServer.
+
+Mirrors the reference's rest/api.clj test coverage (41 deftests) plus
+the integration-test flows in integration/tests/cook/test_basic.py.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.rest.api import CookApi, TaskConstraints
+from cook_tpu.rest.auth import AuthConfig
+from cook_tpu.rest.server import ApiServer
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.state.limits import RateLimiter
+from cook_tpu.state.model import InstanceStatus, Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+
+
+@pytest.fixture
+def stack():
+    store = JobStore()
+    cluster = MockCluster([MockHost("h0", mem=1000, cpus=16),
+                           MockHost("h1", mem=1000, cpus=16)])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header", admins={"admin"},
+                                  imposters={"svc"}))
+    return store, cluster, coord, api
+
+
+def call(api, method, path, user="alice", body=None, query=None,
+         headers=None):
+    q = {k: v if isinstance(v, list) else [v]
+         for k, v in (query or {}).items()}
+    h = {"x-cook-user": user, **(headers or {})}
+    return api.handle(method, path, q, body, h)
+
+
+def submit(api, user="alice", n=1, **job_kw):
+    jobs = [{"uuid": new_uuid(), "command": "sleep 1", "mem": 100,
+             "cpus": 1, **job_kw} for _ in range(n)]
+    resp = call(api, "POST", "/jobs", user=user, body={"jobs": jobs})
+    assert resp.status == 201, resp.body
+    return resp.body["jobs"]
+
+
+# ---------------------------------------------------------------------------
+def test_submit_and_get(stack):
+    store, _, _, api = stack
+    (uuid,) = submit(api, name="myjob", env={"A": "1"}, labels={"l": "v"},
+                     priority=75)
+    resp = call(api, "GET", f"/jobs/{uuid}")
+    assert resp.status == 200
+    body = resp.body
+    assert body["name"] == "myjob" and body["status"] == "waiting"
+    assert body["env"] == {"A": "1"} and body["priority"] == 75
+    assert body["user"] == "alice" and body["retries_remaining"] == 1
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ({"command": ""}, "command"),
+    ({"mem": -1}, "positive"),
+    ({"cpus": 0}, "positive"),
+    ({"mem": 10 ** 9}, "exceeds max"),
+    ({"cpus": 10 ** 4}, "exceeds max"),
+    ({"gpus": 0.5}, "integer"),
+    ({"priority": 101}, "priority"),
+    ({"max_retries": 0}, "max_retries"),
+    ({"name": "bad name!"}, "name"),
+    ({"uuid": "not-a-uuid"}, "uuid"),
+    ({"constraints": [["a", "LIKE", "b"]]}, "EQUALS"),
+    ({"group": new_uuid()}, "group"),
+])
+def test_submit_validation(stack, bad, msg):
+    _, _, _, api = stack
+    job = {"uuid": new_uuid(), "command": "true", "mem": 100, "cpus": 1}
+    job.update(bad)
+    resp = call(api, "POST", "/jobs", body={"jobs": [job]})
+    assert resp.status == 400
+    assert msg in str(resp.body)
+
+
+def test_submit_atomicity_on_invalid_batch(stack):
+    """One bad job rejects the whole batch (commit-latch semantics)."""
+    store, _, _, api = stack
+    good = {"uuid": new_uuid(), "command": "true", "mem": 100, "cpus": 1}
+    bad = {"uuid": new_uuid(), "command": "", "mem": 100, "cpus": 1}
+    resp = call(api, "POST", "/jobs", body={"jobs": [good, bad]})
+    assert resp.status == 400
+    assert store.get_job(good["uuid"]) is None
+
+
+def test_duplicate_uuid_409(stack):
+    _, _, _, api = stack
+    (uuid,) = submit(api)
+    job = {"uuid": uuid, "command": "true", "mem": 100, "cpus": 1}
+    resp = call(api, "POST", "/jobs", body={"jobs": [job]})
+    assert resp.status == 409
+
+
+def test_query_by_user_state_and_time(stack):
+    store, _, coord, api = stack
+    u1 = submit(api, n=2)
+    submit(api, user="bob")
+    coord.match_cycle()
+    resp = call(api, "GET", "/jobs", query={"user": "alice",
+                                            "state": "running"})
+    assert resp.status == 200
+    assert {j["uuid"] for j in resp.body} == set(u1)
+    resp = call(api, "GET", "/jobs", query={"user": "alice",
+                                            "state": "waiting"})
+    assert resp.body == []
+
+
+def test_kill_job(stack):
+    store, cluster, coord, api = stack
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    resp = call(api, "DELETE", "/jobs", query={"uuid": uuid})
+    assert resp.status == 204
+    job = store.get_job(uuid)
+    assert job.state == JobState.COMPLETED and job.success is False
+    assert cluster.known_task_ids() == set()
+
+
+def test_user_cannot_kill_others_job(stack):
+    _, _, _, api = stack
+    (uuid,) = submit(api, user="bob")
+    resp = call(api, "DELETE", "/jobs", user="alice", query={"uuid": uuid})
+    assert resp.status == 403
+
+
+def test_admin_can_read_any_job(stack):
+    _, _, _, api = stack
+    (uuid,) = submit(api, user="bob")
+    resp = call(api, "GET", f"/jobs/{uuid}", user="admin")
+    assert resp.status == 200
+
+
+def test_impersonation(stack):
+    _, _, _, api = stack
+    (uuid,) = submit(api, user="bob")
+    # svc may impersonate bob and read bob's job
+    resp = call(api, "GET", f"/jobs/{uuid}", user="svc",
+                headers={"x-cook-impersonate": "bob"})
+    assert resp.status == 200
+    # alice may not impersonate
+    resp = call(api, "GET", f"/jobs/{uuid}", user="alice",
+                headers={"x-cook-impersonate": "bob"})
+    assert resp.status == 403
+
+
+def test_retry_endpoint(stack):
+    store, cluster, coord, api = stack
+    fates = iter([(5.0, False, 1003)])
+    cluster.runtime_fn = lambda spec: next(fates)
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    cluster.advance(6)
+    job = store.get_job(uuid)
+    assert job.state == JobState.COMPLETED and job.success is False
+    assert call(api, "GET", "/retry", query={"job": uuid}).body == 1
+    resp = call(api, "POST", "/retry", body={"job": uuid, "retries": 3})
+    assert resp.status == 201
+    assert job.state == JobState.WAITING and job.max_retries == 3
+
+
+def test_share_quota_endpoints(stack):
+    _, _, coord, api = stack
+    # non-admin cannot set
+    resp = call(api, "POST", "/share",
+                body={"user": "alice", "share": {"mem": 100}})
+    assert resp.status == 403
+    resp = call(api, "POST", "/share", user="admin",
+                body={"user": "alice", "share": {"mem": 100, "cpus": 10}})
+    assert resp.status == 201
+    got = call(api, "GET", "/share", query={"user": "alice"})
+    assert got.body["mem"] == 100 and got.body["gpus"] == "unlimited"
+    resp = call(api, "POST", "/quota", user="admin",
+                body={"user": "alice", "quota": {"count": 5}})
+    assert resp.status == 201
+    assert call(api, "GET", "/quota",
+                query={"user": "alice"}).body["count"] == 5
+    assert call(api, "DELETE", "/share", user="admin",
+                query={"user": "alice"}).status == 204
+    assert call(api, "GET", "/share",
+                query={"user": "alice"}).body["mem"] == "unlimited"
+
+
+def test_usage_endpoint(stack):
+    _, _, coord, api = stack
+    submit(api, n=3, mem=200, cpus=2)
+    coord.match_cycle()
+    resp = call(api, "GET", "/usage")
+    assert resp.status == 200
+    assert resp.body["total_usage"]["jobs"] == 3
+    assert resp.body["total_usage"]["mem"] == 600
+
+
+def test_submission_rate_limit_429():
+    store = JobStore()
+    api = CookApi(store, auth=AuthConfig(scheme="header"),
+                  submission_rate_limiter=RateLimiter(
+                      tokens_per_sec=0.001, max_tokens=2))
+    assert call(api, "POST", "/jobs", body={"jobs": [
+        {"command": "true", "mem": 1, "cpus": 1}]}).status == 201
+    assert call(api, "POST", "/jobs", body={"jobs": [
+        {"command": "true", "mem": 1, "cpus": 1}]}).status == 201
+    assert call(api, "POST", "/jobs", body={"jobs": [
+        {"command": "true", "mem": 1, "cpus": 1}]}).status == 429
+
+
+def test_group_endpoint(stack):
+    store, _, coord, api = stack
+    guuid = new_uuid()
+    jobs = [{"uuid": new_uuid(), "command": "true", "mem": 10, "cpus": 1,
+             "group": guuid} for _ in range(3)]
+    resp = call(api, "POST", "/jobs",
+                body={"jobs": jobs, "groups": [{"uuid": guuid,
+                                                "name": "g1"}]})
+    assert resp.status == 201
+    coord.match_cycle()
+    resp = call(api, "GET", "/group", query={"uuid": guuid})
+    assert resp.status == 200
+    g = resp.body[0]
+    assert g["name"] == "g1" and len(g["running"]) == 3
+
+
+def test_unscheduled_jobs_quota_reason(stack):
+    store, _, coord, api = stack
+    call(api, "POST", "/quota", user="admin",
+         body={"user": "alice", "quota": {"count": 0}})
+    (uuid,) = submit(api)
+    resp = call(api, "GET", "/unscheduled_jobs", query={"job": uuid})
+    reasons = [r["reason"] for r in resp.body[0]["reasons"]]
+    assert any("exceed resource quotas" in r for r in reasons)
+
+
+def test_unscheduled_jobs_placement_failure(stack):
+    store, _, coord, api = stack
+    (uuid,) = submit(api, mem=10 ** 5)  # bigger than any host
+    coord.match_cycle()
+    resp = call(api, "GET", "/unscheduled_jobs", query={"job": uuid})
+    reasons = [r["reason"] for r in resp.body[0]["reasons"]]
+    assert any("couldn't be placed" in r for r in reasons)
+
+
+def test_progress_endpoint(stack):
+    store, _, coord, api = stack
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    task = store.get_job(uuid).instances[0].task_id
+    resp = call(api, "POST", f"/progress/{task}",
+                body={"progress_sequence": 1, "progress_percent": 50,
+                      "progress_message": "halfway"})
+    assert resp.status == 202 and resp.body["accepted"]
+    # stale sequence rejected
+    resp = call(api, "POST", f"/progress/{task}",
+                body={"progress_sequence": 0, "progress_percent": 10})
+    assert resp.body["accepted"] is False
+    inst = store.get_instance(task)
+    assert inst.progress == 50 and inst.progress_message == "halfway"
+
+
+def test_stats_instances(stack):
+    store, cluster, coord, api = stack
+    submit(api, n=2)
+    coord.match_cycle()
+    cluster.advance(120)
+    now = int(time.time() * 1000)
+    resp = call(api, "GET", "/stats/instances", user="admin",
+                query={"status": "success", "start": str(now - 10 ** 7),
+                       "end": str(now + 10 ** 7)})
+    assert resp.status == 200
+    assert resp.body["overall"]["count"] == 2
+    assert "50" in resp.body["overall"]["percentiles"]
+
+
+def test_queue_running_list_pools_info(stack):
+    store, _, coord, api = stack
+    submit(api, n=2)
+    coord.match_cycle()
+    submit(api, n=1, mem=10 ** 5)  # stays pending
+    assert len(call(api, "GET", "/queue",
+                    user="admin").body["default"]) == 1
+    assert len(call(api, "GET", "/running", user="admin").body) == 2
+    lst = call(api, "GET", "/list",
+               query={"user": "alice", "state": "running+waiting"})
+    assert len(lst.body) == 3
+    pools = call(api, "GET", "/pools")
+    assert pools.body[0]["name"] == "default"
+    info = call(api, "GET", "/info", user="")
+    assert info.status == 200 and "version" in info.body
+
+
+def test_failure_reasons_and_settings(stack):
+    _, _, _, api = stack
+    resp = call(api, "GET", "/failure_reasons")
+    codes = {r["code"]: r for r in resp.body}
+    assert codes[2000]["mea_culpa"] is True
+    assert call(api, "GET", "/settings", user="admin").status == 200
+
+
+def test_instance_endpoints(stack):
+    store, cluster, coord, api = stack
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    task = store.get_job(uuid).instances[0].task_id
+    resp = call(api, "GET", f"/instances/{task}")
+    assert resp.status == 200 and resp.body["status"] == "running"
+    resp = call(api, "DELETE", "/instances", query={"uuid": task})
+    assert resp.status == 204
+    assert store.get_instance(task).status == InstanceStatus.FAILED
+
+
+def test_unknown_paths_and_methods(stack):
+    _, _, _, api = stack
+    assert call(api, "GET", "/nope").status == 404
+    assert call(api, "PUT", "/jobs").status == 405
+
+
+# ---------------------------------------------------------------------------
+# over real HTTP
+def http(url, method="GET", body=None, user="alice"):
+    req = urllib.request.Request(url, method=method,
+                                 headers={"X-Cook-User": user,
+                                          "Content-Type": "application/json"})
+    data = json.dumps(body).encode() if body is not None else None
+    try:
+        with urllib.request.urlopen(req, data=data, timeout=10) as r:
+            payload = r.read()
+            return r.status, json.loads(payload) if payload else None
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else None
+
+
+def test_end_to_end_over_http(stack):
+    store, cluster, coord, api = stack
+    server = ApiServer(api).start()
+    try:
+        uuid = new_uuid()
+        status, body = http(f"{server.url}/jobs", "POST", body={
+            "jobs": [{"uuid": uuid, "command": "sleep 1",
+                      "mem": 100, "cpus": 1}]})
+        assert status == 201 and body["jobs"] == [uuid]
+        coord.match_cycle()
+        cluster.advance(120)
+        status, body = http(f"{server.url}/jobs/{uuid}")
+        assert status == 200 and body["state"] == "success"
+        status, _ = http(f"{server.url}/jobs/{new_uuid()}")
+        assert status == 404
+    finally:
+        server.stop()
