@@ -1,0 +1,1 @@
+"""Compute backends: ComputeCluster protocol, mock, k8s-style."""

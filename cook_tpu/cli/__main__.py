@@ -1,0 +1,3 @@
+from cook_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,219 @@
+"""Python job client for the cook_tpu scheduler.
+
+Equivalent of the reference's Python jobclient
+(jobclient/python/cookclient/__init__.py: JobClient.submit/query/kill/
+wait + dataclasses in jobs.py/instance.py).  Stdlib-only (urllib).
+
+    from cook_tpu.client import JobClient
+    client = JobClient("http://localhost:12321")
+    uuid = client.submit(command="echo hi", mem=128, cpus=1)
+    job = client.wait_for_job(uuid)
+    assert job.state == "success"
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+
+class JobClientError(Exception):
+    def __init__(self, status: int, body):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+@dataclass
+class InstanceInfo:
+    """One job attempt (cookclient/instance.py equivalent)."""
+
+    task_id: str
+    status: str
+    hostname: str = ""
+    start_time: int = 0
+    end_time: Optional[int] = None
+    progress: int = 0
+    progress_message: str = ""
+    exit_code: Optional[int] = None
+    sandbox_directory: str = ""
+    reason_code: Optional[int] = None
+    reason_string: Optional[str] = None
+    preempted: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InstanceInfo":
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__ if k in d})
+
+
+@dataclass
+class JobInfo:
+    """Job status snapshot (cookclient/jobs.py equivalent)."""
+
+    uuid: str
+    name: str = ""
+    command: str = ""
+    user: str = ""
+    status: str = ""          # waiting | running | completed
+    state: str = ""           # waiting | running | success | failed
+    priority: int = 50
+    mem: float = 0.0
+    cpus: float = 0.0
+    gpus: float = 0.0
+    max_retries: int = 1
+    retries_remaining: int = 0
+    submit_time: int = 0
+    pool: str = ""
+    env: dict = field(default_factory=dict)
+    labels: dict = field(default_factory=dict)
+    groups: list = field(default_factory=list)
+    instances: list = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobInfo":
+        out = cls(**{k: d[k] for k in cls.__dataclass_fields__
+                     if k in d and k != "instances"})
+        out.instances = [InstanceInfo.from_dict(i)
+                         for i in d.get("instances", [])]
+        return out
+
+    @property
+    def completed(self) -> bool:
+        return self.status == "completed"
+
+
+class JobClient:
+    """Typed client over the REST API (JobClient.java:97-827 /
+    cookclient JobClient)."""
+
+    def __init__(self, url: str, user: Optional[str] = None,
+                 auth_headers: Optional[dict] = None, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.user = user
+        self.timeout = timeout
+        self._headers = dict(auth_headers or {})
+        if user:
+            self._headers.setdefault("X-Cook-User", user)
+
+    # -- transport -----------------------------------------------------
+    def _request(self, method: str, path: str, query: Optional[dict] = None,
+                 body: Any = None):
+        url = self.url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query, doseq=True)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json", **self._headers})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                payload = r.read()
+                return json.loads(payload) if payload else None
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                parsed = json.loads(payload) if payload else None
+            except ValueError:
+                parsed = payload.decode(errors="replace")
+            raise JobClientError(e.code, parsed)
+
+    # -- submission ----------------------------------------------------
+    def submit(self, command: str, mem: float = 128.0, cpus: float = 1.0,
+               gpus: float = 0.0, uuid: Optional[str] = None,
+               name: Optional[str] = None, priority: Optional[int] = None,
+               max_retries: int = 1, pool: Optional[str] = None,
+               env: Optional[dict] = None, labels: Optional[dict] = None,
+               constraints: Optional[list] = None,
+               group: Optional[str] = None,
+               max_runtime_ms: Optional[int] = None, **extra) -> str:
+        """Submit one job; returns its uuid."""
+        spec: dict[str, Any] = {"command": command, "mem": mem, "cpus": cpus,
+                                "gpus": gpus, "max_retries": max_retries,
+                                **extra}
+        for k, v in (("uuid", uuid), ("name", name), ("priority", priority),
+                     ("env", env), ("labels", labels),
+                     ("constraints", constraints), ("group", group),
+                     ("max_runtime", max_runtime_ms)):
+            if v is not None:
+                spec[k] = v
+        return self.submit_jobs([spec], pool=pool)[0]
+
+    def submit_jobs(self, jobs: list[dict], groups: Optional[list] = None,
+                    pool: Optional[str] = None) -> list[str]:
+        body: dict[str, Any] = {"jobs": jobs}
+        if groups:
+            body["groups"] = groups
+        if pool:
+            body["pool"] = pool
+        return self._request("POST", "/jobs", body=body)["jobs"]
+
+    # -- queries -------------------------------------------------------
+    def query(self, uuid: str) -> JobInfo:
+        return JobInfo.from_dict(self._request("GET", f"/jobs/{uuid}"))
+
+    def query_jobs(self, uuids: Iterable[str]) -> list[JobInfo]:
+        return [JobInfo.from_dict(d) for d in
+                self._request("GET", "/jobs", query={"uuid": list(uuids)})]
+
+    def list_jobs(self, user: Optional[str] = None,
+                  states: str = "waiting+running+completed",
+                  start_ms: Optional[int] = None,
+                  end_ms: Optional[int] = None,
+                  name: Optional[str] = None, limit: int = 150
+                  ) -> list[JobInfo]:
+        q: dict[str, Any] = {"user": user or self.user, "state": states,
+                             "limit": limit}
+        if start_ms is not None:
+            q["start-ms"] = start_ms
+        if end_ms is not None:
+            q["end-ms"] = end_ms
+        if name:
+            q["name"] = name
+        return [JobInfo.from_dict(d)
+                for d in self._request("GET", "/list", query=q)]
+
+    def query_instance(self, task_id: str) -> InstanceInfo:
+        return InstanceInfo.from_dict(
+            self._request("GET", f"/instances/{task_id}"))
+
+    def usage(self, user: Optional[str] = None) -> dict:
+        q = {"user": user} if user else {}
+        return self._request("GET", "/usage", query=q)
+
+    def unscheduled_reasons(self, uuid: str) -> list[dict]:
+        return self._request("GET", "/unscheduled_jobs",
+                             query={"job": uuid})[0]["reasons"]
+
+    # -- mutation ------------------------------------------------------
+    def kill(self, *uuids: str) -> None:
+        self._request("DELETE", "/jobs", query={"uuid": list(uuids)})
+
+    def kill_instances(self, *task_ids: str) -> None:
+        self._request("DELETE", "/instances", query={"uuid": list(task_ids)})
+
+    def retry(self, uuid: str, retries: Optional[int] = None,
+              increment: Optional[int] = None) -> None:
+        body: dict[str, Any] = {"job": uuid}
+        if retries is not None:
+            body["retries"] = retries
+        if increment is not None:
+            body["increment"] = increment
+        self._request("POST", "/retry", body=body)
+
+    # -- waiting (JobClient listener-polling equivalent) ---------------
+    def wait_for_job(self, uuid: str, timeout: float = 300.0,
+                     poll_interval: float = 1.0) -> JobInfo:
+        """Poll until the job completes; returns the final JobInfo."""
+        deadline = time.monotonic() + timeout
+        while True:
+            job = self.query(uuid)
+            if job.completed:
+                return job
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"job {uuid} still {job.status} after "
+                                   f"{timeout}s")
+            time.sleep(poll_interval)

@@ -1,0 +1,1 @@
+"""HTTP API layer (reference: scheduler/src/cook/rest/)."""

@@ -1,0 +1,155 @@
+"""Python JobClient + `cs` CLI against an embedded live server.
+
+Mirrors the reference's jobclient/python/tests + cli/tests coverage:
+submit/query/wait/kill/retry round-trips, federation find-job, CLI
+subcommand output.
+"""
+import json
+
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.cli import Federation, load_config, main as cli_main
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.rest.api import CookApi
+from cook_tpu.rest.auth import AuthConfig
+from cook_tpu.rest.server import ApiServer
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.state.model import new_uuid
+from cook_tpu.state.store import JobStore
+
+
+@pytest.fixture
+def live():
+    store = JobStore()
+    cluster = MockCluster([MockHost("h0", mem=1000, cpus=16)])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header", admins={"admin"}))
+    server = ApiServer(api).start()
+    yield store, cluster, coord, server
+    server.stop()
+
+
+def test_client_submit_query_wait(live):
+    store, cluster, coord, server = live
+    client = JobClient(server.url, user="alice")
+    uuid = client.submit(command="echo hi", mem=64, cpus=1, name="cj")
+    job = client.query(uuid)
+    assert job.status == "waiting" and job.user == "alice"
+    coord.match_cycle()
+    cluster.advance(120)
+    done = client.wait_for_job(uuid, timeout=5)
+    assert done.state == "success"
+    assert done.instances[0].status == "success"
+
+
+def test_client_kill_and_retry(live):
+    store, cluster, coord, server = live
+    client = JobClient(server.url, user="alice")
+    uuid = client.submit(command="sleep 99", mem=64, cpus=1)
+    coord.match_cycle()
+    client.kill(uuid)
+    job = client.query(uuid)
+    assert job.state == "failed"
+    client.retry(uuid, retries=3)
+    assert client.query(uuid).status == "waiting"
+
+
+def test_client_errors(live):
+    _, _, _, server = live
+    client = JobClient(server.url, user="alice")
+    with pytest.raises(JobClientError) as e:
+        client.query(new_uuid())
+    assert e.value.status == 404
+    with pytest.raises(JobClientError) as e:
+        client.submit(command="x", mem=-1)
+    assert e.value.status == 400
+
+
+def test_client_list_and_usage(live):
+    store, cluster, coord, server = live
+    client = JobClient(server.url, user="alice")
+    u1 = client.submit(command="a", mem=64, cpus=1)
+    client.submit(command="b", mem=64, cpus=1)
+    coord.match_cycle()
+    jobs = client.list_jobs(states="running")
+    assert len(jobs) == 2
+    assert client.usage()["total_usage"]["jobs"] == 2
+
+
+def test_federation_finds_job_on_second_cluster(live):
+    store, cluster, coord, server = live
+    cfg = {"clusters": [
+        {"name": "dead", "url": "http://127.0.0.1:1"},
+        {"name": "live", "url": server.url}], "user": "alice"}
+    fed = Federation(cfg)
+    client = JobClient(server.url, user="alice")
+    uuid = client.submit(command="x", mem=64, cpus=1)
+    name, _, job = fed.find_job(uuid)
+    assert name == "live" and job.uuid == uuid
+
+
+# -- CLI ---------------------------------------------------------------
+def run_cli(server, *argv):
+    return cli_main(["--url", server.url, "--user", "alice", *argv])
+
+
+def test_cli_submit_show_wait_kill(live, capsys):
+    store, cluster, coord, server = live
+    assert run_cli(server, "submit", "--mem", "64", "echo", "hello") == 0
+    uuid = capsys.readouterr().out.strip()
+    assert store.get_job(uuid) is not None
+
+    assert run_cli(server, "show", uuid) == 0
+    out = capsys.readouterr().out
+    assert "echo hello" in out and "waiting" in out
+
+    coord.match_cycle()
+    cluster.advance(120)
+    assert run_cli(server, "wait", uuid, "--timeout", "5") == 0
+    assert "success" in capsys.readouterr().out
+
+    assert run_cli(server, "submit", "sleep", "99") == 0
+    uuid2 = capsys.readouterr().out.strip()
+    coord.match_cycle()
+    assert run_cli(server, "kill", uuid2) == 0
+    assert run_cli(server, "show", uuid2) == 0
+    assert "failed" in capsys.readouterr().out
+
+
+def test_cli_jobs_usage_why(live, capsys):
+    store, cluster, coord, server = live
+    run_cli(server, "submit", "--mem", "100000", "big")
+    uuid = capsys.readouterr().out.strip()
+    coord.match_cycle()
+    assert run_cli(server, "jobs", "--state", "waiting") == 0
+    assert uuid in capsys.readouterr().out
+    assert run_cli(server, "why", uuid) == 0
+    assert "placed" in capsys.readouterr().out
+    assert run_cli(server, "usage") == 0
+    assert "jobs 0" in capsys.readouterr().out
+
+
+def test_cli_wait_failed_job_exit_code(live, capsys):
+    store, cluster, coord, server = live
+    cluster.runtime_fn = lambda spec: (5.0, False, 1003)
+    run_cli(server, "submit", "false")
+    uuid = capsys.readouterr().out.strip()
+    coord.match_cycle()
+    cluster.advance(6)
+    assert run_cli(server, "wait", uuid, "--timeout", "5") == 1
+
+
+def test_cli_config(tmp_path, capsys, monkeypatch):
+    cfg_path = str(tmp_path / "cs.json")
+    assert cli_main(["--config", cfg_path, "config", "--set",
+                     "clusters", '[{"name":"c1","url":"http://x"}]']) == 0
+    capsys.readouterr()
+    assert cli_main(["--config", cfg_path, "config", "--get",
+                     "clusters"]) == 0
+    assert "c1" in capsys.readouterr().out
+    assert load_config(cfg_path)["clusters"][0]["name"] == "c1"
