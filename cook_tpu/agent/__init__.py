@@ -1,0 +1,11 @@
+"""On-node agents: the task executor and the sandbox sidecar.
+
+Equivalents of the reference's on-node layer:
+  executor/cook/  (1,495 LoC)  custom executor: process groups, stdout/
+                               stderr capture, progress-regex watching,
+                               heartbeats, graceful kill
+  sidecar/cook/sidecar/ (1,009) per-node file server + progress reporter
+
+Here both live in one package and power backends/local.py — the
+ComputeCluster that actually executes commands on the local host.
+"""

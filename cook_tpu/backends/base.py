@@ -50,10 +50,13 @@ class LaunchSpec:
     gpus: float = 0.0
     env: dict[str, str] = field(default_factory=dict)
     container: Optional[dict] = None
+    progress_regex: str = ""
+    progress_output_file: str = ""
 
 
-StatusCallback = Callable[[str, InstanceStatus, Optional[int]], None]
-# (task_id, status, reason_code)
+StatusCallback = Callable[..., None]
+# (task_id, status, reason_code, **extra) — extra may carry exit_code,
+# sandbox (the sandbox/exit-code publisher data, mesos/sandbox.clj)
 
 
 class ComputeCluster(abc.ABC):
@@ -79,10 +82,10 @@ class ComputeCluster(abc.ABC):
         self._status_cb = cb
 
     def emit_status(self, task_id: str, status: InstanceStatus,
-                    reason: Optional[int] = None) -> None:
+                    reason: Optional[int] = None, **extra) -> None:
         cb = getattr(self, "_status_cb", None)
         if cb:
-            cb(task_id, status, reason)
+            cb(task_id, status, reason, **extra)
 
     # lifecycle / recovery ------------------------------------------------
     def initialize(self) -> None:

@@ -122,15 +122,30 @@ def build_scheduler(config: dict):
     from cook_tpu.state.pools import Pool, PoolRegistry
     from cook_tpu.state.store import JobStore
 
+    from cook_tpu.scheduler.heartbeat import HeartbeatWatcher
+    from cook_tpu.scheduler.progress import ProgressAggregator
+
     store = JobStore.restore(config.get("snapshot_path"),
                              log_path=config.get("log_path"))
     pools = PoolRegistry(config.get("default_pool", "default"))
     for p in config.get("pools", []):
         pools.add(Pool(name=p["name"], purpose=p.get("purpose", "")))
+    progress = ProgressAggregator(store)
+    heartbeats = HeartbeatWatcher(store)
     clusters = ClusterRegistry()
     for c in config.get("clusters", [{"kind": "mock", "name": "mock",
                                       "hosts": 4}]):
-        if c.get("kind", "mock") == "mock":
+        if c.get("kind") == "local":
+            from cook_tpu.backends.local import LocalCluster
+            clusters.register(LocalCluster(
+                sandbox_root=c.get("sandbox_root", "/tmp/cook_tpu_sandboxes"),
+                name=c.get("name", "local"),
+                mem=float(c.get("host_mem", 8192)),
+                cpus=float(c.get("host_cpus", 8)),
+                pool=c.get("pool", pools.default_pool),
+                file_server_port=int(c.get("file_server_port", 12322)),
+                progress_aggregator=progress, heartbeats=heartbeats))
+        elif c.get("kind", "mock") == "mock":
             name = c.get("name", "mock")
             hosts = [MockHost(hostname=f"{name}-host-{i}",
                               mem=float(c.get("host_mem", 32_768)),
@@ -149,7 +164,8 @@ def build_scheduler(config: dict):
         launch_rate_limiter=RateLimiter(
             **rl_cfg.get("global_launch", {"enforce": False})),
         user_launch_rate_limiter=RateLimiter(
-            **rl_cfg.get("user_launch", {"enforce": False})))
+            **rl_cfg.get("user_launch", {"enforce": False})),
+        progress_aggregator=progress, heartbeats=heartbeats)
     submit_rl = RateLimiter(**rl_cfg.get("user_submit", {"enforce": False}))
     api = CookApi(store, coordinator=coord,
                   submission_rate_limiter=submit_rl,
@@ -172,6 +188,12 @@ def main(argv=None) -> None:
                         help="API only; don't start scheduling loops")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # Respect JAX_PLATFORMS even when a site hook already imported jax
+    # and pinned a different platform.
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     config = {}
     if args.config:
         with open(args.config) as f:

@@ -81,7 +81,8 @@ class Coordinator:
                  pools: Optional[PoolRegistry] = None,
                  config: Optional[SchedulerConfig] = None,
                  launch_rate_limiter: Optional[RateLimiter] = None,
-                 user_launch_rate_limiter: Optional[RateLimiter] = None):
+                 user_launch_rate_limiter: Optional[RateLimiter] = None,
+                 progress_aggregator=None, heartbeats=None):
         self.store = store
         self.clusters = clusters
         self.shares = shares or ShareStore()
@@ -100,15 +101,19 @@ class Coordinator:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.metrics: dict[str, float] = {}
+        self.progress_aggregator = progress_aggregator
+        self.heartbeats = heartbeats
         for cluster in clusters.all():
             cluster.set_status_callback(self._on_status)
 
     # ------------------------------------------------------------------
     def _on_status(self, task_id: str, status: InstanceStatus,
-                   reason: Optional[int]) -> None:
+                   reason: Optional[int], exit_code: Optional[int] = None,
+                   sandbox: Optional[str] = None) -> None:
         preempted = reason == 2000
         self.store.update_instance(task_id, status, reason_code=reason,
-                                   preempted=preempted)
+                                   preempted=preempted, exit_code=exit_code,
+                                   sandbox=sandbox)
         # a launched job's reservation is spent
         job_uuid = self.store.task_to_job.get(task_id)
         if job_uuid and job_uuid in self.reservations and \
@@ -224,7 +229,9 @@ class Coordinator:
                 LaunchSpec(task_id=inst.task_id, job_uuid=job.uuid,
                            hostname=hostname, command=job.command,
                            mem=job.mem, cpus=job.cpus, gpus=job.gpus,
-                           env=job.env, container=job.container))
+                           env=job.env, container=job.container,
+                           progress_regex=job.progress_regex_string,
+                           progress_output_file=job.progress_output_file))
             launched += 1
             self.launch_rl.spend("global")
             if job.uuid in self.reservations:
@@ -505,6 +512,11 @@ class Coordinator:
         loop(self.config.match_interval_s, self.match_cycle)
         loop(self.config.rebalancer_interval_s, self.rebalance_cycle)
         loop(60.0, self.watchdog_cycle, per_pool=False)
+        if self.progress_aggregator is not None:
+            loop(1.0, self.progress_aggregator.publish, per_pool=False)
+        if self.heartbeats is not None:
+            loop(30.0, self.heartbeats.check, per_pool=False)
+            loop(300.0, self.heartbeats.sync, per_pool=False)
 
     def stop(self) -> None:
         self._stop.set()

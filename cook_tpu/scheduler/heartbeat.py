@@ -1,0 +1,72 @@
+"""Heartbeat tracking: lose a task whose executor goes silent.
+
+Equivalent of cook.mesos.heartbeat (heartbeat.clj): per-task deadlines
+refreshed by executor heartbeats (notify-heartbeat :38); a task whose
+deadline lapses fails with :heartbeat-lost / reason 3000
+(handle-timeout :65).  A periodic sync registers tracking for any
+running task that has never heartbeated (sync-with-datomic :95) so a
+dead-on-arrival executor is still detected.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from cook_tpu.state.model import InstanceStatus
+from cook_tpu.state.store import JobStore
+
+HEARTBEAT_TIMEOUT_S = 15 * 60.0
+
+
+class HeartbeatWatcher:
+    def __init__(self, store: JobStore, timeout_s: float = HEARTBEAT_TIMEOUT_S,
+                 on_timeout: Optional[Callable[[str], None]] = None,
+                 clock=time.monotonic):
+        self.store = store
+        self.timeout_s = timeout_s
+        self.on_timeout = on_timeout
+        self._clock = clock
+        self._deadlines: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def notify(self, task_id: str) -> None:
+        """An executor heartbeat arrived: extend the deadline."""
+        with self._lock:
+            self._deadlines[task_id] = self._clock() + self.timeout_s
+
+    def track(self, task_id: str) -> None:
+        """Start tracking without a heartbeat (task just launched)."""
+        with self._lock:
+            self._deadlines.setdefault(task_id,
+                                       self._clock() + self.timeout_s)
+
+    def untrack(self, task_id: str) -> None:
+        with self._lock:
+            self._deadlines.pop(task_id, None)
+
+    def sync(self) -> None:
+        """Track every running instance; drop completed ones
+        (sync-with-datomic heartbeat.clj:95)."""
+        running = {i.task_id for i in self.store.running_instances()}
+        with self._lock:
+            for tid in running - self._deadlines.keys():
+                self._deadlines[tid] = self._clock() + self.timeout_s
+            for tid in list(self._deadlines.keys() - running):
+                del self._deadlines[tid]
+
+    def check(self) -> list[str]:
+        """Fail every task past its deadline (handle-timeout
+        heartbeat.clj:65). Returns the task ids timed out."""
+        now = self._clock()
+        with self._lock:
+            expired = [tid for tid, dl in self._deadlines.items()
+                       if dl <= now]
+            for tid in expired:
+                del self._deadlines[tid]
+        for tid in expired:
+            self.store.update_instance(tid, InstanceStatus.FAILED,
+                                       reason_code=3000)
+            if self.on_timeout:
+                self.on_timeout(tid)
+        return expired

@@ -1,0 +1,253 @@
+"""On-node agent layer: executor subprocess lifecycle, progress regex
+watching, file server API, heartbeats, progress aggregation, and the
+LocalCluster end-to-end path (real subprocesses through the full
+scheduler: submit → match → execute → exit-code/sandbox writeback).
+
+Mirrors executor/tests (test_executor.py, test_subprocess.py,
+test_progress.py) and sidecar file-server coverage.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from cook_tpu.agent.executor import Executor
+from cook_tpu.agent.file_server import FileServer
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.local import LocalCluster
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.scheduler.heartbeat import HeartbeatWatcher
+from cook_tpu.scheduler.progress import ProgressAggregator
+from cook_tpu.state.model import InstanceStatus, Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+
+
+def wait_until(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- executor ----------------------------------------------------------
+def test_executor_success_and_failure(tmp_path):
+    events = []
+    ex = Executor(str(tmp_path), on_status=lambda *a: events.append(a))
+    ex.launch("t1", "echo hello; exit 0")
+    ex.launch("t2", "exit 3")
+    assert wait_until(lambda: sum(1 for e in events
+                                  if e[1] in ("exited", "killed")) == 2)
+    by_task = {e[0]: e for e in events if e[1] == "exited"}
+    assert by_task["t1"][2]["exit_code"] == 0
+    assert by_task["t2"][2]["exit_code"] == 3
+    with open(tmp_path / "t1" / "stdout") as f:
+        assert f.read() == "hello\n"
+
+
+def test_executor_kill_process_group(tmp_path):
+    events = []
+    ex = Executor(str(tmp_path), on_status=lambda *a: events.append(a),
+                  kill_grace_period_s=0.2)
+    # spawn a child that ignores nothing; the whole group must die
+    ex.launch("t1", "sleep 60 & sleep 60")
+    assert wait_until(lambda: any(e[1] == "running" for e in events))
+    ex.kill("t1")
+    assert wait_until(lambda: any(e[1] == "killed" for e in events))
+    assert ex.alive_task_ids() == set()
+
+
+def test_executor_progress_regex(tmp_path):
+    updates = []
+    ex = Executor(str(tmp_path), on_status=lambda *a: None,
+                  on_progress=lambda *a: updates.append(a))
+    ex.launch("t1", "echo 'progress: 25 quarter done'; sleep 0.3; "
+                    "echo 'progress: 75 almost'; echo not-a-progress-line")
+    assert wait_until(lambda: len(updates) >= 2)
+    assert updates[0][2] == 25 and updates[0][3] == "quarter done"
+    assert updates[1][2] == 75 and updates[1][3] == "almost"
+    # sequences strictly increase
+    assert updates[0][1] < updates[1][1]
+
+
+def test_executor_custom_regex_and_progress_file(tmp_path):
+    updates = []
+    ex = Executor(str(tmp_path), on_status=lambda *a: None,
+                  on_progress=lambda *a: updates.append(a))
+    ex.launch("t1", "echo '^^33 one-third' > prog.txt; sleep 0.5",
+              progress_regex=r"\^\^(\d+)\s+(.*)",
+              progress_output_file="prog.txt")
+    assert wait_until(lambda: len(updates) >= 1)
+    assert updates[0][2] == 33 and updates[0][3] == "one-third"
+
+
+def test_executor_heartbeats(tmp_path):
+    beats = []
+    ex = Executor(str(tmp_path), on_status=lambda *a: None,
+                  on_heartbeat=lambda t: beats.append(t),
+                  heartbeat_interval_s=0.1)
+    ex.launch("t1", "sleep 0.5")
+    assert wait_until(lambda: len(beats) >= 3)
+
+
+# -- file server -------------------------------------------------------
+def fget(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_file_server(tmp_path):
+    (tmp_path / "job1").mkdir()
+    (tmp_path / "job1" / "stdout").write_text("line1\nline2\n")
+    fs = FileServer(str(tmp_path), port=0).start()
+    base = f"http://127.0.0.1:{fs.port}"
+    try:
+        # browse
+        status, body = fget(f"{base}/files/browse?path={tmp_path}/job1")
+        entries = json.loads(body)
+        assert status == 200 and entries[0]["path"].endswith("stdout")
+        assert entries[0]["size"] == 12
+        # read: offset=-1 -> size
+        status, body = fget(
+            f"{base}/files/read?path={tmp_path}/job1/stdout&offset=-1")
+        assert json.loads(body)["offset"] == 12
+        # ranged read
+        status, body = fget(
+            f"{base}/files/read?path={tmp_path}/job1/stdout"
+            f"&offset=6&length=6")
+        assert json.loads(body)["data"] == "line2\n"
+        # download
+        status, body = fget(
+            f"{base}/files/download?path={tmp_path}/job1/stdout")
+        assert body == b"line1\nline2\n"
+        # path traversal rejected
+        try:
+            status, _ = fget(f"{base}/files/read?path=/etc/passwd&offset=0")
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 404
+    finally:
+        fs.stop()
+
+
+# -- heartbeat watcher / progress aggregator ---------------------------
+def test_heartbeat_watcher_timeout():
+    store = JobStore()
+    job = Job(uuid=new_uuid(), user="u", command="x", mem=1, cpus=1)
+    store.create_jobs([job])
+    inst = store.create_instance(job.uuid, "h", "local")
+    store.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    clock = [0.0]
+    hb = HeartbeatWatcher(store, timeout_s=10, clock=lambda: clock[0])
+    hb.sync()
+    clock[0] = 5.0
+    hb.notify(inst.task_id)       # refresh at t=5 -> new deadline 15
+    clock[0] = 12.0
+    assert hb.check() == []
+    clock[0] = 16.0
+    assert hb.check() == [inst.task_id]
+    assert store.get_instance(inst.task_id).reason_code == 3000
+    # mea-culpa: the failure doesn't consume the retry
+    assert job.state == JobState.WAITING
+
+
+def test_progress_aggregator_dedupe_and_publish():
+    store = JobStore()
+    job = Job(uuid=new_uuid(), user="u", command="x", mem=1, cpus=1)
+    store.create_jobs([job])
+    inst = store.create_instance(job.uuid, "h", "local")
+    agg = ProgressAggregator(store)
+    assert agg.handle(inst.task_id, 1, 10, "a")
+    assert agg.handle(inst.task_id, 3, 30, "c")
+    assert not agg.handle(inst.task_id, 2, 20, "b")   # stale
+    assert agg.publish() == 1
+    assert store.get_instance(inst.task_id).progress == 30
+    assert agg.publish() == 0  # batch drained
+
+
+# -- LocalCluster end-to-end ------------------------------------------
+@pytest.fixture
+def local_stack(tmp_path):
+    store = JobStore()
+    agg = ProgressAggregator(store)
+    hb = HeartbeatWatcher(store)
+    cluster = LocalCluster(str(tmp_path), mem=4096, cpus=4,
+                           progress_aggregator=agg, heartbeats=hb,
+                           heartbeat_interval_s=0.1)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, progress_aggregator=agg, heartbeats=hb)
+    cluster.initialize()
+    yield store, cluster, coord, agg
+    cluster.shutdown()
+
+
+def test_local_cluster_end_to_end(local_stack, tmp_path):
+    store, cluster, coord, agg = local_stack
+    job = Job(uuid=new_uuid(), user="alice", command="echo out; exit 0",
+              mem=100, cpus=1)
+    store.create_jobs([job])
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+    assert wait_until(lambda: job.state == JobState.COMPLETED)
+    inst = job.instances[0]
+    assert job.success and inst.exit_code == 0
+    assert inst.sandbox_directory
+    with open(os.path.join(inst.sandbox_directory, "stdout")) as f:
+        assert f.read() == "out\n"
+
+
+def test_local_cluster_failure_exit_code(local_stack):
+    store, cluster, coord, agg = local_stack
+    job = Job(uuid=new_uuid(), user="alice", command="exit 7",
+              mem=100, cpus=1, max_retries=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert wait_until(lambda: job.state == JobState.COMPLETED)
+    assert job.success is False
+    assert job.instances[0].exit_code == 7
+    assert job.instances[0].reason_code == 1003
+
+
+def test_local_cluster_progress_to_store(local_stack):
+    store, cluster, coord, agg = local_stack
+    job = Job(uuid=new_uuid(), user="alice",
+              command="echo 'progress: 50 halfway'; sleep 0.5",
+              mem=100, cpus=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert wait_until(lambda: agg.publish() > 0 or
+                      store.get_job(job.uuid).instances[0].progress == 50)
+    agg.publish()
+    assert job.instances[0].progress == 50
+
+
+def test_local_cluster_kill(local_stack):
+    store, cluster, coord, agg = local_stack
+    job = Job(uuid=new_uuid(), user="alice", command="sleep 60",
+              mem=100, cpus=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert wait_until(
+        lambda: job.instances and
+        job.instances[0].status == InstanceStatus.RUNNING)
+    tid = job.instances[0].task_id
+    store.kill_job(job.uuid)
+    cluster.kill_task(tid)
+    assert wait_until(lambda: cluster.known_task_ids() == set())
+    assert job.instances[0].status == InstanceStatus.FAILED
+
+
+def test_local_cluster_capacity_accounting(local_stack):
+    store, cluster, coord, agg = local_stack
+    jobs = [Job(uuid=new_uuid(), user="alice", command="sleep 5",
+                mem=2000, cpus=1) for _ in range(3)]
+    store.create_jobs(jobs)
+    coord.match_cycle()  # only 2 fit in 4096 MB
+    running = [j for j in jobs if j.instances]
+    assert len(running) == 2
+    offers = cluster.pending_offers("default")
+    assert offers == [] or offers[0].mem <= 96
