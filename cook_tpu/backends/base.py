@@ -103,9 +103,12 @@ class ComputeCluster(abc.ABC):
         offer path (the agent-attributes-cache, scheduler.clj:986-993)."""
         return {}
 
-    def autoscale(self, pool: str, queue_depth: int) -> None:
+    def autoscale(self, pool: str, queue_depth: int,
+                  pending_sizes: Optional[list] = None) -> None:
         """Hook for synthetic-pod style autoscaling (autoscale!,
-        kubernetes/compute_cluster.clj:339-409)."""
+        kubernetes/compute_cluster.clj:339-409). pending_sizes carries
+        (mem, cpus) of the unmatched queue head so scale-up requests are
+        representative."""
 
 
 class ClusterRegistry:

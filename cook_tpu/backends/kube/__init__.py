@@ -1,0 +1,20 @@
+"""Kubernetes-style compute backend.
+
+Equivalent of the reference's kubernetes/ layer:
+  api.py        typed pod/node model + KubeApi client protocol + an
+                in-memory FakeKube with watches and a toy autoscaler
+                (kubernetes/api.clj + testutil fake cluster)
+  controller.py the (cook-expected-state x k8s-actual-state) state
+                machine with sharded pod locks (kubernetes/controller.clj)
+  cluster.py    ComputeCluster impl: node/pod watches -> offers,
+                launches via expected-state writes, synthetic-pod
+                autoscaling, startup reconstruction
+                (kubernetes/compute_cluster.clj)
+"""
+from cook_tpu.backends.kube.api import FakeKube, KubeApi, Node, Pod, PodPhase
+from cook_tpu.backends.kube.cluster import KubeCluster
+from cook_tpu.backends.kube.controller import (ExpectedState, KubeController,
+                                               PodState)
+
+__all__ = ["FakeKube", "KubeApi", "Node", "Pod", "PodPhase", "KubeCluster",
+           "KubeController", "ExpectedState", "PodState"]

@@ -1,0 +1,177 @@
+"""KubeCluster: the ComputeCluster over the kube controller.
+
+Equivalent of kubernetes/compute_cluster.clj (574 LoC):
+  - offers synthesized per pool from node capacity minus non-terminal
+    pod requests (generate-offers :48-88);
+  - launch: write the instance's expected state = STARTING with the
+    built pod spec; the controller creates the pod (launch-task! :213);
+  - kill: expected state = KILLED (safe for unknown tasks);
+  - autoscaling via synthetic pods: unmatched pending jobs materialize
+    as cheap placeholder pods that make the cluster autoscaler add
+    nodes; synthetic pods never write back to the store
+    (:339-409);
+  - startup reconstruction: seed expected state from the store's view
+    of live instances, then scan (:155-190);
+  - task-id == pod-name throughout (like the reference).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
+from cook_tpu.backends.kube.api import (KubeApi, Pod, PodPhase, POOL_LABEL,
+                                        SYNTHETIC_LABEL)
+from cook_tpu.backends.kube.controller import (ExpectedState, KubeController,
+                                               PodState)
+from cook_tpu.state.model import InstanceStatus
+
+MAX_SYNTHETIC_PODS = 30
+
+
+class KubeCluster(ComputeCluster):
+    def __init__(self, api: KubeApi, name: str = "kube",
+                 max_synthetic_pods: int = MAX_SYNTHETIC_PODS,
+                 synthetic_pods: bool = True):
+        self.name = name
+        self.api = api
+        self.max_synthetic = max_synthetic_pods
+        self.synthetic_enabled = synthetic_pods
+        self._synthetic_seq = 0
+        self._lock = threading.Lock()
+        self.controller = KubeController(api, self._writeback, name=name)
+
+    # -- lifecycle -----------------------------------------------------
+    def initialize(self, running_task_ids=frozenset()) -> None:
+        """Startup reconstruction then watches (initialize-cluster;
+        compute_cluster.clj:155-190): (1) load the live pod list into
+        the actual-state map without processing, (2) seed expected
+        RUNNING for every instance the store believes is live, (3) one
+        reconciling scan — store-vs-pod disagreements resolve here
+        (live pod → keep; missing pod → externally-deleted failure;
+        orphan pod → weird-state kill), (4) subscribe to watches."""
+        from cook_tpu.backends.kube.controller import ExpectedDict
+        with self.controller._maps_lock:
+            for pod in self.api.list_pods():
+                if not pod.synthetic:
+                    self.controller.actual[pod.name] = pod
+            for task_id in running_task_ids:
+                self.controller.expected[task_id] = ExpectedDict(
+                    ExpectedState.RUNNING)
+        self.controller.scan()
+        self.api.watch_pods(self._on_pod_event)
+        self.api.watch_nodes(lambda kind, node: None)
+
+    def _on_pod_event(self, kind: str, pod: Pod) -> None:
+        if pod.synthetic:
+            self._on_synthetic_event(kind, pod)
+            return
+        if kind == "deleted":
+            self.controller.pod_deleted(pod)
+        else:
+            self.controller.pod_update(pod)
+
+    # -- protocol ------------------------------------------------------
+    def pending_offers(self, pool: str) -> list[Offer]:
+        """generate-offers (:48-88): capacity minus consumption per
+        node; synthetic pods count as consumption so the matcher and the
+        autoscaler don't double-claim the same room."""
+        pods = self.api.list_pods()
+        offers = []
+        for node in self.api.list_nodes():
+            if node.pool != pool or not node.schedulable:
+                continue
+            used_mem = used_cpus = used_gpus = 0.0
+            for p in pods:
+                if p.node == node.name and not p.terminal:
+                    used_mem += p.mem
+                    used_cpus += p.cpus
+                    used_gpus += p.gpus
+            mem = node.mem - used_mem
+            cpus = node.cpus - used_cpus
+            if mem <= 0 and cpus <= 0:
+                continue
+            offers.append(Offer(
+                hostname=node.name, pool=pool, mem=mem, cpus=cpus,
+                gpus=node.gpus - used_gpus,
+                attributes={POOL_LABEL: node.pool, **node.labels},
+                cap_mem=node.mem, cap_cpus=node.cpus, cap_gpus=node.gpus))
+        return offers
+
+    def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
+        for spec in specs:
+            pod = Pod(name=spec.task_id, mem=spec.mem, cpus=spec.cpus,
+                      gpus=spec.gpus, node=spec.hostname, pool=pool,
+                      env=dict(spec.env), command=spec.command,
+                      labels={"cook-job": spec.job_uuid})
+            self.controller.set_expected(spec.task_id,
+                                         ExpectedState.STARTING,
+                                         launch_pod=pod)
+
+    def kill_task(self, task_id: str) -> None:
+        # only flip tasks we actually track; an unconditional KILLED
+        # write would resurrect completed entries (safe-kill-task)
+        if task_id in self.controller.known_task_ids():
+            self.controller.set_expected(task_id, ExpectedState.KILLED)
+
+    def preempt_task(self, task_id: str) -> None:
+        self.kill_task(task_id)
+
+    def known_task_ids(self) -> set[str]:
+        return self.controller.known_task_ids()
+
+    def host_attributes(self) -> dict[str, dict[str, str]]:
+        return {n.name: {POOL_LABEL: n.pool, **n.labels}
+                for n in self.api.list_nodes()}
+
+    # -- autoscaling (synthetic pods, :339-409) ------------------------
+    def autoscale(self, pool: str, queue_depth: int,
+                  pending_sizes: Optional[list] = None) -> None:
+        """Materialize up to max_synthetic placeholder pods for
+        unmatched demand.  Outstanding synthetic pods count against the
+        cap; they are deleted as soon as they schedule+run (their whole
+        purpose is to be unschedulable and trigger scale-up)."""
+        if not self.synthetic_enabled or queue_depth <= 0:
+            return
+        outstanding = [p for p in self.api.list_pods()
+                       if p.synthetic and p.pool == pool]
+        budget = self.max_synthetic - len(outstanding)
+        sizes = (pending_sizes or [(1024.0, 1.0)] * queue_depth)[:budget]
+        with self._lock:
+            for mem, cpus in sizes:
+                self._synthetic_seq += 1
+                self.api.create_pod(Pod(
+                    name=f"synthetic-{self.name}-{self._synthetic_seq}",
+                    mem=float(mem), cpus=float(cpus), pool=pool,
+                    labels={SYNTHETIC_LABEL: "true"}))
+
+    def _on_synthetic_event(self, kind: str, pod: Pod) -> None:
+        """Synthetic pods that ever start running are useless (they hold
+        real capacity): delete immediately (synthetic-pod GC)."""
+        if kind != "deleted" and pod.phase in (PodPhase.RUNNING,
+                                               PodPhase.SUCCEEDED,
+                                               PodPhase.FAILED):
+            self.api.delete_pod(pod.name)
+
+    def gc_synthetic(self, max_age_pods: int = 0) -> int:
+        """Drop scheduled-but-idle synthetic pods so real workloads can
+        claim the room (the reference ages them out via
+        synthetic-pod-recency tracking)."""
+        n = 0
+        for p in self.api.list_pods():
+            if p.synthetic and p.node:
+                self.api.delete_pod(p.name)
+                n += 1
+        return n
+
+    # -- controller writeback -----------------------------------------
+    def _writeback(self, task_id: str, event: str, info: dict) -> None:
+        if event == "running":
+            self.emit_status(task_id, InstanceStatus.RUNNING, None)
+        elif event == "succeeded":
+            self.emit_status(task_id, InstanceStatus.SUCCESS, None,
+                             exit_code=info.get("exit_code", 0))
+        elif event == "failed":
+            self.emit_status(task_id, InstanceStatus.FAILED,
+                             info.get("reason"),
+                             exit_code=info.get("exit_code"))

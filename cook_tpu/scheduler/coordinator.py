@@ -110,7 +110,7 @@ class Coordinator:
     def _on_status(self, task_id: str, status: InstanceStatus,
                    reason: Optional[int], exit_code: Optional[int] = None,
                    sandbox: Optional[str] = None) -> None:
-        preempted = reason == 2000
+        preempted = reason in (2000, 2003)
         self.store.update_instance(task_id, status, reason_code=reason,
                                    preempted=preempted, exit_code=exit_code,
                                    sandbox=sandbox)
@@ -265,8 +265,12 @@ class Coordinator:
 
         # autoscaling hook (trigger-autoscaling! scheduler.clj:828-846)
         queue_depth = len(pending) - launched
+        unmatched_sizes = [(pending[i].mem, pending[i].cpus)
+                           for i in range(len(pending))
+                           if not pending[i].instances][:64]
         for cluster in self.clusters.all():
-            cluster.autoscale(pool, queue_depth)
+            cluster.autoscale(pool, queue_depth,
+                              pending_sizes=unmatched_sizes)
 
         stats.cycle_ms = (time.perf_counter() - t0) * 1e3
         self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms

@@ -84,6 +84,8 @@ REASONS = [
     Reason(5000, "host-lost", "Host lost", mea_culpa=True, failure_limit=3),
     Reason(5001, "executor-unregistered", "Executor unregistered",
            mea_culpa=True, failure_limit=3),
+    Reason(5002, "killed-externally", "Container killed externally",
+           mea_culpa=True, failure_limit=3),
     Reason(6000, "unknown", "Unknown failure"),
     Reason(99000, "scheduling-failed", "Could not launch task",
            mea_culpa=True, failure_limit=None),

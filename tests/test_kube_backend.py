@@ -1,0 +1,284 @@
+"""K8s-style backend: controller state machine cross-product, sharded
+locks, offers synthesis, synthetic-pod autoscaling, startup
+reconstruction, and the full coordinator end-to-end path.
+
+Mirrors the reference's kubernetes/controller.clj test coverage (9
+deftests) + compute-cluster tests.
+"""
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.kube import (ExpectedState, FakeKube, KubeCluster,
+                                    Node, Pod, PodPhase)
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.state.model import InstanceStatus, Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+
+
+def build(nodes=None, autoscale_max=0, template=None, **cluster_kw):
+    kube = FakeKube(nodes if nodes is not None else [
+        Node("n0", mem=1000, cpus=16), Node("n1", mem=1000, cpus=16)],
+        autoscaler_max_nodes=autoscale_max,
+        autoscaler_node_template=template)
+    cluster = KubeCluster(kube, **cluster_kw)
+    store = JobStore()
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    cluster.initialize()
+    return kube, cluster, store, coord
+
+
+def mkjob(user="alice", mem=100, cpus=1, **kw):
+    return Job(uuid=new_uuid(), user=user, command="true", mem=mem,
+               cpus=cpus, **kw)
+
+
+def run_pod_lifecycle(kube, task_id, end="succeed"):
+    kube.schedule_pending()
+    kube.start_pod(task_id)
+    if end == "succeed":
+        kube.succeed_pod(task_id)
+    elif end == "fail":
+        kube.fail_pod(task_id, exit_code=2)
+
+
+# -- end-to-end --------------------------------------------------------
+def test_submit_launch_run_success():
+    kube, cluster, store, coord = build()
+    job = mkjob()
+    store.create_jobs([job])
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+    task_id = job.instances[0].task_id
+    # pod created by controller, pending on its assigned node
+    pod = next(p for p in kube.list_pods() if p.name == task_id)
+    assert pod.node in ("n0", "n1")
+    kube.start_pod(task_id)
+    assert job.instances[0].status == InstanceStatus.RUNNING
+    kube.succeed_pod(task_id)
+    assert job.state == JobState.COMPLETED and job.success
+    assert job.instances[0].exit_code == 0
+    # pod GC'd after writeback
+    assert kube.list_pods() == []
+    assert cluster.known_task_ids() == set()
+
+
+def test_pod_failure_writes_exit_code():
+    kube, cluster, store, coord = build()
+    job = mkjob(max_retries=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    run_pod_lifecycle(kube, tid, end="fail")
+    assert job.state == JobState.COMPLETED and job.success is False
+    assert job.instances[0].exit_code == 2
+    assert job.instances[0].reason_code == 1003
+
+
+def test_kill_running_task():
+    kube, cluster, store, coord = build()
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    kube.schedule_pending()
+    kube.start_pod(tid)
+    store.kill_job(job.uuid)
+    cluster.kill_task(tid)
+    assert job.instances[0].status == InstanceStatus.FAILED
+    assert kube.list_pods() == []
+
+
+def test_kill_races_ahead_of_watch():
+    """(KILLED, MISSING) with a saved launch pod: opportunistic delete
+    (controller.clj:456-474)."""
+    kube, cluster, store, coord = build()
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    # simulate watch lag: drop actual state then kill
+    cluster.controller.actual.pop(tid, None)
+    cluster.kill_task(tid)
+    assert job.instances[0].status == InstanceStatus.FAILED
+    assert job.instances[0].reason_code == 1004
+    assert all(p.name != tid for p in kube.list_pods())
+
+
+def test_node_preemption_is_mea_culpa():
+    kube, cluster, store, coord = build()
+    job = mkjob(max_retries=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    kube.schedule_pending()
+    kube.start_pod(tid)
+    node = job.instances[0].hostname
+    kube.preempt_node(node)
+    inst = job.instances[0]
+    assert inst.status == InstanceStatus.FAILED
+    assert inst.reason_code == 2003 and inst.preempted
+    # mea-culpa: retry not consumed, job waits again
+    assert job.state == JobState.WAITING
+
+
+def test_external_deletion():
+    kube, cluster, store, coord = build()
+    job = mkjob(max_retries=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    kube.schedule_pending()
+    kube.start_pod(tid)
+    kube.vanish_pod(tid)
+    inst = job.instances[0]
+    assert inst.reason_code == 5002
+    assert job.state == JobState.WAITING  # mea-culpa with limit 3
+
+
+def test_pod_unknown_treated_terminal():
+    kube, cluster, store, coord = build()
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    kube.schedule_pending()
+    kube.start_pod(tid)
+    kube.mark_unknown(tid)
+    assert job.instances[0].status == InstanceStatus.FAILED
+    assert job.instances[0].reason_code == 5002
+    assert kube.list_pods() == []
+
+
+def test_orphan_pod_killed():
+    """(MISSING expected, running pod): kill in weird state."""
+    kube, cluster, store, coord = build()
+    orphan = Pod(name="orphan-1", mem=10, cpus=1, node="n0",
+                 phase=PodPhase.RUNNING)
+    kube.create_pod(orphan)
+    assert cluster.controller.weird_states >= 1
+    assert all(p.name != "orphan-1" for p in kube.list_pods())
+
+
+def test_resurrected_pod_after_completed():
+    kube, cluster, store, coord = build()
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    run_pod_lifecycle(kube, tid)
+    assert job.success
+    # someone recreates the pod
+    kube.create_pod(Pod(name=tid, mem=10, cpus=1, node="n0",
+                        phase=PodPhase.RUNNING))
+    # weird-state kill; no store change
+    assert job.instances[0].status == InstanceStatus.SUCCESS
+    assert all(p.name != tid for p in kube.list_pods())
+
+
+def test_offers_subtract_pod_consumption():
+    kube, cluster, store, coord = build(nodes=[Node("n0", mem=1000,
+                                                    cpus=10)])
+    offers = cluster.pending_offers("default")
+    assert offers[0].mem == 1000
+    job = mkjob(mem=400, cpus=4)
+    store.create_jobs([job])
+    coord.match_cycle()
+    offers = cluster.pending_offers("default")
+    assert offers[0].mem == 600 and offers[0].cpus == 6
+
+
+def test_pool_filtering_of_nodes():
+    kube, cluster, store, coord = build(nodes=[
+        Node("n0", mem=100, cpus=4, pool="default"),
+        Node("gpu0", mem=100, cpus=4, pool="gpu-pool")])
+    assert [o.hostname for o in cluster.pending_offers("default")] == ["n0"]
+    assert [o.hostname
+            for o in cluster.pending_offers("gpu-pool")] == ["gpu0"]
+
+
+def test_synthetic_pod_autoscaling():
+    template = Node("big", mem=2000, cpus=32)
+    kube, cluster, store, coord = build(
+        nodes=[Node("n0", mem=100, cpus=1)],
+        autoscale_max=3, template=template)
+    # demand exceeds the single small node
+    jobs = [mkjob(mem=500, cpus=4) for _ in range(4)]
+    store.create_jobs(jobs)
+    coord.match_cycle()     # nothing fits; autoscale hook fires
+    assert any(p.synthetic for p in kube.list_pods())
+    added = kube.autoscale_step()
+    assert added >= 1
+    # synthetic pods on new capacity are GC'd so real jobs can claim it
+    kube.schedule_pending()
+    cluster.gc_synthetic()
+    coord.match_cycle()
+    assert sum(1 for j in jobs if j.instances) >= 1
+
+
+def test_synthetic_pods_capped():
+    kube, cluster, store, coord = build(
+        nodes=[], autoscale_max=0, max_synthetic_pods=5)
+    cluster.autoscale("default", 100,
+                      pending_sizes=[(100.0, 1.0)] * 100)
+    assert len([p for p in kube.list_pods() if p.synthetic]) == 5
+    # repeated calls don't exceed the cap
+    cluster.autoscale("default", 100,
+                      pending_sizes=[(100.0, 1.0)] * 100)
+    assert len([p for p in kube.list_pods() if p.synthetic]) == 5
+
+
+def test_startup_reconstruction():
+    """Restarted leader: store believes an instance is running; the
+    controller reconciles it against the live pod."""
+    kube = FakeKube([Node("n0", mem=1000, cpus=16)])
+    store = JobStore()
+    job = mkjob()
+    store.create_jobs([job])
+    inst = store.create_instance(job.uuid, "n0", "kube")
+    store.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    kube.create_pod(Pod(name=inst.task_id, mem=100, cpus=1, node="n0",
+                        phase=PodPhase.RUNNING))
+    cluster = KubeCluster(kube)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    cluster.initialize(running_task_ids={inst.task_id})
+    assert cluster.known_task_ids() == {inst.task_id}
+    # and completion still flows through
+    kube.succeed_pod(inst.task_id)
+    assert job.success
+
+
+def test_startup_reconstruction_pod_gone():
+    """Store says running, pod is gone → externally-deleted failure."""
+    kube = FakeKube([Node("n0", mem=1000, cpus=16)])
+    store = JobStore()
+    job = mkjob()
+    store.create_jobs([job])
+    inst = store.create_instance(job.uuid, "n0", "kube")
+    store.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    cluster = KubeCluster(kube)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    cluster.initialize(running_task_ids={inst.task_id})
+    assert store.get_instance(inst.task_id).status == InstanceStatus.FAILED
+    assert store.get_instance(inst.task_id).reason_code == 5002
+
+
+def test_scan_reconciles_missed_events():
+    kube, cluster, store, coord = build()
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    tid = job.instances[0].task_id
+    kube.schedule_pending()
+    # mutate pod state directly without emitting a watch event
+    with kube._lock:
+        kube.pods[tid].phase = PodPhase.SUCCEEDED
+        kube.pods[tid].exit_code = 0
+    cluster.controller.actual[tid] = kube.pods[tid]
+    cluster.controller.scan()
+    assert job.success
