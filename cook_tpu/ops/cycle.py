@@ -62,6 +62,7 @@ def rank_and_match(
     num_groups: int = 1,
     sequential: bool = True,
     considerable_limit=None,
+    bonus=None,                # (P, H) f32 >= 0 fitness bonus (data locality)
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -156,11 +157,13 @@ def rank_and_match(
         forb = match_ops.varying_full(hosts.valid, False, (C, H), bool)
     else:
         forb = forbidden[pend_idx] & in_use[:, None]
+    bonusc = None if bonus is None else bonus[pend_idx] * in_use[:, None]
     if sequential:
-        res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups)
+        res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups,
+                                   bonus=bonusc)
     else:
         res = match_ops.match_rounds(jobs, hosts, forb, rounds=12,
-                                     num_groups=num_groups)
+                                     num_groups=num_groups, bonus=bonusc)
     # scatter back: compact -> original pending order in one scatter
     # (empty compact slots get index P and are dropped)
     scatter_idx = jnp.where(in_use, pend_idx, P)

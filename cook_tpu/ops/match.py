@@ -118,19 +118,24 @@ def _feasible(job_mem, job_cpus, job_gpus, mem_left, cpus_left, gpus_left,
 
 @functools.partial(jax.jit, static_argnames=("num_groups",))
 def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
-               num_groups: int = 1) -> MatchResult:
+               num_groups: int = 1,
+               bonus: jnp.ndarray | None = None) -> MatchResult:
     """Exact sequential greedy assignment (Fenzo semantics) as one scan.
 
     forbidden: (N, H) bool — per-(job, host) hard-constraint exclusions
     computed by cook_tpu.scheduler.constraints.
     num_groups: static upper bound on dense group ids in this batch.
+    bonus: optional (N, H) f32 >= 0 additive fitness term (the
+    data-locality fitness blend, data_locality.clj:192).
     """
     H = hosts.mem.shape[0]
     group_occ = varying_full(hosts.valid, False, (num_groups, H), bool)
+    if bonus is None:
+        bonus = varying_full(hosts.valid, 0.0, forbidden.shape, jnp.float32)
 
     def step(carry, xs):
         mem_left, cpus_left, gpus_left, slots_left, group_occ = carry
-        j_mem, j_cpus, j_gpus, j_valid, j_group, j_unique, forb = xs
+        j_mem, j_cpus, j_gpus, j_valid, j_group, j_unique, forb, bon = xs
 
         ok = _feasible(j_mem, j_cpus, j_gpus, mem_left, cpus_left, gpus_left,
                        hosts.cap_gpus, hosts.valid, slots_left, forb)
@@ -142,7 +147,7 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         ok &= j_valid
 
         fit = _fitness(j_mem, j_cpus, mem_left, cpus_left,
-                       hosts.cap_mem, hosts.cap_cpus)
+                       hosts.cap_mem, hosts.cap_cpus) + bon
         fit = jnp.where(ok, fit, -1.0)
         best = jnp.argmax(fit)
         assigned = fit[best] > -0.5
@@ -158,14 +163,15 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
 
     carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots, group_occ)
     xs = (jobs.mem, jobs.cpus, jobs.gpus, jobs.valid, jobs.group,
-          jobs.unique_group, forbidden)
+          jobs.unique_group, forbidden, bonus)
     (mem_left, cpus_left, gpus_left, _, _), job_host = jax.lax.scan(step, carry, xs)
     return MatchResult(job_host, mem_left, cpus_left, gpus_left)
 
 
 @functools.partial(jax.jit, static_argnames=("rounds", "num_groups"))
 def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
-                 rounds: int = 4, num_groups: int = 1) -> MatchResult:
+                 rounds: int = 4, num_groups: int = 1,
+                 bonus: jnp.ndarray | None = None) -> MatchResult:
     """Batched greedy approximation: all jobs bid at once, hosts accept
     the feasible prefix of their bidders in queue order, repeat.
 
@@ -194,6 +200,8 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         fit = _fitness(jobs.mem[:, None], jobs.cpus[:, None],
                        mem_left[None, :], cpus_left[None, :],
                        hosts.cap_mem[None, :], hosts.cap_cpus[None, :])
+        if bonus is not None:
+            fit = fit + bonus
         fit = jnp.where(ok, fit, -1.0)
         choice = jnp.argmax(fit, axis=1)
         bids = fit[rank, choice] > -0.5  # job has any feasible host

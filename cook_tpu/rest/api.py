@@ -126,7 +126,7 @@ class CookApi:
                  pools=None, auth: Optional[AuthConfig] = None,
                  task_constraints: Optional[TaskConstraints] = None,
                  submission_rate_limiter=None, settings: Optional[dict] = None,
-                 leader_url: str = ""):
+                 leader_url: str = "", plugins=None):
         self.store = store
         self.coord = coordinator
         self.shares = shares if shares is not None else \
@@ -138,6 +138,8 @@ class CookApi:
         self.auth = auth or AuthConfig()
         self.tc = task_constraints or TaskConstraints()
         self.submit_rl = submission_rate_limiter
+        self.plugins = plugins if plugins is not None else \
+            getattr(coordinator, "plugins", None)
         self.settings = settings or {}
         self.leader_url = leader_url
         self.started_ms = now_ms()
@@ -207,6 +209,21 @@ class CookApi:
             raise ApiError(429, "User submission rate limit exceeded")
 
         pool_name = body.get("pool")
+        # submission-validator + pool-selector plugins
+        # (plugins/submission.clj, plugins/pool.clj)
+        if self.plugins is not None:
+            for spec in body["jobs"]:
+                status = self.plugins.submission.check_job_submission(
+                    spec, req.user, pool_name)
+                if status.status == "reject":
+                    raise ApiError(400, f"submission rejected by plugin: "
+                                        f"{status.message}")
+            if pool_name is None and body["jobs"]:
+                default = self.pools.default_pool if self.pools else "default"
+                selected = {self.plugins.pool_selector.select_pool(s, default)
+                            for s in body["jobs"]}
+                if len(selected) == 1 and selected != {default}:
+                    pool_name = selected.pop()
         if self.pools is not None:
             if pool_name and self.pools.get(pool_name).name != pool_name:
                 raise ApiError(400, f"pool {pool_name} does not exist")

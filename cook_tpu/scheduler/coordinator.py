@@ -82,7 +82,8 @@ class Coordinator:
                  config: Optional[SchedulerConfig] = None,
                  launch_rate_limiter: Optional[RateLimiter] = None,
                  user_launch_rate_limiter: Optional[RateLimiter] = None,
-                 progress_aggregator=None, heartbeats=None):
+                 progress_aggregator=None, heartbeats=None,
+                 plugins=None, data_locality=None):
         self.store = store
         self.clusters = clusters
         self.shares = shares or ShareStore()
@@ -103,6 +104,8 @@ class Coordinator:
         self.metrics: dict[str, float] = {}
         self.progress_aggregator = progress_aggregator
         self.heartbeats = heartbeats
+        self.plugins = plugins
+        self.data_locality = data_locality
         for cluster in clusters.all():
             cluster.set_status_callback(self._on_status)
 
@@ -111,9 +114,17 @@ class Coordinator:
                    reason: Optional[int], exit_code: Optional[int] = None,
                    sandbox: Optional[str] = None) -> None:
         preempted = reason in (2000, 2003)
-        self.store.update_instance(task_id, status, reason_code=reason,
-                                   preempted=preempted, exit_code=exit_code,
-                                   sandbox=sandbox)
+        job = self.store.update_instance(
+            task_id, status, reason_code=reason, preempted=preempted,
+            exit_code=exit_code, sandbox=sandbox)
+        # completion plugin (write-status path, scheduler.clj:305-316)
+        if self.plugins is not None and job is not None and \
+                status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
+            inst = self.store.get_instance(task_id)
+            try:
+                self.plugins.completion.on_instance_completion(job, inst)
+            except Exception:
+                log.exception("completion plugin failed")
         # a launched job's reservation is spent
         job_uuid = self.store.task_to_job.get(task_id)
         if job_uuid and job_uuid in self.reservations and \
@@ -156,6 +167,10 @@ class Coordinator:
                    if self.user_launch_rl.would_allow(j.user)]
         if not self.launch_rl.would_allow("global"):
             pending = []
+        # launch-filter plugin with age-out cache (plugins/launch.clj)
+        if self.plugins is not None and pending:
+            pending = [j for j in pending if self.plugins.launch.check(j)]
+            pending = [self.plugins.adjuster.adjust_job(j) for j in pending]
         if not pending:
             stats.cycle_ms = (time.perf_counter() - t0) * 1e3
             return stats
@@ -192,6 +207,13 @@ class Coordinator:
         forbidden[:, len(offers):] = True
         qm, qc, qn = quota_arrays(self.quotas, self.interner, pool)
 
+        # data-locality fitness bonus (data_locality.clj blend)
+        bonus = None
+        if self.data_locality is not None:
+            self.data_locality.update(pending)
+            bonus = self.data_locality.bonus_matrix(
+                pending, host_names, jb.user.shape[0], H)
+
         C = min(bucket(self.config.max_jobs_considered), jb.user.shape[0])
         res = cycle_ops.rank_and_match(
             tb.user, tb.mem, tb.cpus, tb.priority, tb.start_time, tb.valid,
@@ -201,7 +223,7 @@ class Coordinator:
             hosts, forbidden, qm, qc, qn,
             num_considerable=C, num_groups=jb.num_groups,
             sequential=C <= self.config.sequential_match_threshold,
-            considerable_limit=num_considerable)
+            considerable_limit=num_considerable, bonus=bonus)
 
         job_host = np.asarray(res.job_host)
         considerable = np.asarray(res.considerable)

@@ -1,0 +1,77 @@
+"""Pluggable optimizer hook.
+
+Equivalent of cook.scheduler.optimizer (optimizer.clj): a periodic
+cycle that feeds (queue, running, offers, purchasable-host catalog) to
+a pluggable Optimizer and records the suggested Schedule.  The default
+implementations are no-ops, as in the reference (dummy impls
+optimizer.clj:44-66); the coordinator consumes the step-0 suggestions
+as scheduling hints and the autoscaler may consume host purchases.
+Docs: reference scheduler/docs/optimizer.md.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class HostType:
+    """A purchasable host shape (HostFeed, optimizer.clj:33-42)."""
+
+    name: str
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    count: int = 0
+
+
+class HostFeed:
+    """get-available-host-info (optimizer.clj:33)."""
+
+    def available_hosts(self) -> list[HostType]:
+        return []
+
+
+class Optimizer:
+    """produce-schedule (optimizer.clj:57-66): returns
+    {step-seconds: {"suggested-matches": {host-type: [job uuids]},
+                    "suggested-purchases": {host-type: count}}}."""
+
+    def produce_schedule(self, queue, running, offers,
+                         host_types: list[HostType]) -> dict:
+        return {0: {"suggested-matches": {}, "suggested-purchases": {}}}
+
+
+@dataclass
+class OptimizerCycle:
+    """optimizer-cycle! / start-optimizer-cycles! (optimizer.clj:90-134)."""
+
+    store: object
+    clusters: object
+    optimizer: Optimizer = field(default_factory=Optimizer)
+    host_feed: HostFeed = field(default_factory=HostFeed)
+    interval_s: float = 30.0
+    last_schedule: dict = field(default_factory=dict)
+
+    def cycle(self, pool: Optional[str] = None) -> dict:
+        queue = self.store.pending_jobs(pool)
+        running = self.store.running_jobs(pool)
+        offers = []
+        for cluster in self.clusters.all():
+            offers.extend(cluster.pending_offers(
+                pool or "default"))
+        try:
+            schedule = self.optimizer.produce_schedule(
+                queue, running, offers, self.host_feed.available_hosts())
+        except Exception:
+            log.exception("optimizer cycle failed")
+            return self.last_schedule
+        self.last_schedule = schedule
+        return schedule
+
+    def step_zero_matches(self) -> dict:
+        return self.last_schedule.get(0, {}).get("suggested-matches", {})

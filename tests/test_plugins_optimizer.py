@@ -1,0 +1,256 @@
+"""Plugins (submission/launch/completion/pool/adjuster), optimizer hook,
+and data-locality fitness blending.
+
+Mirrors the reference's plugins test coverage + data_locality.clj tests
+(DataLocalFitnessCalculator blending, batched cost updates).
+"""
+import numpy as np
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.plugins import (ACCEPT, CachedLaunchFilter, CompletionHandler,
+                              LaunchFilter, PluginRegistry, PoolSelector,
+                              SubmissionValidator, accepted, deferred,
+                              rejected, resolve_plugin)
+from cook_tpu.rest.api import CookApi
+from cook_tpu.rest.auth import AuthConfig
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.scheduler.data_locality import DataLocalityCosts
+from cook_tpu.scheduler.optimizer import (HostFeed, HostType, Optimizer,
+                                          OptimizerCycle)
+from cook_tpu.state.model import InstanceStatus, Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+
+
+def mkjob(user="alice", mem=100, cpus=1, **kw):
+    return Job(uuid=new_uuid(), user=user, command="true", mem=mem,
+               cpus=cpus, **kw)
+
+
+def build(plugins=None, data_locality=None, hosts=None):
+    store = JobStore()
+    cluster = MockCluster(hosts or [MockHost("h0", mem=1000, cpus=16),
+                                    MockHost("h1", mem=1000, cpus=16)])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, plugins=plugins,
+                        data_locality=data_locality)
+    return store, cluster, coord
+
+
+# -- submission validator / pool selector ------------------------------
+class NoProdValidator(SubmissionValidator):
+    def check_job_submission(self, spec, user, pool):
+        if "prod" in spec.get("name", ""):
+            return rejected("prod jobs forbidden here")
+        return accepted()
+
+
+class LabelPoolSelector(PoolSelector):
+    def select_pool(self, spec, default):
+        return spec.get("labels", {}).get("pool", default)
+
+
+def test_submission_validator_rejects():
+    store, _, coord = build(plugins=PluginRegistry(
+        submission=NoProdValidator()))
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header"))
+    resp = api.handle("POST", "/jobs", {}, {
+        "jobs": [{"command": "x", "mem": 10, "cpus": 1,
+                  "name": "prod-thing"}]}, {"x-cook-user": "alice"})
+    assert resp.status == 400 and "forbidden" in str(resp.body)
+    resp = api.handle("POST", "/jobs", {}, {
+        "jobs": [{"command": "x", "mem": 10, "cpus": 1,
+                  "name": "dev-thing"}]}, {"x-cook-user": "alice"})
+    assert resp.status == 201
+
+
+def test_pool_selector_plugin():
+    from cook_tpu.state.pools import Pool
+    store, _, coord = build(plugins=PluginRegistry(
+        pool_selector=LabelPoolSelector()))
+    coord.pools.add(Pool(name="batch"))
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header"))
+    resp = api.handle("POST", "/jobs", {}, {
+        "jobs": [{"command": "x", "mem": 10, "cpus": 1,
+                  "labels": {"pool": "batch"}}]},
+        {"x-cook-user": "alice"})
+    assert resp.status == 201
+    assert store.get_job(resp.body["jobs"][0]).pool == "batch"
+
+
+# -- launch filter -----------------------------------------------------
+class DeferOnce(LaunchFilter):
+    def __init__(self):
+        self.calls = 0
+
+    def check_job_launch(self, job):
+        self.calls += 1
+        if self.calls == 1:
+            return deferred("not yet", for_s=0.05)
+        return accepted()
+
+
+def test_launch_filter_defer_then_accept():
+    inner = DeferOnce()
+    plugins = PluginRegistry(launch=CachedLaunchFilter(inner))
+    store, cluster, coord = build(plugins=plugins)
+    job = mkjob()
+    store.create_jobs([job])
+    assert coord.match_cycle().matched == 0        # deferred
+    import time
+    time.sleep(0.06)                               # cache expires
+    assert coord.match_cycle().matched == 1
+    assert inner.calls == 2                        # cached between cycles
+
+
+def test_launch_filter_age_out():
+    class AlwaysDefer(LaunchFilter):
+        def check_job_launch(self, job):
+            return deferred("never", for_s=0.01)
+
+    clock = [0.0]
+    filt = CachedLaunchFilter(AlwaysDefer(), age_out_s=100.0,
+                              clock=lambda: clock[0])
+    job = mkjob()
+    assert filt.check(job) is False
+    clock[0] = 101.0
+    assert filt.check(job) is True                 # aged out: force accept
+
+
+# -- completion handler ------------------------------------------------
+def test_completion_plugin_invoked():
+    calls = []
+
+    class Recorder(CompletionHandler):
+        def on_instance_completion(self, job, inst):
+            calls.append((job.uuid, inst.status))
+
+    store, cluster, coord = build(plugins=PluginRegistry(
+        completion=Recorder()))
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    cluster.advance(120)
+    assert calls == [(job.uuid, InstanceStatus.SUCCESS)]
+
+
+# -- job adjuster ------------------------------------------------------
+def test_job_adjuster():
+    from cook_tpu.plugins import JobAdjuster
+
+    class MemPadder(JobAdjuster):
+        def adjust_job(self, job):
+            job.mem = job.mem * 2
+            return job
+
+    store, cluster, coord = build(plugins=PluginRegistry(
+        adjuster=MemPadder()))
+    job = mkjob(mem=300)
+    store.create_jobs([job])
+    coord.match_cycle()
+    cluster.advance(1)
+    offers = cluster.pending_offers("default")
+    # 600 MB claimed on the chosen host
+    assert min(o.mem for o in offers) == 400
+
+
+# -- plugin resolution -------------------------------------------------
+def create():  # factory used by resolve_plugin below
+    return NoProdValidator()
+
+
+def test_resolve_plugin_factory():
+    obj = resolve_plugin("tests.test_plugins_optimizer:create")
+    assert isinstance(obj, NoProdValidator)
+
+
+# -- optimizer ---------------------------------------------------------
+def test_optimizer_cycle():
+    class CountingOptimizer(Optimizer):
+        def __init__(self):
+            self.seen = None
+
+        def produce_schedule(self, queue, running, offers, host_types):
+            self.seen = (len(queue), len(running), len(offers),
+                         len(host_types))
+            return {0: {"suggested-matches": {"big": [q.uuid
+                                                      for q in queue]},
+                        "suggested-purchases": {"big": 2}}}
+
+    class StaticFeed(HostFeed):
+        def available_hosts(self):
+            return [HostType("big", mem=10000, cpus=64, count=5)]
+
+    store, cluster, coord = build()
+    store.create_jobs([mkjob(), mkjob()])
+    opt = CountingOptimizer()
+    cyc = OptimizerCycle(store=store, clusters=coord.clusters,
+                         optimizer=opt, host_feed=StaticFeed())
+    schedule = cyc.cycle()
+    assert opt.seen == (2, 0, 2, 1)
+    assert len(cyc.step_zero_matches()["big"]) == 2
+
+
+def test_optimizer_failure_keeps_last_schedule():
+    class Boom(Optimizer):
+        def produce_schedule(self, *a):
+            raise RuntimeError("boom")
+
+    store, cluster, coord = build()
+    cyc = OptimizerCycle(store=store, clusters=coord.clusters,
+                         optimizer=Boom())
+    cyc.last_schedule = {0: {"suggested-matches": {"x": []}}}
+    assert cyc.cycle() == {0: {"suggested-matches": {"x": []}}}
+
+
+# -- data locality -----------------------------------------------------
+def test_data_locality_steers_placement():
+    """Two identical hosts; the job's data lives on h1 → it must land
+    there despite identical bin-packing fitness."""
+    costs = {"h0": 1.0, "h1": 0.0}
+    job = mkjob(datasets=[{"dataset": {"bucket": "b"}}])
+    dl = DataLocalityCosts(fetcher=lambda uuids: {u: costs for u in uuids},
+                           weight=0.5)
+    store, cluster, coord = build(data_locality=dl)
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert job.instances[0].hostname == "h1"
+
+
+def test_data_locality_cache_and_batching():
+    fetches = []
+    dl = DataLocalityCosts(
+        fetcher=lambda uuids: fetches.append(list(uuids)) or
+        {u: {"h0": 0.2} for u in uuids},
+        batch_size=2, cache_ttl_s=1000)
+    jobs = [mkjob(datasets=[{"d": i}]) for i in range(5)]
+    assert dl.update(jobs) == 5
+    assert [len(b) for b in fetches] == [2, 2, 1]
+    # second update: everything cached
+    assert dl.update(jobs) == 0
+
+
+def test_data_locality_no_costs_returns_none():
+    dl = DataLocalityCosts(fetcher=None)
+    assert dl.bonus_matrix([mkjob()], ["h0"], 4, 4) is None
+
+
+def test_fetcher_failure_keeps_stale_costs():
+    calls = [0]
+
+    def fetcher(uuids):
+        calls[0] += 1
+        if calls[0] > 1:
+            raise RuntimeError("cost service down")
+        return {u: {"h0": 0.1} for u in uuids}
+
+    dl = DataLocalityCosts(fetcher=fetcher, cache_ttl_s=0.0)
+    job = mkjob(datasets=[{"d": 1}])
+    dl.update([job])
+    assert dl.get_costs(job.uuid) == {"h0": 0.1}
+    dl.update([job])  # fails; stale data kept
+    assert dl.get_costs(job.uuid) == {"h0": 0.1}
