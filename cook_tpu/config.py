@@ -1,0 +1,178 @@
+"""Configuration system: one file → validated settings tree.
+
+Equivalent of cook.config (config.clj:134-469 config-settings plumbing
+graph): every knob has a default, validation happens up front with
+actionable errors, and the assembled server consumes only this tree.
+JSON instead of EDN; the same keys drive `python -m
+cook_tpu.rest.server --config`.
+
+Runtime-tunable knobs (rebalancer params, mea-culpa limits) follow the
+reference's pattern of living in the durable store rather than here
+(rebalancer.clj:520-542) — SchedulerConfig.rebalancer holds the boot
+defaults.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class ClusterSettings:
+    kind: str = "mock"            # mock | local | kube
+    name: str = "mock"
+    pool: str = "default"
+    hosts: int = 4                # mock: number of hosts
+    host_mem: float = 32_768.0
+    host_cpus: float = 16.0
+    host_gpus: float = 0.0
+    sandbox_root: str = "/tmp/cook_tpu_sandboxes"   # local
+    file_server_port: int = 12322                   # local
+    max_synthetic_pods: int = 30                    # kube
+
+    def validate(self) -> None:
+        if self.kind not in ("mock", "local", "kube"):
+            raise ConfigError(f"unknown cluster kind {self.kind!r}")
+        if self.hosts < 0 or self.host_mem <= 0 or self.host_cpus <= 0:
+            raise ConfigError(f"cluster {self.name}: invalid host shape")
+
+
+@dataclass
+class PoolSettings:
+    name: str
+    purpose: str = ""
+    dru_mode: str = "default"     # default | gpu
+
+    def validate(self) -> None:
+        if self.dru_mode not in ("default", "gpu"):
+            raise ConfigError(f"pool {self.name}: dru_mode must be "
+                              "default|gpu")
+
+
+@dataclass
+class RateLimitSettings:
+    tokens_per_sec: float = float("inf")
+    max_tokens: float = float("inf")
+    enforce: bool = False
+
+
+@dataclass
+class AuthSettings:
+    scheme: str = "one-user"      # one-user | basic | header
+    one_user: str = "root"
+    admins: list = field(default_factory=list)
+    imposters: list = field(default_factory=list)
+    authorization: str = "configfile-admins-auth"
+    cors_origins: list = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.scheme not in ("one-user", "basic", "header"):
+            raise ConfigError(f"unknown auth scheme {self.scheme!r}")
+
+
+@dataclass
+class SchedulerSettings:
+    max_jobs_considered: int = 1024     # fenzo-max-jobs-considered
+    scaleback: float = 0.95
+    match_interval_s: float = 1.0
+    rank_interval_s: float = 5.0
+    rebalancer_interval_s: float = 300.0
+    rebalancer_safe_dru_threshold: float = 1.0
+    rebalancer_min_dru_diff: float = 0.5
+    rebalancer_max_preemption: int = 64
+    sequential_match_threshold: int = 2048
+
+    def validate(self) -> None:
+        if self.max_jobs_considered < 1:
+            raise ConfigError("max_jobs_considered must be >= 1")
+        if not 0 < self.scaleback <= 1:
+            raise ConfigError("scaleback must be in (0, 1]")
+
+
+@dataclass
+class TaskConstraintSettings:
+    max_mem_mb: float = 256 * 1024
+    max_cpus: float = 128
+    max_gpus: float = 8
+    max_retries: int = 1000
+
+
+@dataclass
+class Settings:
+    port: int = 12321
+    default_pool: str = "default"
+    pools: list = field(default_factory=list)          # [PoolSettings]
+    clusters: list = field(default_factory=lambda: [ClusterSettings()])
+    scheduler: SchedulerSettings = field(default_factory=SchedulerSettings)
+    auth: AuthSettings = field(default_factory=AuthSettings)
+    task_constraints: TaskConstraintSettings = field(
+        default_factory=TaskConstraintSettings)
+    rate_limits: dict = field(default_factory=dict)
+    # {user_submit|user_launch|global_launch: RateLimitSettings}
+    log_path: Optional[str] = None
+    snapshot_path: Optional[str] = None
+    leader_lock_path: Optional[str] = None   # None = standalone leader
+    url: str = ""                             # published leader URL
+    metrics_jsonl: Optional[str] = None
+    metrics_interval_s: float = 60.0
+    plugins: dict = field(default_factory=dict)
+    data_locality: dict = field(default_factory=dict)
+    # {fetcher: "pkg.mod:factory", weight: 0.25, batch_size: 500}
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Settings":
+        known = set(cls.__dataclass_fields__)
+        unknown = set(raw) - known
+        if unknown:
+            raise ConfigError(f"unknown config keys: {sorted(unknown)}")
+        s = cls(**{k: v for k, v in raw.items()
+                   if k not in ("pools", "clusters", "scheduler", "auth",
+                                "task_constraints", "rate_limits")})
+        s.pools = [PoolSettings(**p) for p in raw.get("pools", [])]
+        s.clusters = [ClusterSettings(**c) for c in
+                      raw.get("clusters", [asdict(ClusterSettings())])]
+        s.scheduler = SchedulerSettings(**raw.get("scheduler", {}))
+        s.auth = AuthSettings(**raw.get("auth", {}))
+        s.task_constraints = TaskConstraintSettings(
+            **raw.get("task_constraints", {}))
+        s.rate_limits = {k: RateLimitSettings(**v)
+                         for k, v in raw.get("rate_limits", {}).items()}
+        s.validate()
+        return s
+
+    @classmethod
+    def from_file(cls, path: str) -> "Settings":
+        with open(path) as f:
+            try:
+                raw = json.load(f)
+            except ValueError as e:
+                raise ConfigError(f"malformed config {path}: {e}")
+        return cls.from_dict(raw)
+
+    def validate(self) -> None:
+        if not 0 < self.port < 65536:
+            raise ConfigError(f"invalid port {self.port}")
+        for p in self.pools:
+            p.validate()
+        names = [c.name for c in self.clusters]
+        if len(names) != len(set(names)):
+            raise ConfigError("duplicate cluster names")
+        for c in self.clusters:
+            c.validate()
+        self.scheduler.validate()
+        self.auth.validate()
+        for key in self.rate_limits:
+            if key not in ("user_submit", "user_launch", "global_launch"):
+                raise ConfigError(f"unknown rate limit {key!r}")
+
+    def public(self) -> dict:
+        """Sanitized view for GET /settings (no secrets)."""
+        d = asdict(self)
+        d.pop("plugins", None)
+        d["auth"] = {"scheme": self.auth.scheme}
+        return d

@@ -691,12 +691,19 @@ class CookApi:
                               for j in jobs[:limit]])
 
     def get_info(self, req: Request) -> Response:
+        elector = getattr(self, "leader_elector", None)
+        leader_url = self.leader_url
+        is_leader = True
+        if elector is not None:
+            leader_url = elector.current_leader() or leader_url
+            is_leader = elector.is_leader()
         return Response(200, {
             "authentication-scheme": self.auth.scheme,
             "commit": VERSION,
             "version": VERSION,
             "start-time": self.started_ms,
-            "leader-url": self.leader_url,
+            "leader-url": leader_url,
+            "is-leader": is_leader,
         })
 
     def get_debug(self, req: Request) -> Response:

@@ -112,71 +112,118 @@ class ApiServer:
         self.httpd.server_close()
 
 
-def build_scheduler(config: dict):
-    """Assemble a full single-process scheduler from a config dict (the
-    components.clj scheduler-server graph equivalent)."""
+def build_scheduler(config):
+    """Assemble a full single-process scheduler from a Settings tree or
+    raw config dict (the components.clj scheduler-server graph
+    equivalent)."""
     from cook_tpu.backends.base import ClusterRegistry
     from cook_tpu.backends.mock import MockCluster, MockHost
-    from cook_tpu.scheduler.coordinator import Coordinator, SchedulerConfig
-    from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
-    from cook_tpu.state.pools import Pool, PoolRegistry
-    from cook_tpu.state.store import JobStore
-
+    from cook_tpu.config import Settings
+    from cook_tpu.plugins import PluginRegistry, registry_from_config
+    from cook_tpu.rest.auth import AuthConfig
+    from cook_tpu.rest.api import TaskConstraints
+    from cook_tpu.scheduler.coordinator import (Coordinator,
+                                                RebalancerParams,
+                                                SchedulerConfig)
+    from cook_tpu.scheduler.data_locality import DataLocalityCosts
     from cook_tpu.scheduler.heartbeat import HeartbeatWatcher
+    from cook_tpu.scheduler.monitor import StatsMonitor
     from cook_tpu.scheduler.progress import ProgressAggregator
+    from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
+    from cook_tpu.state.pools import DruMode, Pool, PoolRegistry
+    from cook_tpu.state.store import JobStore
+    from cook_tpu.utils import metrics as metrics_mod
 
-    store = JobStore.restore(config.get("snapshot_path"),
-                             log_path=config.get("log_path"))
-    pools = PoolRegistry(config.get("default_pool", "default"))
-    for p in config.get("pools", []):
-        pools.add(Pool(name=p["name"], purpose=p.get("purpose", "")))
+    if isinstance(config, dict):
+        config = Settings.from_dict(config)
+
+    store = JobStore.restore(config.snapshot_path,
+                             log_path=config.log_path)
+    pools = PoolRegistry(config.default_pool)
+    for p in config.pools:
+        pools.add(Pool(name=p.name, purpose=p.purpose,
+                       dru_mode=DruMode(p.dru_mode)))
     progress = ProgressAggregator(store)
     heartbeats = HeartbeatWatcher(store)
     clusters = ClusterRegistry()
-    for c in config.get("clusters", [{"kind": "mock", "name": "mock",
-                                      "hosts": 4}]):
-        if c.get("kind") == "local":
+    for c in config.clusters:
+        if c.kind == "local":
             from cook_tpu.backends.local import LocalCluster
             clusters.register(LocalCluster(
-                sandbox_root=c.get("sandbox_root", "/tmp/cook_tpu_sandboxes"),
-                name=c.get("name", "local"),
-                mem=float(c.get("host_mem", 8192)),
-                cpus=float(c.get("host_cpus", 8)),
-                pool=c.get("pool", pools.default_pool),
-                file_server_port=int(c.get("file_server_port", 12322)),
+                sandbox_root=c.sandbox_root, name=c.name,
+                mem=c.host_mem, cpus=c.host_cpus, pool=c.pool,
+                file_server_port=c.file_server_port,
                 progress_aggregator=progress, heartbeats=heartbeats))
-        elif c.get("kind", "mock") == "mock":
-            name = c.get("name", "mock")
-            hosts = [MockHost(hostname=f"{name}-host-{i}",
-                              mem=float(c.get("host_mem", 32_768)),
-                              cpus=float(c.get("host_cpus", 16)),
-                              gpus=float(c.get("host_gpus", 0)),
-                              pool=c.get("pool", pools.default_pool))
-                     for i in range(int(c.get("hosts", 4)))]
-            clusters.register(MockCluster(hosts, name=name))
+        elif c.kind == "kube":
+            from cook_tpu.backends.kube import FakeKube, KubeCluster, Node
+            kube = FakeKube([Node(f"{c.name}-n{i}", mem=c.host_mem,
+                                  cpus=c.host_cpus, gpus=c.host_gpus,
+                                  pool=c.pool)
+                             for i in range(c.hosts)])
+            clusters.register(KubeCluster(
+                kube, name=c.name, max_synthetic_pods=c.max_synthetic_pods))
         else:
-            raise ValueError(f"unknown cluster kind {c.get('kind')}")
-    rl_cfg = config.get("rate_limits", {})
+            hosts = [MockHost(hostname=f"{c.name}-host-{i}",
+                              mem=c.host_mem, cpus=c.host_cpus,
+                              gpus=c.host_gpus, pool=c.pool)
+                     for i in range(c.hosts)]
+            clusters.register(MockCluster(hosts, name=c.name))
+
+    def make_rl(key):
+        rl = config.rate_limits.get(key)
+        if rl is None:
+            return RateLimiter(enforce=False)
+        return RateLimiter(tokens_per_sec=rl.tokens_per_sec,
+                           max_tokens=rl.max_tokens, enforce=rl.enforce)
+
+    plugins = registry_from_config(config.plugins) if config.plugins \
+        else PluginRegistry()
+    data_locality = None
+    if config.data_locality.get("fetcher"):
+        from cook_tpu.plugins import resolve_plugin
+        data_locality = DataLocalityCosts(
+            fetcher=resolve_plugin(config.data_locality["fetcher"]),
+            weight=float(config.data_locality.get("weight", 0.25)),
+            batch_size=int(config.data_locality.get("batch_size", 500)))
+
+    s = config.scheduler
     coord = Coordinator(
         store, clusters,
         shares=ShareStore(), quotas=QuotaStore(), pools=pools,
-        config=SchedulerConfig(**config.get("scheduler", {})),
-        launch_rate_limiter=RateLimiter(
-            **rl_cfg.get("global_launch", {"enforce": False})),
-        user_launch_rate_limiter=RateLimiter(
-            **rl_cfg.get("user_launch", {"enforce": False})),
-        progress_aggregator=progress, heartbeats=heartbeats)
-    submit_rl = RateLimiter(**rl_cfg.get("user_submit", {"enforce": False}))
-    api = CookApi(store, coordinator=coord,
-                  submission_rate_limiter=submit_rl,
-                  settings=_public_settings(config))
+        config=SchedulerConfig(
+            max_jobs_considered=s.max_jobs_considered,
+            scaleback=s.scaleback,
+            match_interval_s=s.match_interval_s,
+            rank_interval_s=s.rank_interval_s,
+            rebalancer_interval_s=s.rebalancer_interval_s,
+            rebalancer=RebalancerParams(
+                safe_dru_threshold=s.rebalancer_safe_dru_threshold,
+                min_dru_diff=s.rebalancer_min_dru_diff,
+                max_preemption=s.rebalancer_max_preemption),
+            sequential_match_threshold=s.sequential_match_threshold),
+        launch_rate_limiter=make_rl("global_launch"),
+        user_launch_rate_limiter=make_rl("user_launch"),
+        progress_aggregator=progress, heartbeats=heartbeats,
+        plugins=plugins, data_locality=data_locality)
+
+    monitor = StatsMonitor(store, coord.shares, metrics_mod.registry)
+    api = CookApi(
+        store, coordinator=coord,
+        auth=AuthConfig(scheme=config.auth.scheme,
+                        one_user=config.auth.one_user,
+                        admins=set(config.auth.admins),
+                        imposters=set(config.auth.imposters),
+                        authorization=config.auth.authorization,
+                        cors_origins=list(config.auth.cors_origins)),
+        task_constraints=TaskConstraints(
+            max_mem_mb=config.task_constraints.max_mem_mb,
+            max_cpus=config.task_constraints.max_cpus,
+            max_gpus=config.task_constraints.max_gpus,
+            max_retries=config.task_constraints.max_retries),
+        submission_rate_limiter=make_rl("user_submit"),
+        settings=config.public(), leader_url=config.url)
+    coord.monitor = monitor
     return store, coord, api
-
-
-def _public_settings(config: dict) -> dict:
-    """Sanitized config for GET /settings."""
-    return {k: v for k, v in config.items()
-            if k not in ("auth", "secrets")}
 
 
 def main(argv=None) -> None:
@@ -194,31 +241,68 @@ def main(argv=None) -> None:
     if os.environ.get("JAX_PLATFORMS"):
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    config = {}
-    if args.config:
-        with open(args.config) as f:
-            config = json.load(f)
-    store, coord, api = build_scheduler(config)
-    if not args.no_cycles:
+    from cook_tpu.config import Settings
+    from cook_tpu.scheduler.leader import (FileLeaderElector,
+                                           StandaloneElector)
+    from cook_tpu.utils.metrics import JsonlReporter, registry
+
+    settings = Settings.from_file(args.config) if args.config else Settings()
+    if args.port != 12321:
+        settings.port = args.port
+    settings.url = settings.url or f"http://127.0.0.1:{settings.port}"
+    store, coord, api = build_scheduler(settings)
+    api.leader_url = settings.url
+
+    def on_leadership():
+        """The takeLeadership path (mesos.clj:153-223): start backends,
+        scheduling cycles, monitors."""
         for cluster in coord.clusters.all():
             cluster.initialize()
         coord.run()
-        # drive any mock clusters' virtual clocks in real time
-        def tick():
+
+        def tick():  # real-time driver for mock virtual clocks + monitor
             while True:
                 time.sleep(1.0)
                 for cluster in coord.clusters.all():
                     if hasattr(cluster, "advance"):
                         cluster.advance(1.0)
+
         threading.Thread(target=tick, daemon=True).start()
-    server = ApiServer(api, port=args.port).start()
-    log.info("cook_tpu scheduler listening on %s", server.url)
+
+        def monitor_loop():
+            while True:
+                time.sleep(settings.metrics_interval_s)
+                try:
+                    for p in coord.pools.active():
+                        coord.monitor.collect(p.name)
+                except Exception:
+                    log.exception("stats monitor failed")
+
+        threading.Thread(target=monitor_loop, daemon=True).start()
+
+    if args.no_cycles:
+        elector = StandaloneElector(settings.url)
+    elif settings.leader_lock_path:
+        elector = FileLeaderElector(settings.leader_lock_path, settings.url)
+        elector.start(on_leadership)
+    else:
+        elector = StandaloneElector(settings.url)
+        elector.start(on_leadership)
+    api.leader_elector = elector
+
+    if settings.metrics_jsonl:
+        JsonlReporter(registry, settings.metrics_jsonl,
+                      interval_s=settings.metrics_interval_s).start()
+    server = ApiServer(api, port=settings.port).start()
+    log.info("cook_tpu scheduler listening on %s (leader=%s)", server.url,
+             elector.is_leader())
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.stop()
         coord.stop()
+        elector.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,155 @@
+"""HA leader election: single active scheduler, API-only standbys.
+
+Equivalent of cook.mesos/start-leader-selector (mesos.clj:111-270,
+Curator LeaderSelector on ZooKeeper):
+  - candidates race for a lease; exactly one wins;
+  - the winner publishes its URL so standby API nodes can redirect
+    (leader-url, cook-info-handler);
+  - on leadership loss the process SUICIDES (System/exit) so supervisor
+    restart is the only recovery path (mesos.clj:247-261) — partial
+    in-memory state is never trusted;
+  - non-leaders can serve the read API only (components.clj:101-105).
+
+The elector protocol is pluggable like the reference's curator layer;
+FileLeaderElector implements it with an fcntl file lock + a lease file
+naming the current leader (single-host / shared-filesystem HA).  A
+ZK/etcd/k8s-Lease elector drops into the same interface.
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class LeaderElector:
+    def start(self, on_leadership: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+    def is_leader(self) -> bool:
+        raise NotImplementedError
+
+    def current_leader(self) -> Optional[str]:
+        """The published leader URL (for /info and redirects)."""
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        pass
+
+
+class StandaloneElector(LeaderElector):
+    """No-HA mode: immediately leader (single-instance deploys)."""
+
+    def __init__(self, url: str = ""):
+        self.url = url
+        self._leader = False
+
+    def start(self, on_leadership) -> None:
+        self._leader = True
+        on_leadership()
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def current_leader(self) -> Optional[str]:
+        return self.url
+
+
+class FileLeaderElector(LeaderElector):
+    """flock-based elector. The lock file IS the lease: holding the
+    exclusive lock means leadership; its JSON body names the leader.
+
+    on_loss: by default calls os._exit(1) — the reference's deliberate
+    suicide — override in tests."""
+
+    def __init__(self, path: str, url: str,
+                 retry_interval_s: float = 1.0,
+                 on_loss: Optional[Callable[[], None]] = None):
+        self.path = path
+        self.url = url
+        self.retry_interval_s = retry_interval_s
+        self.on_loss = on_loss or self._suicide
+        self._fd: Optional[int] = None
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _suicide() -> None:
+        log.error("leadership lost — exiting so the supervisor restarts "
+                  "us from durable state")
+        os._exit(1)
+
+    def start(self, on_leadership: Callable[[], None]) -> None:
+        def campaign():
+            while not self._stop.is_set():
+                fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    os.close(fd)
+                    self._stop.wait(self.retry_interval_s)
+                    continue
+                # we are the leader: publish and hand off
+                os.ftruncate(fd, 0)
+                os.write(fd, json.dumps({"url": self.url,
+                                         "pid": os.getpid(),
+                                         "since": time.time()}).encode())
+                os.fsync(fd)
+                self._fd = fd
+                self._leader = True
+                log.info("acquired leadership (%s)", self.path)
+                try:
+                    on_leadership()
+                except Exception:
+                    log.exception("on_leadership failed")
+                    self._release()
+                    self.on_loss()
+                    return
+                # hold until stopped; watch for lease-file deletion
+                # (the ZK-session-expired analog)
+                while not self._stop.wait(self.retry_interval_s):
+                    try:
+                        if os.stat(self.path).st_ino != os.fstat(fd).st_ino:
+                            raise OSError("lease file replaced")
+                    except OSError:
+                        self._release()
+                        self.on_loss()
+                        return
+                self._release()
+                return
+        self._thread = threading.Thread(target=campaign, daemon=True)
+        self._thread.start()
+
+    def _release(self) -> None:
+        self._leader = False
+        if self._fd is not None:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def current_leader(self) -> Optional[str]:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            return data.get("url")
+        except (OSError, ValueError):
+            return None
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+        self._release()

@@ -1,0 +1,217 @@
+"""Config validation, leader election, metrics registry, stats monitor,
+and the Settings-driven server assembly.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cook_tpu.config import ConfigError, Settings
+from cook_tpu.scheduler.leader import FileLeaderElector, StandaloneElector
+from cook_tpu.scheduler.monitor import StatsMonitor, starved_stats
+from cook_tpu.state.limits import ShareStore
+from cook_tpu.state.model import Job, new_uuid
+from cook_tpu.state.store import JobStore
+from cook_tpu.utils.metrics import (ConsoleReporter, MetricRegistry,
+                                    JsonlReporter)
+
+
+# -- config ------------------------------------------------------------
+def test_settings_defaults():
+    s = Settings.from_dict({})
+    assert s.port == 12321 and s.scheduler.max_jobs_considered == 1024
+    assert s.clusters[0].kind == "mock"
+
+
+def test_settings_full_roundtrip(tmp_path):
+    cfg = {
+        "port": 1234,
+        "pools": [{"name": "gpu", "dru_mode": "gpu"}],
+        "clusters": [{"kind": "kube", "name": "k1", "hosts": 2}],
+        "scheduler": {"max_jobs_considered": 64},
+        "auth": {"scheme": "header", "admins": ["root"]},
+        "rate_limits": {"user_submit": {"tokens_per_sec": 10,
+                                        "max_tokens": 100,
+                                        "enforce": True}},
+    }
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    s = Settings.from_file(str(p))
+    assert s.port == 1234 and s.pools[0].dru_mode == "gpu"
+    assert s.rate_limits["user_submit"].enforce is True
+    assert s.public()["auth"] == {"scheme": "header"}
+
+
+@pytest.mark.parametrize("bad", [
+    {"port": 0},
+    {"nonsense_key": 1},
+    {"clusters": [{"kind": "marathon"}]},
+    {"pools": [{"name": "x", "dru_mode": "weird"}]},
+    {"auth": {"scheme": "kerberos"}},
+    {"scheduler": {"scaleback": 1.5}},
+    {"rate_limits": {"bogus": {}}},
+    {"clusters": [{"name": "a"}, {"name": "a"}]},
+])
+def test_settings_validation_errors(bad):
+    with pytest.raises(ConfigError):
+        Settings.from_dict(bad)
+
+
+def test_build_scheduler_from_settings():
+    from cook_tpu.rest.server import build_scheduler
+    store, coord, api = build_scheduler({
+        "clusters": [{"kind": "kube", "name": "k1", "hosts": 2}],
+        "pools": [{"name": "extra"}]})
+    assert {p.name for p in coord.pools.all()} == {"default", "extra"}
+    assert coord.clusters.get("k1") is not None
+    assert api.plugins is not None
+
+
+# -- leader election ---------------------------------------------------
+def test_standalone_elector():
+    calls = []
+    e = StandaloneElector("http://me")
+    e.start(lambda: calls.append(1))
+    assert e.is_leader() and calls == [1]
+    assert e.current_leader() == "http://me"
+
+
+def test_file_elector_single_winner(tmp_path):
+    path = str(tmp_path / "leader.lock")
+    won = []
+    e1 = FileLeaderElector(path, "http://a", retry_interval_s=0.05,
+                           on_loss=lambda: won.append("lost-a"))
+    e2 = FileLeaderElector(path, "http://b", retry_interval_s=0.05,
+                           on_loss=lambda: won.append("lost-b"))
+    e1.start(lambda: won.append("a"))
+    deadline = time.monotonic() + 5
+    while "a" not in won and time.monotonic() < deadline:
+        time.sleep(0.01)
+    e2.start(lambda: won.append("b"))
+    time.sleep(0.3)
+    assert won == ["a"]            # e2 never acquires while e1 holds
+    assert e1.is_leader() and not e2.is_leader()
+    assert e2.current_leader() == "http://a"
+    # e1 releases; e2 takes over
+    e1.stop()
+    deadline = time.monotonic() + 5
+    while "b" not in won and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "b" in won and e2.is_leader()
+    e2.stop()
+
+
+def test_file_elector_loss_on_lease_deletion(tmp_path):
+    path = str(tmp_path / "leader.lock")
+    events = []
+    e = FileLeaderElector(path, "http://a", retry_interval_s=0.05,
+                          on_loss=lambda: events.append("loss"))
+    e.start(lambda: events.append("lead"))
+    deadline = time.monotonic() + 5
+    while "lead" not in events and time.monotonic() < deadline:
+        time.sleep(0.01)
+    os.unlink(path)               # the ZK-session-expired analog
+    deadline = time.monotonic() + 5
+    while "loss" not in events and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert events == ["lead", "loss"]
+
+
+def test_cross_process_exclusion(tmp_path):
+    """A second PROCESS cannot take the lock (fcntl is per-process)."""
+    path = str(tmp_path / "leader.lock")
+    e = FileLeaderElector(path, "http://parent", retry_interval_s=0.05)
+    e.start(lambda: None)
+    deadline = time.monotonic() + 5
+    while not e.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    code = (
+        "import fcntl, os, sys\n"
+        f"fd = os.open({path!r}, os.O_RDWR)\n"
+        "try:\n"
+        "    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)\n"
+        "    sys.exit(1)\n"
+        "except OSError:\n"
+        "    sys.exit(0)\n")
+    r = subprocess.run([sys.executable, "-c", code])
+    assert r.returncode == 0
+    e.stop()
+
+
+# -- metrics -----------------------------------------------------------
+def test_metric_kinds():
+    reg = MetricRegistry()
+    reg.counter("c").inc(5)
+    reg.counter("c").inc(-2)
+    reg.meter("m").mark(10)
+    for v in range(100):
+        reg.histogram("h").update(v)
+    with reg.timer("t").time():
+        pass
+    snap = reg.snapshot()
+    assert snap["c"]["value"] == 3
+    assert snap["m"]["count"] == 10
+    assert snap["h"]["count"] == 100 and 94 <= snap["h"]["p95"] <= 96
+    assert snap["t"]["count"] == 1
+
+
+def test_jsonl_reporter(tmp_path):
+    reg = MetricRegistry()
+    reg.counter("x").inc()
+    path = str(tmp_path / "metrics.jsonl")
+    rep = JsonlReporter(reg, path, interval_s=0.05)
+    rep.start()
+    time.sleep(0.2)
+    rep.stop()
+    rows = [json.loads(l) for l in open(path)]
+    assert rows and rows[0]["metrics"]["x"]["value"] == 1
+
+
+# -- stats monitor -----------------------------------------------------
+def mkjob(user, mem=100, cpus=1, **kw):
+    return Job(uuid=new_uuid(), user=user, command="x", mem=mem, cpus=cpus,
+               **kw)
+
+
+def test_starved_hungry_satisfied():
+    store = JobStore()
+    shares = ShareStore()
+    shares.set("default", "default", mem=500, cpus=5)
+    reg = MetricRegistry()
+    mon = StatsMonitor(store, shares, reg)
+
+    # alice: running 100 MB (below 500 share), waiting more → starved
+    a_run, a_wait = mkjob("alice"), mkjob("alice")
+    # bob: running 600 MB (over share), waiting → hungry
+    b_runs = [mkjob("bob", mem=300) for _ in range(2)]
+    b_wait = mkjob("bob")
+    # carol: running only → satisfied
+    c_run = mkjob("carol")
+    store.create_jobs([a_run, a_wait, *b_runs, b_wait, c_run])
+    for j in (a_run, *b_runs, c_run):
+        store.create_instance(j.uuid, "h", "mock")
+
+    out = mon.collect("default")
+    assert out["starved"] == ["alice"]
+    assert out["hungry"] == ["bob"]
+    assert out["satisfied"] == ["carol"]
+    assert reg.counter("starved.users.pool-default").value == 1
+    assert reg.counter("running.alice.mem.pool-default").value == 100
+
+    # alice's waiting job gets killed → she leaves starved; counters clear
+    store.kill_job(a_wait.uuid)
+    out = mon.collect("default")
+    assert out["starved"] == []
+    assert reg.counter("starved.alice.mem.pool-default").value == 0
+
+
+def test_starvation_amount_is_capped_by_share():
+    running = {"u": {"mem": 100.0, "cpus": 1.0}}
+    waiting = {"u": {"mem": 10_000.0, "cpus": 100.0, "jobs": 5}}
+    shares = ShareStore()
+    shares.set("u", "default", mem=500, cpus=5)
+    out = starved_stats(running, waiting, shares, "default")
+    assert out["u"]["mem"] == 400.0 and out["u"]["cpus"] == 4.0
