@@ -14,6 +14,7 @@ bin-packing fitness.
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Callable, Optional
@@ -46,7 +47,7 @@ class DataLocalityCosts:
         now = time.monotonic()
         with self._lock:
             want = [j.uuid for j in jobs if j.datasets
-                    and now - self._fetched_at.get(j.uuid, 0.0)
+                    and now - self._fetched_at.get(j.uuid, -math.inf)
                     > self.cache_ttl_s]
         fetched = 0
         for i in range(0, len(want), self.batch_size):
