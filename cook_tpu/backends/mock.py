@@ -6,7 +6,7 @@ keeps per-host resource state, synthesizes offers from spare capacity
 caller-specified duration on a virtual clock and emits completion
 statuses (complete-tasks! :229, default-task->runtime-ms :320). Powers
 the unit tests and the faster-than-real-time simulator
-(backends/simulate.py), like zz_simulator.clj does.
+(cook_tpu/sim), like zz_simulator.clj does.
 """
 from __future__ import annotations
 

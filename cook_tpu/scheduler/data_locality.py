@@ -61,6 +61,10 @@ class DataLocalityCosts:
                     self._costs[uuid] = {
                         h: min(max(float(c), 0.0), 1.0)
                         for h, c in host_costs.items()}
+                # stamp the whole attempted batch: a uuid the service has
+                # no costs for must still honor cache_ttl_s rather than
+                # be re-requested on every cycle
+                for uuid in batch:
                     self._fetched_at[uuid] = now
             fetched += len(batch)
         return fetched

@@ -1,0 +1,78 @@
+"""Randomized trace generation, the analog of the system simulator's
+schedule generator (simulator/src/main/cook/sim/schedule.clj:134
+generate-job-schedule!): N users submitting jobs over a window with
+log-normal-ish runtimes and mixed resource shapes. Deterministic by
+seed so two framework versions can replay identical traces
+(simulator.md "two simulations should only be compared if all inputs
+were the same")."""
+from __future__ import annotations
+
+import json
+import uuid
+
+import numpy as np
+
+
+def generate_trace(n_jobs: int = 1000, n_users: int = 10,
+                   submit_window_ms: int = 3_600_000,
+                   mean_runtime_ms: int = 600_000,
+                   fail_fraction: float = 0.05,
+                   seed: int = 0) -> list[dict]:
+    rng = np.random.default_rng(seed)
+    users = [chr(ord("a") + i % 26) + (str(i // 26) if i >= 26 else "")
+             for i in range(n_users)]
+    jobs = []
+    for _ in range(n_jobs):
+        runtime = int(rng.lognormal(np.log(mean_runtime_ms), 0.8))
+        status = "failed" if rng.random() < fail_fraction else "finished"
+        jobs.append({
+            "job/uuid": str(uuid.UUID(bytes=rng.bytes(16), version=4)),
+            "job/user": users[int(rng.integers(n_users))],
+            "job/name": "simjob",
+            "job/command": "sleep 10",
+            "job/priority": int(rng.choice([25, 50, 75])),
+            "job/max-retries": 3,
+            "job/max-runtime": 86_400_000,
+            "job/disable-mea-culpa-retries": False,
+            "submit-time-ms": int(rng.integers(submit_window_ms)),
+            "run-time-ms": max(runtime, 1000),
+            "status": status,
+            "job/resource": [
+                {"resource/type": "resource.type/cpus",
+                 "resource/amount": float(rng.choice([1.0, 2.0, 4.0]))},
+                {"resource/type": "resource.type/mem",
+                 "resource/amount": float(rng.choice([512.0, 2048.0,
+                                                      4096.0]))},
+            ],
+        })
+    return jobs
+
+
+def generate_hosts(n_hosts: int = 20, cpus: float = 20.0,
+                   mem: float = 20_000.0) -> list[dict]:
+    """Uniform fleet like example-hosts.json (20-cpu/20 GB hosts)."""
+    return [{"hostname": str(i), "attributes": {},
+             "resources": {"cpus": {"*": cpus}, "mem": {"*": mem}}}
+            for i in range(n_hosts)]
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(description="generate a simulator trace")
+    p.add_argument("--jobs", type=int, default=1000)
+    p.add_argument("--users", type=int, default=10)
+    p.add_argument("--hosts", type=int, default=20)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace-out", required=True)
+    p.add_argument("--hosts-out", required=True)
+    a = p.parse_args(argv)
+    with open(a.trace_out, "w") as f:
+        json.dump(generate_trace(a.jobs, a.users, seed=a.seed), f, indent=1)
+    with open(a.hosts_out, "w") as f:
+        json.dump(generate_hosts(a.hosts), f, indent=1)
+    print(f"wrote {a.jobs} jobs -> {a.trace_out}, "
+          f"{a.hosts} hosts -> {a.hosts_out}")
+
+
+if __name__ == "__main__":
+    main()

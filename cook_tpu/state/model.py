@@ -97,8 +97,26 @@ REASON_BY_NAME = {r.name: r for r in REASONS}
 REASON_UNKNOWN = REASON_BY_CODE[6000]
 
 
+# Swappable clock so the faster-than-real-time simulator (cook_tpu.sim)
+# can freeze/set time, the way the reference pins joda DateTimeUtils
+# (zz_simulator.clj "Setting time" developer notes). Production leaves
+# the wall clock in place.
+_clock = time.time
+
+
+def set_clock(fn) -> None:
+    """Install `fn() -> seconds` as the time source for all timestamps."""
+    global _clock
+    _clock = fn
+
+
+def reset_clock() -> None:
+    global _clock
+    _clock = time.time
+
+
 def now_ms() -> int:
-    return int(time.time() * 1000)
+    return int(_clock() * 1000)
 
 
 def new_uuid() -> str:

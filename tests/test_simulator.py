@@ -1,0 +1,172 @@
+"""Faster-than-real-time simulator (cook_tpu.sim).
+
+Mirrors the reference's zz_simulator flow (scheduler/docs/simulator.md):
+trace + hosts -> full coordinator on a virtual clock -> run-trace CSV.
+"""
+import csv
+import json
+
+import pytest
+
+from cook_tpu.sim import (SimConfig, Simulator, parse_hosts, parse_trace)
+from cook_tpu.sim.gen import generate_hosts, generate_trace
+from cook_tpu.state.model import JobState
+
+
+def make_trace_entry(uuid="j-1", user="a", submit=0, runtime=60_000,
+                     status="finished", cpus=2.0, mem=1024.0, **extra):
+    e = {
+        "job/uuid": uuid, "job/user": user, "job/name": "t",
+        "job/command": "sleep 10", "job/priority": 50,
+        "job/max-retries": 1, "submit-time-ms": submit,
+        "run-time-ms": runtime, "status": status,
+        "job/resource": [
+            {"resource/type": "resource.type/cpus",
+             "resource/amount": cpus},
+            {"resource/type": "resource.type/mem",
+             "resource/amount": mem},
+        ],
+    }
+    e.update(extra)
+    return e
+
+
+def test_parse_trace_reference_format():
+    trace = parse_trace([
+        make_trace_entry(uuid="j-1", submit=-855, runtime=1000,
+                         **{"job/group": "g-1", "job/expected-runtime": 100}),
+        make_trace_entry(uuid="j-2", submit=-562, status="failed"),
+    ])
+    assert [t.job.uuid for t in trace] == ["j-1", "j-2"]
+    # shifted so earliest submit is 0
+    assert trace[0].submit_time_ms == 0
+    assert trace[1].submit_time_ms == 293
+    assert trace[0].job.cpus == 2.0 and trace[0].job.mem == 1024.0
+    assert trace[0].job.group == "g-1"
+    assert trace[0].job.expected_runtime_ms == 100
+    assert trace[1].success is False and trace[1].reason == 1003
+
+
+def test_parse_hosts_reference_format():
+    hosts = parse_hosts([{
+        "hostname": "0", "attributes": {"rack": "r1"},
+        "resources": {"cpus": {"*": 10}, "mem": {"*": 10000},
+                      "ports": {"*": [{"begin": 1, "end": 100}]}},
+        "slave-id": "s-0",
+    }])
+    assert hosts[0].hostname == "0"
+    assert hosts[0].cpus == 10.0 and hosts[0].mem == 10000.0
+    assert hosts[0].attributes == {"rack": "r1"}
+
+
+def run_sim(trace_raw, hosts_raw, **cfg_kw):
+    cfg = SimConfig(**cfg_kw)
+    sim = Simulator(parse_trace(trace_raw), parse_hosts(hosts_raw), cfg)
+    summary = sim.run()
+    return sim, summary
+
+
+def test_end_to_end_trace_completes():
+    trace = generate_trace(n_jobs=60, n_users=4, submit_window_ms=300_000,
+                           mean_runtime_ms=120_000, fail_fraction=0.1,
+                           seed=7)
+    hosts = generate_hosts(n_hosts=5, cpus=8, mem=8000)
+    sim, summary = run_sim(trace, hosts, cycle_step_ms=15_000)
+    assert summary["completed"] == 60
+    assert summary["jobs"] == 60
+    assert summary["succeeded"] >= 40
+    assert summary["wait_ms"]["mean"] >= 0
+    assert summary["turnaround_ms"]["p50"] > 0
+    # every job got at least one instance on a real host
+    hostnames = {h["hostname"] for h in hosts}
+    for t in sim.trace:
+        assert t.job.instances
+        assert all(i.hostname in hostnames for i in t.job.instances)
+
+
+def test_determinism_same_inputs_same_decisions():
+    trace = generate_trace(n_jobs=40, n_users=3, submit_window_ms=120_000,
+                           mean_runtime_ms=60_000, seed=3)
+    hosts = generate_hosts(n_hosts=4, cpus=4, mem=8000)
+    sims = [run_sim(trace, hosts, cycle_step_ms=10_000) for _ in range(2)]
+    rows_a = sims[0][0].run_trace_rows()
+    rows_b = sims[1][0].run_trace_rows()
+    # instance ids are random uuids; compare placement/timing decisions
+    strip = lambda r: {k: v for k, v in r.items() if k != "instance_id"}
+    assert [strip(r) for r in rows_a] == [strip(r) for r in rows_b]
+    assert sims[0][1] == sims[1][1]
+
+
+def test_failed_job_consumes_retries_and_completes():
+    # 3 hosts: the novel-host constraint (constraints.clj:73) forbids
+    # relaunching on a host that already failed this job, so each of the
+    # 3 attempts needs a fresh host.
+    trace = [make_trace_entry(uuid="f-1", status="failed", runtime=10_000,
+                              **{"job/max-retries": 3})]
+    hosts = generate_hosts(n_hosts=3, cpus=4, mem=4000)
+    sim, summary = run_sim(trace, hosts, cycle_step_ms=5_000)
+    job = sim.trace[0].job
+    assert job.state == JobState.COMPLETED and job.success is False
+    assert len(job.instances) == 3      # all retries consumed
+
+
+def test_max_runtime_kills_lingering_job():
+    # runs "forever" but max-runtime 60 s -> watchdog kills on virtual time
+    trace = [make_trace_entry(uuid="l-1", runtime=10 ** 9,
+                              **{"job/max-runtime": 60_000})]
+    hosts = generate_hosts(n_hosts=1, cpus=4, mem=4000)
+    sim, summary = run_sim(trace, hosts, cycle_step_ms=30_000)
+    job = sim.trace[0].job
+    assert job.state == JobState.COMPLETED and job.success is False
+    assert job.instances[0].reason_code == 4000
+    assert summary["sim_time_ms"] < 10 ** 9     # didn't wait out the task
+
+
+def test_rebalancer_preempts_hog_for_starved_user():
+    # user a fills the cluster with long jobs; user b arrives later.
+    # min_dru_diff=0 + fast rebalance cadence => preemption fires.
+    trace = ([make_trace_entry(uuid=f"a-{i}", user="a", submit=0,
+                               runtime=3_600_000, cpus=1.0, mem=100.0)
+              for i in range(8)] +
+             [make_trace_entry(uuid=f"b-{i}", user="b", submit=30_000,
+                               runtime=10_000, cpus=1.0, mem=100.0)
+              for i in range(4)])
+    hosts = generate_hosts(n_hosts=2, cpus=4, mem=4000)
+    cfg = SimConfig(cycle_step_ms=10_000, rebalance_interval_ms=60_000,
+                    max_sim_time_ms=7_200_000)
+    cfg.scheduler.rebalancer.min_dru_diff = 0.0
+    cfg.scheduler.rebalancer.safe_dru_threshold = 0.0
+    sim = Simulator(parse_trace(trace), parse_hosts(hosts), cfg)
+    summary = sim.run()
+    assert summary["preemptions"] > 0
+    b_first_start = min(i.start_time_ms for t in sim.trace
+                        if t.job.user == "b" and t.job.instances
+                        for i in t.job.instances)
+    assert b_first_start < 3_600_000    # b ran long before a's jobs ended
+    preempted = [i for t in sim.trace for i in t.job.instances
+                 if i.preempted]
+    assert preempted and all(i.reason_code == 2000 for i in preempted)
+
+
+def test_cli_round_trip(tmp_path):
+    from cook_tpu.sim.__main__ import main as sim_main
+    from cook_tpu.sim.gen import main as gen_main
+    trace_f = tmp_path / "trace.json"
+    hosts_f = tmp_path / "hosts.json"
+    out_f = tmp_path / "out.csv"
+    gen_main(["--jobs", "20", "--users", "3", "--hosts", "3",
+              "--trace-out", str(trace_f), "--hosts-out", str(hosts_f)])
+    cfg_f = tmp_path / "cfg.json"
+    cfg_f.write_text(json.dumps({
+        "cycle-step-ms": 20000,
+        "shares": [{"user": "default", "mem": 5000, "cpus": 10}],
+        "scheduler-config": {"max-jobs-considered": 512},
+    }))
+    rc = sim_main(["--trace-file", str(trace_f), "--host-file",
+                   str(hosts_f), "--out-trace-file", str(out_f),
+                   "--config-file", str(cfg_f)])
+    assert rc == 0
+    with open(out_f) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) >= 20
+    assert set(Simulator.RUN_TRACE_COLUMNS) == set(rows[0])
