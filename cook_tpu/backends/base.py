@@ -52,6 +52,11 @@ class LaunchSpec:
     container: Optional[dict] = None
     progress_regex: str = ""
     progress_output_file: str = ""
+    # job-level checkpointing (:job/checkpoint schema.clj:84): raw job
+    # config + this job's prior failure reason names, so the backend can
+    # apply the max-checkpoint-attempts cutoff (kubernetes/api.clj:642)
+    checkpoint: Optional[dict] = None
+    prior_failure_reasons: list[str] = field(default_factory=list)
 
 
 StatusCallback = Callable[..., None]

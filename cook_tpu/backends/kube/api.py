@@ -45,6 +45,9 @@ class Pod:
     deleting: bool = False
     preempted: bool = False         # node-preemption mark
     pool: str = "default"
+    # injected volumes/mounts (checkpointing tools volume etc.,
+    # task-metadata->pod kubernetes/api.clj:598-611)
+    volumes: list = field(default_factory=list)
 
     @property
     def synthetic(self) -> bool:

@@ -20,6 +20,7 @@ import threading
 from typing import Optional
 
 from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
+from cook_tpu.backends.kube import checkpoint as cp
 from cook_tpu.backends.kube.api import (KubeApi, Pod, PodPhase, POOL_LABEL,
                                         SYNTHETIC_LABEL)
 from cook_tpu.backends.kube.controller import (ExpectedState, KubeController,
@@ -32,11 +33,15 @@ MAX_SYNTHETIC_PODS = 30
 class KubeCluster(ComputeCluster):
     def __init__(self, api: KubeApi, name: str = "kube",
                  max_synthetic_pods: int = MAX_SYNTHETIC_PODS,
-                 synthetic_pods: bool = True):
+                 synthetic_pods: bool = True,
+                 default_checkpoint_config: Optional[dict] = None):
         self.name = name
         self.api = api
         self.max_synthetic = max_synthetic_pods
         self.synthetic_enabled = synthetic_pods
+        # cluster-wide defaults merged under each job's checkpoint
+        # config (config/kubernetes :default-checkpoint-config)
+        self.default_checkpoint_config = default_checkpoint_config or {}
         self._synthetic_seq = 0
         self._lock = threading.Lock()
         self.controller = KubeController(api, self._writeback, name=name)
@@ -100,10 +105,18 @@ class KubeCluster(ComputeCluster):
 
     def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
         for spec in specs:
-            pod = Pod(name=spec.task_id, mem=spec.mem, cpus=spec.cpus,
+            # checkpointing: env/volumes/memory-overhead materialized on
+            # the pod (task-metadata->pod api.clj:598-660,:689,:724)
+            ckpt = cp.effective_checkpoint_config(
+                spec.checkpoint, spec.prior_failure_reasons,
+                self.default_checkpoint_config)
+            pod = Pod(name=spec.task_id,
+                      mem=cp.adjusted_mem(spec.mem, ckpt), cpus=spec.cpus,
                       gpus=spec.gpus, node=spec.hostname, pool=pool,
-                      env=dict(spec.env), command=spec.command,
-                      labels={"cook-job": spec.job_uuid})
+                      env={**spec.env, **cp.checkpoint_env(ckpt)},
+                      command=spec.command,
+                      labels={"cook-job": spec.job_uuid},
+                      volumes=cp.checkpoint_volumes(ckpt))
             self.controller.set_expected(spec.task_id,
                                          ExpectedState.STARTING,
                                          launch_pod=pod)

@@ -123,6 +123,10 @@ class Settings:
     plugins: dict = field(default_factory=dict)
     data_locality: dict = field(default_factory=dict)
     # {fetcher: "pkg.mod:factory", weight: 0.25, batch_size: 500}
+    # cluster-wide default-checkpoint-config (config/kubernetes
+    # :default-checkpoint-config): merged under each job's checkpoint
+    # config by the matcher and the kube backend
+    checkpoint: dict = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, raw: dict) -> "Settings":

@@ -161,7 +161,8 @@ def build_scheduler(config):
                                   pool=c.pool)
                              for i in range(c.hosts)])
             clusters.register(KubeCluster(
-                kube, name=c.name, max_synthetic_pods=c.max_synthetic_pods))
+                kube, name=c.name, max_synthetic_pods=c.max_synthetic_pods,
+                default_checkpoint_config=config.checkpoint or None))
         else:
             hosts = [MockHost(hostname=f"{c.name}-host-{i}",
                               mem=c.host_mem, cpus=c.host_cpus,
@@ -204,7 +205,8 @@ def build_scheduler(config):
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,
-        plugins=plugins, data_locality=data_locality)
+        plugins=plugins, data_locality=data_locality,
+        checkpoint_defaults=config.checkpoint or None)
 
     monitor = StatsMonitor(store, coord.shares, metrics_mod.registry)
     api = CookApi(

@@ -38,7 +38,9 @@ from cook_tpu.scheduler.tensorize import (
     JobBatch, TaskBatch, UserInterner, bucket, quota_arrays, tensorize_jobs,
     tensorize_tasks)
 from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
-from cook_tpu.state.model import InstanceStatus, Job, JobState, now_ms
+from cook_tpu.backends.kube import checkpoint as cp
+from cook_tpu.state.model import (REASON_BY_CODE, InstanceStatus, Job,
+                                  JobState, now_ms)
 from cook_tpu.state.pools import PoolRegistry
 from cook_tpu.state.store import JobStore, TransactionError
 
@@ -83,7 +85,8 @@ class Coordinator:
                  launch_rate_limiter: Optional[RateLimiter] = None,
                  user_launch_rate_limiter: Optional[RateLimiter] = None,
                  progress_aggregator=None, heartbeats=None,
-                 plugins=None, data_locality=None):
+                 plugins=None, data_locality=None,
+                 checkpoint_defaults: Optional[dict] = None):
         self.store = store
         self.clusters = clusters
         self.shares = shares or ShareStore()
@@ -106,8 +109,27 @@ class Coordinator:
         self.heartbeats = heartbeats
         self.plugins = plugins
         self.data_locality = data_locality
+        # cluster-wide checkpoint defaults: the matcher must bin-pack
+        # with the checkpoint memory-overhead included, like the
+        # reference's adjust-job-resources is applied in
+        # make-task-request (kubernetes/api.clj:573-589) — otherwise a
+        # matched pod can overcommit its node at launch. Pass the same
+        # dict to KubeCluster(default_checkpoint_config=...).
+        self.checkpoint_defaults = checkpoint_defaults
         for cluster in clusters.all():
             cluster.set_status_callback(self._on_status)
+
+    # ------------------------------------------------------------------
+    def _effective_mem(self, job: Job) -> float:
+        """Matcher-visible memory: job request + checkpoint
+        memory-overhead when checkpointing is (still) effective for the
+        next attempt (adjust-job-resources kubernetes/api.clj:573-589)."""
+        if job.checkpoint is None and not self.checkpoint_defaults:
+            return job.mem
+        cfg = cp.effective_checkpoint_config(
+            job.checkpoint, _failure_reason_names(job),
+            self.checkpoint_defaults)
+        return cp.adjusted_mem(job.mem, cfg)
 
     # ------------------------------------------------------------------
     def _on_status(self, task_id: str, status: InstanceStatus,
@@ -187,7 +209,8 @@ class Coordinator:
         tb = tensorize_tasks(run_insts, self.shares, pool,
                              self.interner, host_ids)
         jb = tensorize_jobs(pending, self.shares, pool, self.interner,
-                            groups=self.store.groups)
+                            groups=self.store.groups,
+                            mem_fn=self._effective_mem)
         H = bucket(len(offers))
         hosts = match_ops.make_hosts(
             mem=_pad([o.mem for o in offers], H),
@@ -253,7 +276,9 @@ class Coordinator:
                            mem=job.mem, cpus=job.cpus, gpus=job.gpus,
                            env=job.env, container=job.container,
                            progress_regex=job.progress_regex_string,
-                           progress_output_file=job.progress_output_file))
+                           progress_output_file=job.progress_output_file,
+                           checkpoint=job.checkpoint,
+                           prior_failure_reasons=_failure_reason_names(job)))
             launched += 1
             self.launch_rl.spend("global")
             if job.uuid in self.reservations:
@@ -384,7 +409,8 @@ class Coordinator:
         tb = tensorize_tasks(run_insts, self.shares, pool,
                              self.interner, host_ids, extra_slots=Pb)
         jb = tensorize_jobs(pending_sorted, self.shares, pool, self.interner,
-                            groups=self.store.groups, pad_to=Pb)
+                            groups=self.store.groups, pad_to=Pb,
+                            mem_fn=self._effective_mem)
         all_attrs = self._all_host_attributes()
         host_attrs = [all_attrs.get(h, {}) for h in host_names]
         forb_small = constraints_mod.build_forbidden(
@@ -548,6 +574,18 @@ class Coordinator:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+
+
+def _failure_reason_names(job: Job) -> list[str]:
+    """Reason names of this job's failed instances, for the backend's
+    max-checkpoint-attempts cutoff (kubernetes/api.clj:642-660)."""
+    names = []
+    for inst in job.instances:
+        if inst.status == InstanceStatus.FAILED and \
+                inst.reason_code is not None:
+            r = REASON_BY_CODE.get(inst.reason_code)
+            names.append(r.name if r else str(inst.reason_code))
+    return names
 
 
 def _pad(vals, size, fill=0.0):

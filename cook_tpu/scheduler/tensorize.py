@@ -131,7 +131,12 @@ def tensorize_tasks(instances, shares: ShareStore, pool: str,
 
 def tensorize_jobs(jobs: list[Job], shares: ShareStore, pool: str,
                    interner: UserInterner, groups=None,
-                   pad_to: Optional[int] = None) -> JobBatch:
+                   pad_to: Optional[int] = None,
+                   mem_fn=None) -> JobBatch:
+    """mem_fn(job) -> effective MB overrides the matcher-visible memory
+    (checkpoint memory-overhead, adjust-job-resources
+    kubernetes/api.clj:573-589 — the reference also bin-packs with the
+    adjusted resources, via make-task-request)."""
     n = len(jobs)
     size = pad_to or bucket(n)
     b = JobBatch(
@@ -148,7 +153,8 @@ def tensorize_jobs(jobs: list[Job], shares: ShareStore, pool: str,
     group_ids: dict[str, int] = {}
     for i, job in enumerate(jobs):
         b.user[i] = interner.id(job.user)
-        b.mem[i], b.cpus[i], b.gpus[i] = job.mem, job.cpus, job.gpus
+        b.mem[i] = mem_fn(job) if mem_fn else job.mem
+        b.cpus[i], b.gpus[i] = job.cpus, job.gpus
         b.priority[i] = job.priority
         # pending jobs sort after running tasks of equal priority: use
         # submit time in seconds relative to nothing (monotonic enough)

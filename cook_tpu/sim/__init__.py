@@ -69,7 +69,12 @@ def parse_trace(raw: list[dict]) -> list[TraceJob]:
     for r in raw:
         res = {d["resource/type"].split("/")[-1]: float(d["resource/amount"])
                for d in r.get("job/resource", [])}
-        success, reason = STATUS_MAP[r.get("status", "finished")]
+        status = r.get("status", "finished")
+        if status not in STATUS_MAP:
+            raise ValueError(
+                f"job {r.get('job/uuid')}: unknown status {status!r} "
+                f"(expected one of {sorted(STATUS_MAP)})")
+        success, reason = STATUS_MAP[status]
         job = Job(
             uuid=r["job/uuid"], user=r["job/user"],
             command=r.get("job/command", "sim"),

@@ -1,0 +1,157 @@
+"""Job-level checkpointing materialization (backends/kube/checkpoint.py
+<-> kubernetes/api.clj:598-660)."""
+import pytest
+
+from cook_tpu.backends.kube.checkpoint import (
+    DEFAULT_CHECKPOINT_FAILURE_REASONS, add_as_decimals, adjusted_mem,
+    checkpoint_env, checkpoint_volumes, effective_checkpoint_config)
+from tests.test_kube_backend import build, mkjob, run_pod_lifecycle
+
+
+def test_checkpoint_env_full():
+    env = checkpoint_env({
+        "mode": "periodic",
+        "options": {"preserve-paths": ["/z-last", "/a-first"]},
+        "periodic-options": {"period-sec": 300},
+    })
+    assert env == {
+        "COOK_CHECKPOINT_MODE": "periodic",
+        "COOK_CHECKPOINT_PRESERVE_PATH_0": "/a-first",   # sorted order
+        "COOK_CHECKPOINT_PRESERVE_PATH_1": "/z-last",
+        "COOK_CHECKPOINT_PERIOD_SEC": "300",
+    }
+
+
+def test_checkpoint_env_empty_without_mode():
+    assert checkpoint_env(None) == {}
+    assert checkpoint_env({"options": {"preserve-paths": ["/x"]}}) == {}
+
+
+def test_checkpoint_volumes():
+    vols = checkpoint_volumes({
+        "mode": "auto", "volume-name": "tools",
+        "init-container-volume-mounts": [{"path": "/cp", "sub-path": "s"}],
+        "main-container-volume-mounts": [{"path": "/cp"}],
+    })
+    assert vols[0] == {"name": "tools", "kind": "empty-dir"}
+    mounts = [v for v in vols if v["kind"] == "mount"]
+    assert {m["container"] for m in mounts} == {"init", "main"}
+    # no volume-name -> no volumes
+    assert checkpoint_volumes({"mode": "auto"}) == []
+
+
+def test_add_as_decimals_precision():
+    # api.clj:567-571: 0.1 + 0.02 must come out exactly 0.12
+    assert add_as_decimals(0.1, 0.02) == 0.12
+    assert adjusted_mem(1024.0, {"memory-overhead": 512}) == 1536.0
+    assert adjusted_mem(1024.0, None) == 1024.0
+
+
+def test_max_checkpoint_attempts_cutoff():
+    ckpt = {"mode": "auto", "max-checkpoint-attempts": 2}
+    # one countable failure -> still checkpointing
+    assert effective_checkpoint_config(
+        ckpt, ["command-executor-failed"]) is not None
+    # two countable -> disabled
+    assert effective_checkpoint_config(
+        ckpt, ["command-executor-failed", "straggler"]) is None
+    # non-countable reasons (preemption is the system's fault) are free
+    assert effective_checkpoint_config(
+        ckpt, ["preempted-by-rebalancer"] * 5) is not None
+    # custom countable set
+    custom = {**ckpt, "checkpoint-failure-reasons": ["host-lost"]}
+    assert effective_checkpoint_config(custom, ["host-lost"] * 2) is None
+    assert effective_checkpoint_config(
+        custom, ["command-executor-failed"] * 5) is not None
+
+
+def test_default_config_merged_under_job_config():
+    defaults = {"volume-name": "tools", "memory-overhead": 256}
+    cfg = effective_checkpoint_config({"mode": "auto"}, [], defaults)
+    assert cfg["volume-name"] == "tools"
+    assert cfg["memory-overhead"] == 256
+    # job config wins over defaults
+    cfg = effective_checkpoint_config(
+        {"mode": "auto", "memory-overhead": 512}, [], defaults)
+    assert cfg["memory-overhead"] == 512
+
+
+def test_pod_carries_checkpoint_env_volumes_and_overhead():
+    kube, cluster, store, coord = build(
+        default_checkpoint_config={"volume-name": "tools",
+                                   "memory-overhead": 128})
+    job = mkjob(checkpoint={"mode": "auto",
+                            "options": {"preserve-paths": ["/model"]}})
+    store.create_jobs([job])
+    coord.match_cycle()
+    task_id = job.instances[0].task_id
+    pod = next(p for p in kube.list_pods() if p.name == task_id)
+    assert pod.env["COOK_CHECKPOINT_MODE"] == "auto"
+    assert pod.env["COOK_CHECKPOINT_PRESERVE_PATH_0"] == "/model"
+    assert pod.mem == job.mem + 128          # memory-overhead applied
+    assert any(v["kind"] == "empty-dir" and v["name"] == "tools"
+               for v in pod.volumes)
+
+
+def test_checkpoint_disabled_after_repeated_failures():
+    kube, cluster, store, coord = build(
+        nodes=None,
+        default_checkpoint_config={"max-checkpoint-attempts": 1})
+    job = mkjob(checkpoint={"mode": "auto"}, max_retries=3)
+    store.create_jobs([job])
+    # attempt 1: checkpointing on
+    coord.match_cycle()
+    t1 = job.instances[0].task_id
+    pod1 = next(p for p in kube.list_pods() if p.name == t1)
+    assert "COOK_CHECKPOINT_MODE" in pod1.env
+    run_pod_lifecycle(kube, t1, end="fail")
+    # attempt 2: one command-executor-failed on record -> cutoff reached
+    coord.match_cycle()
+    assert len(job.instances) == 2
+    t2 = job.instances[1].task_id
+    pod2 = next(p for p in kube.list_pods() if p.name == t2)
+    assert "COOK_CHECKPOINT_MODE" not in pod2.env
+    assert pod2.mem == job.mem               # overhead gone too
+
+
+def test_matcher_sees_checkpoint_overhead_no_overcommit():
+    """A job whose base mem fits a node but whose checkpoint-inflated
+    mem does not must NOT match (the reference bin-packs with
+    adjust-job-resources applied, kubernetes/api.clj:573-589)."""
+    from cook_tpu.backends.kube import FakeKube, KubeCluster, Node
+    from cook_tpu.backends.base import ClusterRegistry
+    from cook_tpu.scheduler.coordinator import Coordinator
+    from cook_tpu.state.store import JobStore
+    defaults = {"memory-overhead": 128}
+    kube = FakeKube([Node("n0", mem=1000, cpus=16)])
+    cluster = KubeCluster(kube, default_checkpoint_config=defaults)
+    store = JobStore()
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, checkpoint_defaults=defaults)
+    cluster.initialize()
+    job = mkjob(mem=1000, checkpoint={"mode": "auto"})
+    store.create_jobs([job])
+    stats = coord.match_cycle()
+    assert stats.matched == 0 and not job.instances
+    # a job that fits with the overhead still matches
+    ok_job = mkjob(mem=800, checkpoint={"mode": "auto"})
+    store.create_jobs([ok_job])
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+    pod = next(p for p in kube.list_pods()
+               if p.name == ok_job.instances[0].task_id)
+    assert pod.mem == 928.0
+
+
+def test_job_without_checkpoint_unaffected():
+    kube, cluster, store, coord = build(
+        default_checkpoint_config={"volume-name": "tools",
+                                   "memory-overhead": 128})
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    pod = next(p for p in kube.list_pods()
+               if p.name == job.instances[0].task_id)
+    assert "COOK_CHECKPOINT_MODE" not in pod.env
+    assert pod.mem == job.mem and pod.volumes == []
