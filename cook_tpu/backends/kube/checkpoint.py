@@ -37,6 +37,8 @@ DEFAULT_CHECKPOINT_FAILURE_REASONS = frozenset({
     "straggler",
 })
 
+VALID_MODES = ("auto", "periodic", "preemption")
+
 
 def add_as_decimals(a: float, b: float) -> float:
     """Float addition via Decimal so resource quantities keep k8s-legal
@@ -56,6 +58,11 @@ def effective_checkpoint_config(
     if not checkpoint:
         return None
     cfg = {**(default_config or {}), **checkpoint}
+    # a config without a valid mode checkpointed nothing in
+    # checkpoint_env/checkpoint_volumes; it must not pay the
+    # memory-overhead either (the API also rejects it up front)
+    if cfg.get("mode") not in VALID_MODES:
+        return None
     max_attempts = cfg.get("max-checkpoint-attempts")
     if max_attempts is not None:
         countable = set(cfg.get("checkpoint-failure-reasons") or

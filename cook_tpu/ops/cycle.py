@@ -44,7 +44,7 @@ class CycleResult(NamedTuple):
 
 
 @functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
-                                             "sequential"))
+                                             "sequential", "use_pallas"))
 def rank_and_match(
     # running tasks (R slots)
     run_user, run_mem, run_cpus, run_prio, run_start, run_valid,
@@ -63,6 +63,7 @@ def rank_and_match(
     sequential: bool = True,
     considerable_limit=None,
     bonus=None,                # (P, H) f32 >= 0 fitness bonus (data locality)
+    use_pallas: bool = False,  # fused Pallas TPU kernel in match_rounds
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -163,7 +164,8 @@ def rank_and_match(
                                    bonus=bonusc)
     else:
         res = match_ops.match_rounds(jobs, hosts, forb, rounds=12,
-                                     num_groups=num_groups, bonus=bonusc)
+                                     num_groups=num_groups, bonus=bonusc,
+                                     use_pallas=use_pallas)
     # scatter back: compact -> original pending order in one scatter
     # (empty compact slots get index P and are dropped)
     scatter_idx = jnp.where(in_use, pend_idx, P)

@@ -168,10 +168,14 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     return MatchResult(job_host, mem_left, cpus_left, gpus_left)
 
 
-@functools.partial(jax.jit, static_argnames=("rounds", "num_groups"))
+@functools.partial(jax.jit, static_argnames=("rounds", "num_groups",
+                                             "use_pallas",
+                                             "pallas_interpret"))
 def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                  rounds: int = 4, num_groups: int = 1,
-                 bonus: jnp.ndarray | None = None) -> MatchResult:
+                 bonus: jnp.ndarray | None = None,
+                 use_pallas: bool = False,
+                 pallas_interpret: bool = False) -> MatchResult:
     """Batched greedy approximation: all jobs bid at once, hosts accept
     the feasible prefix of their bidders in queue order, repeat.
 
@@ -180,31 +184,60 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     Converges to sequential greedy when conflicts are sparse; every
     accepted assignment is always *valid* (never oversubscribes), which is
     the safety property the scheduler relies on.
+
+    use_pallas: route the per-round dense feasibility+fitness+argmax
+    through the fused Pallas TPU kernel (ops.pallas_match). Requires
+    num_groups == 1 (the kernel folds group-0 unique occupancy in; the
+    multi-group gather stays on the XLA path).
     """
     N = jobs.mem.shape[0]
     H = hosts.mem.shape[0]
     rank = jnp.arange(N)
+    # pallas path needs block-divisible power-of-two shapes (the
+    # coordinator's bucket() padding guarantees this; arbitrary direct
+    # callers fall back to XLA instead of silently truncating)
+    use_pallas = (use_pallas and num_groups == 1 and N >= 8 and H >= 128
+                  and N & (N - 1) == 0 and H & (H - 1) == 0)
+    if use_pallas:
+        from cook_tpu.ops import pallas_match
+        forb_u8 = forbidden.astype(jnp.uint8)
 
     def one_round(state, _):
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
         unassigned = jobs.valid & (job_host == NO_HOST)
-
-        ok = _feasible(jobs.mem[:, None], jobs.cpus[:, None], jobs.gpus[:, None],
-                       mem_left[None, :], cpus_left[None, :], gpus_left[None, :],
-                       hosts.cap_gpus[None, :], hosts.valid[None, :],
-                       slots_left[None, :], forbidden)
-        ok &= unassigned[:, None]
-        # group-unique vs assignments from previous rounds
         gclip = jnp.clip(jobs.group, 0, num_groups - 1)
-        ok &= ~(jobs.unique_group[:, None] & group_occ[gclip])
-        fit = _fitness(jobs.mem[:, None], jobs.cpus[:, None],
-                       mem_left[None, :], cpus_left[None, :],
-                       hosts.cap_mem[None, :], hosts.cap_cpus[None, :])
-        if bonus is not None:
-            fit = fit + bonus
-        fit = jnp.where(ok, fit, -1.0)
-        choice = jnp.argmax(fit, axis=1)
-        bids = fit[rank, choice] > -0.5  # job has any feasible host
+
+        if use_pallas:
+            jobs_packed = pallas_match.pack_jobs(
+                jobs.mem, jobs.cpus, jobs.gpus, unassigned,
+                jobs.unique_group)
+            hosts_packed = pallas_match.pack_hosts(
+                mem_left, cpus_left, gpus_left, hosts.cap_mem,
+                hosts.cap_cpus, hosts.cap_gpus, slots_left, hosts.valid,
+                group_occ[0])
+            best_fit, best = pallas_match.best_host(
+                jobs_packed, hosts_packed, forb_u8, bonus,
+                interpret=pallas_interpret)
+            choice = jnp.clip(best, 0, H - 1)
+            bids = best_fit > -0.5
+        else:
+            ok = _feasible(jobs.mem[:, None], jobs.cpus[:, None],
+                           jobs.gpus[:, None],
+                           mem_left[None, :], cpus_left[None, :],
+                           gpus_left[None, :],
+                           hosts.cap_gpus[None, :], hosts.valid[None, :],
+                           slots_left[None, :], forbidden)
+            ok &= unassigned[:, None]
+            # group-unique vs assignments from previous rounds
+            ok &= ~(jobs.unique_group[:, None] & group_occ[gclip])
+            fit = _fitness(jobs.mem[:, None], jobs.cpus[:, None],
+                           mem_left[None, :], cpus_left[None, :],
+                           hosts.cap_mem[None, :], hosts.cap_cpus[None, :])
+            if bonus is not None:
+                fit = fit + bonus
+            fit = jnp.where(ok, fit, -1.0)
+            choice = jnp.argmax(fit, axis=1)
+            bids = fit[rank, choice] > -0.5  # job has any feasible host
 
         # Hosts accept claimants in queue order while they still fit:
         # sort bidders by (choice, rank), segmented cumsum of demands.

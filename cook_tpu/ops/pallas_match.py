@@ -1,0 +1,185 @@
+"""Pallas TPU kernel for the hot inner op of the batched matcher.
+
+The dominant cost of `ops.match.match_rounds` at benchmark scale
+(8k considerable x 10k hosts, BASELINE.md headline config) is the dense
+(N, H) pass per round: feasibility mask + cpuMemBinPacker fitness +
+per-job argmax over hosts (the vectorized form of Fenzo's per-task host
+scoring loop, scheduler.clj:524-569). XLA materializes/streams several
+(N, H) f32 intermediates for it; this kernel fuses the whole thing into
+one tiled pass that keeps every intermediate in VMEM and emits only the
+per-job (best fitness, best host) pair — HBM traffic drops to the two
+unavoidable (N, H) input reads (forbidden mask, optional bonus) plus
+O(N + H) vectors.
+
+Layout: grid (N/bn, H/bh), H innermost; the output block is revisited
+across the H walk and accumulates the running row-max (standard Pallas
+accumulation pattern). Hosts ship as one (16, bh) f32 stack (row per
+field — lanes = hosts), jobs as an (bn, 8) f32 stack (sublanes = jobs),
+the forbidden mask as (bn, bh) uint8.
+
+Semantics identical to the XLA path (ops.match._feasible/_fitness and
+the argmax tie-break toward the lowest host index): verified by
+tests/test_pallas_match.py under interpret mode, and exercised on real
+TPU by bench.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NO_HOST = -1
+EPS = 1e-6
+BIG_I = 2 ** 30
+
+# host-stack row indices (sublane layout of the (16, H) host tensor)
+H_MEM, H_CPUS, H_GPUS, H_CAP_MEM, H_CAP_CPUS, H_CAP_GPUS, \
+    H_SLOTS, H_VALID, H_OCC0 = range(9)
+HOST_ROWS = 16   # padded to a full f32 sublane tile
+
+# job-stack column indices of the (N, 8) job tensor
+J_MEM, J_CPUS, J_GPUS, J_ACTIVE, J_UNIQUE = range(5)
+JOB_COLS = 8
+
+
+def pack_hosts(mem_left, cpus_left, gpus_left, cap_mem, cap_cpus,
+               cap_gpus, slots_left, valid, occ0) -> jnp.ndarray:
+    """(16, H) f32 host field stack."""
+    H = mem_left.shape[0]
+    rows = [mem_left, cpus_left, gpus_left, cap_mem, cap_cpus, cap_gpus,
+            slots_left.astype(jnp.float32), valid.astype(jnp.float32),
+            occ0.astype(jnp.float32)]
+    stack = jnp.stack(rows, axis=0)
+    return jnp.concatenate(
+        [stack, jnp.zeros((HOST_ROWS - len(rows), H), jnp.float32)], axis=0)
+
+
+def pack_jobs(mem, cpus, gpus, active, unique) -> jnp.ndarray:
+    """(N, 8) f32 job field stack."""
+    N = mem.shape[0]
+    cols = [mem, cpus, gpus, active.astype(jnp.float32),
+            unique.astype(jnp.float32)]
+    stack = jnp.stack(cols, axis=1)
+    return jnp.concatenate(
+        [stack, jnp.zeros((N, JOB_COLS - len(cols)), jnp.float32)], axis=1)
+
+
+def _score_tile(jobs_ref, hosts_ref, forb_ref, bonus):
+    """(bn, bh) masked fitness for one tile (-1 where infeasible)."""
+    jm = jobs_ref[:, J_MEM:J_MEM + 1]
+    jc = jobs_ref[:, J_CPUS:J_CPUS + 1]
+    jg = jobs_ref[:, J_GPUS:J_GPUS + 1]
+    ja = jobs_ref[:, J_ACTIVE:J_ACTIVE + 1]
+    ju = jobs_ref[:, J_UNIQUE:J_UNIQUE + 1]
+    mem_left = hosts_ref[H_MEM:H_MEM + 1, :]
+    cpus_left = hosts_ref[H_CPUS:H_CPUS + 1, :]
+    gpus_left = hosts_ref[H_GPUS:H_GPUS + 1, :]
+    cap_mem = hosts_ref[H_CAP_MEM:H_CAP_MEM + 1, :]
+    cap_cpus = hosts_ref[H_CAP_CPUS:H_CAP_CPUS + 1, :]
+    cap_gpus = hosts_ref[H_CAP_GPUS:H_CAP_GPUS + 1, :]
+    slots = hosts_ref[H_SLOTS:H_SLOTS + 1, :]
+    hvalid = hosts_ref[H_VALID:H_VALID + 1, :]
+    occ0 = hosts_ref[H_OCC0:H_OCC0 + 1, :]
+
+    # feasibility (ops.match._feasible)
+    ok = (hvalid > 0) & (slots > 0) & (forb_ref[:, :] == 0)
+    ok &= (mem_left + EPS >= jm) & (cpus_left + EPS >= jc)
+    is_gpu_host = cap_gpus > 0
+    ok &= jnp.where(jg > 0, is_gpu_host & (gpus_left + EPS >= jg),
+                    ~is_gpu_host)
+    # group-0 unique-host occupancy (the num_groups == 1 fast path)
+    ok &= ~((ju > 0) & (occ0 > 0))
+    ok &= ja > 0
+
+    # cpuMemBinPacker fitness (ops.match._fitness)
+    f_mem = jnp.where(cap_mem > 0, (cap_mem - mem_left + jm) / cap_mem, 0.0)
+    f_cpu = jnp.where(cap_cpus > 0,
+                      (cap_cpus - cpus_left + jc) / cap_cpus, 0.0)
+    fit = 0.5 * (f_mem + f_cpu)
+    if bonus is not None:
+        fit = fit + bonus[:, :]
+    return jnp.where(ok, fit, -1.0)
+
+
+def _accumulate(fit, bh, fit_ref, idx_ref):
+    """Merge this tile's row-max into the running (best fit, best host)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        fit_ref[:, :] = jnp.full_like(fit_ref, -1.0)
+        idx_ref[:, :] = jnp.full_like(idx_ref, NO_HOST)
+
+    tile_max = jnp.max(fit, axis=1, keepdims=True)
+    ids = jax.lax.broadcasted_iota(jnp.int32, fit.shape, 1) + j * bh
+    # first-max tie-break, same as jnp.argmax row semantics
+    tile_arg = jnp.min(jnp.where(fit >= tile_max, ids, BIG_I), axis=1,
+                       keepdims=True)
+    better = tile_max > fit_ref[:, :]
+    idx_ref[:, :] = jnp.where(better, tile_arg, idx_ref[:, :])
+    fit_ref[:, :] = jnp.where(better, tile_max, fit_ref[:, :])
+
+
+def _kernel(jobs_ref, hosts_ref, forb_ref, fit_ref, idx_ref, *, bh):
+    _accumulate(_score_tile(jobs_ref, hosts_ref, forb_ref, None), bh,
+                fit_ref, idx_ref)
+
+
+def _kernel_bonus(jobs_ref, hosts_ref, forb_ref, bonus_ref, fit_ref,
+                  idx_ref, *, bh):
+    _accumulate(_score_tile(jobs_ref, hosts_ref, forb_ref, bonus_ref), bh,
+                fit_ref, idx_ref)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_n", "block_h", "interpret"))
+def best_host(jobs_packed: jnp.ndarray, hosts_packed: jnp.ndarray,
+              forbidden_u8: jnp.ndarray,
+              bonus: jnp.ndarray | None = None,
+              block_n: int = 256, block_h: int = 1024,
+              interpret: bool = False):
+    """Fused feasibility+fitness+argmax over hosts.
+
+    jobs_packed: (N, 8) f32 from pack_jobs; hosts_packed: (16, H) f32
+    from pack_hosts; forbidden_u8: (N, H) u8 (1 = excluded); bonus:
+    optional (N, H) f32 additive fitness. N, H must be multiples of the
+    block sizes. Returns (best_fit (N,), best_host (N,) i32, -1 = none).
+    """
+    N = jobs_packed.shape[0]
+    H = hosts_packed.shape[1]
+    bn = min(block_n, N)
+    bh = min(block_h, H)
+    if N % bn or H % bh:
+        raise ValueError(
+            f"best_host needs N divisible by {bn} and H by {bh} "
+            f"(got N={N}, H={H}); pad with tensorize.bucket()")
+    if H % 128:
+        raise ValueError(f"H must be a multiple of 128 lanes (got {H})")
+    grid = (N // bn, H // bh)
+    in_specs = [
+        pl.BlockSpec((bn, JOB_COLS), lambda i, j: (i, 0)),
+        pl.BlockSpec((HOST_ROWS, bh), lambda i, j: (0, j)),
+        pl.BlockSpec((bn, bh), lambda i, j: (i, j)),
+    ]
+    args = [jobs_packed, hosts_packed, forbidden_u8]
+    if bonus is None:
+        kernel = functools.partial(_kernel, bh=bh)
+    else:
+        kernel = functools.partial(_kernel_bonus, bh=bh)
+        in_specs.append(pl.BlockSpec((bn, bh), lambda i, j: (i, j)))
+        args.append(bonus)
+    fit, idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+                   pl.BlockSpec((bn, 1), lambda i, j: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((N, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((N, 1), jnp.int32)],
+        interpret=interpret,
+    )(*args)
+    idx = idx[:, 0]
+    return fit[:, 0], jnp.where(idx >= BIG_I, NO_HOST, idx)

@@ -306,6 +306,14 @@ class CookApi:
         env = {str(k): str(v) for k, v in (spec.get("env") or {}).items()}
         labels = {str(k): str(v)
                   for k, v in (spec.get("labels") or {}).items()}
+        checkpoint = spec.get("checkpoint")
+        if checkpoint is not None:
+            from cook_tpu.backends.kube.checkpoint import VALID_MODES
+            if not isinstance(checkpoint, dict) or \
+                    checkpoint.get("mode") not in VALID_MODES:
+                raise ApiError(
+                    400, f"job {uuid}: checkpoint.mode must be one of "
+                         f"{list(VALID_MODES)}")
         max_runtime = int(spec.get("max_runtime", spec.get("max-runtime",
                                                            2 ** 53)))
         return Job(
@@ -319,7 +327,7 @@ class CookApi:
             application=spec.get("application"),
             progress_output_file=spec.get("progress_output_file", ""),
             progress_regex_string=spec.get("progress_regex_string", ""),
-            checkpoint=spec.get("checkpoint"),
+            checkpoint=checkpoint,
             disable_mea_culpa_retries=bool(
                 spec.get("disable_mea_culpa_retries", False)),
             datasets=spec.get("datasets", []),

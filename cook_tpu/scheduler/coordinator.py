@@ -113,8 +113,15 @@ class Coordinator:
         # with the checkpoint memory-overhead included, like the
         # reference's adjust-job-resources is applied in
         # make-task-request (kubernetes/api.clj:573-589) — otherwise a
-        # matched pod can overcommit its node at launch. Pass the same
-        # dict to KubeCluster(default_checkpoint_config=...).
+        # matched pod can overcommit its node at launch. When not given
+        # explicitly, adopt the defaults a registered cluster carries so
+        # the matcher and the pod builder can never disagree.
+        if checkpoint_defaults is None:
+            for cluster in clusters.all():
+                cfg = getattr(cluster, "default_checkpoint_config", None)
+                if cfg:
+                    checkpoint_defaults = cfg
+                    break
         self.checkpoint_defaults = checkpoint_defaults
         for cluster in clusters.all():
             cluster.set_status_callback(self._on_status)

@@ -144,6 +144,29 @@ def test_matcher_sees_checkpoint_overhead_no_overcommit():
     assert pod.mem == 928.0
 
 
+def test_coordinator_adopts_cluster_defaults_no_drift():
+    """Wiring defaults only on the cluster must still protect the
+    matcher: the coordinator adopts a registered cluster's
+    default_checkpoint_config."""
+    kube, cluster, store, coord = build(
+        nodes=[__import__("cook_tpu.backends.kube", fromlist=["Node"])
+               .Node("n0", mem=1000, cpus=16)],
+        default_checkpoint_config={"memory-overhead": 128})
+    assert coord.checkpoint_defaults == {"memory-overhead": 128}
+    job = mkjob(mem=1000, checkpoint={"mode": "auto"})
+    store.create_jobs([job])
+    assert coord.match_cycle().matched == 0   # 1128 > 1000: no overcommit
+
+
+def test_modeless_checkpoint_config_is_inert():
+    # no valid mode -> no overhead, no env, no volumes
+    assert effective_checkpoint_config(
+        {"options": {"preserve-paths": ["/x"]}}, [],
+        {"memory-overhead": 512}) is None
+    assert effective_checkpoint_config(
+        {"mode": "bogus"}, [], {"memory-overhead": 512}) is None
+
+
 def test_job_without_checkpoint_unaffected():
     kube, cluster, store, coord = build(
         default_checkpoint_config={"volume-name": "tools",

@@ -1,0 +1,91 @@
+"""Dask cluster backend (integrations/dask_cook.py) against the real
+REST server + mock backend — the flow the reference's dask/docs/design.md
+describes (CookCluster.scale/adapt, CookJob lifecycle)."""
+import pytest
+
+from cook_tpu.client import JobClient
+from cook_tpu.integrations.dask_cook import (CookCluster, CookJob,
+                                             WorkerSpec)
+from cook_tpu.rest.server import ApiServer, build_scheduler
+
+
+@pytest.fixture()
+def server():
+    cfg = {"clusters": [{"name": "m1", "kind": "mock", "hosts": 4,
+                         "host_mem": 16000, "host_cpus": 16}]}
+    store, coord, api = build_scheduler(cfg)
+    srv = ApiServer(api, port=0).start()
+    yield srv, store, coord
+    srv.stop()
+
+
+def test_worker_spec_command():
+    spec = WorkerSpec(scheduler_addr="tcp://10.0.0.1:8786", mem=2048,
+                      cpus=4, extra_args=["--name", "w0"])
+    cmd = spec.command()
+    assert cmd.startswith("dask-worker tcp://10.0.0.1:8786")
+    assert "--memory-limit 2048MB" in cmd
+    assert "--nthreads 4" in cmd and "--name w0" in cmd
+    js = spec.job_spec()
+    assert js["labels"]["cook-dask-worker"] == "true"
+    assert js["mem"] == 2048 and js["cpus"] == 4
+
+
+def test_scale_up_and_down(server):
+    srv, store, coord = server
+    cluster = CookCluster(srv.url, scheduler_addr="tcp://sched:8786",
+                          user="dask",
+                          worker_spec=WorkerSpec(
+                              scheduler_addr="tcp://sched:8786",
+                              mem=1024, cpus=2))
+    cluster.client.user = "dask"
+    cluster.scale(3)
+    assert len(cluster.worker_uuids()) == 3
+    jobs = [store.get_job(u) for u in cluster.worker_uuids()]
+    assert all(j is not None and "dask-worker" in j.command for j in jobs)
+    # workers get matched and run
+    coord.match_cycle()
+    assert all(store.get_job(u).state.value == "running"
+               for u in cluster.worker_uuids())
+    # scale down kills the surplus
+    cluster.scale(1)
+    assert len(cluster.worker_uuids()) == 1
+    killed = [j for j in jobs if j.uuid not in cluster.worker_uuids()]
+    assert all(j.state.value == "completed" for j in killed)
+
+
+def test_adapt_clamps_to_bounds(server):
+    srv, _, _ = server
+    with CookCluster(srv.url, scheduler_addr="tcp://s:1", user="a") as c:
+        assert c.adapt(minimum=1, maximum=3, queued_tasks=10) == 3
+        assert len(c.worker_uuids()) == 3
+        assert c.adapt(minimum=1, maximum=3, queued_tasks=0) == 1
+        assert len(c.worker_uuids()) == 1
+    # context exit closes everything
+    assert c.worker_uuids() == []
+
+
+def test_scale_replaces_dead_workers(server):
+    srv, store, coord = server
+    c = CookCluster(srv.url, scheduler_addr="tcp://s:1", user="a")
+    c.scale(2)
+    u0 = c.worker_uuids()[0]
+    # worker dies (job killed externally)
+    JobClient(srv.url, user="a").kill(u0)
+    c.scale(2)   # reconcile: dead worker replaced
+    assert len(c.worker_uuids()) == 2
+    assert u0 not in c.worker_uuids()
+    c.close()
+
+
+def test_cook_job_lifecycle(server):
+    srv, store, coord = server
+    job = CookJob(JobClient(srv.url, user="a"),
+                  WorkerSpec(scheduler_addr="tcp://s:1"))
+    assert job.status() == "unstarted"
+    job.start()
+    assert job.status() == "waiting"
+    coord.match_cycle()
+    assert job.running()
+    job.close()
+    assert job.status() == "completed"

@@ -1,0 +1,142 @@
+"""Pallas fused match kernel (ops/pallas_match.py) vs the XLA path.
+
+Runs under interpret mode on CPU (conftest forces JAX_PLATFORMS=cpu);
+the real-TPU execution is exercised by bench.py.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from cook_tpu.ops import match as match_ops
+from cook_tpu.ops import pallas_match
+
+
+def random_problem(rng, n=16, h=128, gpu_frac=0.2, forbid_frac=0.1):
+    job_mem = rng.uniform(1, 10, n).astype(np.float32)
+    job_cpus = rng.uniform(1, 4, n).astype(np.float32)
+    job_gpus = (rng.random(n) < gpu_frac) * rng.integers(1, 3, n)
+    active = rng.random(n) < 0.9
+    unique = rng.random(n) < 0.2
+    cap_mem = rng.uniform(20, 40, h).astype(np.float32)
+    cap_cpus = rng.uniform(8, 16, h).astype(np.float32)
+    cap_gpus = (rng.random(h) < gpu_frac) * rng.integers(1, 5, h)
+    mem_left = cap_mem * rng.uniform(0, 1, h).astype(np.float32)
+    cpus_left = cap_cpus * rng.uniform(0, 1, h).astype(np.float32)
+    gpus_left = cap_gpus * rng.uniform(0, 1, h).astype(np.float32)
+    slots = rng.integers(0, 4, h).astype(np.int32)
+    hvalid = rng.random(h) < 0.95
+    occ0 = rng.random(h) < 0.1
+    forb = rng.random((n, h)) < forbid_frac
+    return dict(job_mem=job_mem, job_cpus=job_cpus,
+                job_gpus=job_gpus.astype(np.float32), active=active,
+                unique=unique, cap_mem=cap_mem, cap_cpus=cap_cpus,
+                cap_gpus=cap_gpus.astype(np.float32), mem_left=mem_left,
+                cpus_left=cpus_left, gpus_left=gpus_left, slots=slots,
+                hvalid=hvalid, occ0=occ0, forb=forb)
+
+
+def xla_reference(p, bonus=None):
+    """The exact computation match_rounds does per round on XLA."""
+    ok = np.array(match_ops._feasible(
+        jnp.asarray(p["job_mem"])[:, None], jnp.asarray(p["job_cpus"])[:, None],
+        jnp.asarray(p["job_gpus"])[:, None],
+        jnp.asarray(p["mem_left"])[None, :], jnp.asarray(p["cpus_left"])[None, :],
+        jnp.asarray(p["gpus_left"])[None, :],
+        jnp.asarray(p["cap_gpus"])[None, :], jnp.asarray(p["hvalid"])[None, :],
+        jnp.asarray(p["slots"])[None, :], jnp.asarray(p["forb"])))
+    ok &= p["active"][:, None]
+    ok &= ~(p["unique"][:, None] & p["occ0"][None, :])
+    fit = np.array(match_ops._fitness(
+        jnp.asarray(p["job_mem"])[:, None], jnp.asarray(p["job_cpus"])[:, None],
+        jnp.asarray(p["mem_left"])[None, :], jnp.asarray(p["cpus_left"])[None, :],
+        jnp.asarray(p["cap_mem"])[None, :], jnp.asarray(p["cap_cpus"])[None, :]))
+    if bonus is not None:
+        fit = fit + bonus
+    fit = np.where(ok, fit, -1.0)
+    choice = fit.argmax(axis=1)
+    best = fit[np.arange(len(choice)), choice]
+    return np.where(best > -0.5, choice, -1), best
+
+
+def pallas_result(p, bonus=None, block_n=8, block_h=128):
+    jobs_packed = pallas_match.pack_jobs(
+        jnp.asarray(p["job_mem"]), jnp.asarray(p["job_cpus"]),
+        jnp.asarray(p["job_gpus"]), jnp.asarray(p["active"]),
+        jnp.asarray(p["unique"]))
+    hosts_packed = pallas_match.pack_hosts(
+        jnp.asarray(p["mem_left"]), jnp.asarray(p["cpus_left"]),
+        jnp.asarray(p["gpus_left"]), jnp.asarray(p["cap_mem"]),
+        jnp.asarray(p["cap_cpus"]), jnp.asarray(p["cap_gpus"]),
+        jnp.asarray(p["slots"]), jnp.asarray(p["hvalid"]),
+        jnp.asarray(p["occ0"]))
+    fit, idx = pallas_match.best_host(
+        jobs_packed, hosts_packed, jnp.asarray(p["forb"], jnp.uint8),
+        None if bonus is None else jnp.asarray(bonus),
+        block_n=block_n, block_h=block_h, interpret=True)
+    return np.asarray(idx), np.asarray(fit)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_best_host_matches_xla(seed):
+    rng = np.random.default_rng(seed)
+    p = random_problem(rng, n=16, h=256)
+    ref_idx, ref_fit = xla_reference(p)
+    got_idx, got_fit = pallas_result(p, block_n=8, block_h=128)
+    np.testing.assert_array_equal(got_idx, ref_idx)
+    feas = ref_idx >= 0
+    np.testing.assert_allclose(got_fit[feas], ref_fit[feas], rtol=1e-6)
+
+
+def test_best_host_with_bonus():
+    rng = np.random.default_rng(7)
+    p = random_problem(rng, n=8, h=128, forbid_frac=0.0)
+    bonus = rng.uniform(0, 0.5, (8, 128)).astype(np.float32)
+    ref_idx, _ = xla_reference(p, bonus)
+    got_idx, _ = pallas_result(p, bonus, block_n=8, block_h=128)
+    np.testing.assert_array_equal(got_idx, ref_idx)
+
+
+def test_all_infeasible_row_gets_no_host():
+    rng = np.random.default_rng(5)
+    p = random_problem(rng, n=8, h=128)
+    p["forb"][:] = True
+    idx, fit = pallas_result(p)
+    assert (idx == -1).all()
+    assert (fit <= -0.5).all()
+
+
+def test_tie_breaks_toward_lowest_host_across_tiles():
+    rng = np.random.default_rng(9)
+    n, h = 8, 256
+    p = random_problem(rng, n=n, h=h, gpu_frac=0.0, forbid_frac=0.0)
+    # identical hosts -> identical fitness everywhere; first host wins
+    for k in ("cap_mem", "cap_cpus", "mem_left", "cpus_left"):
+        p[k] = np.full(h, 16.0, np.float32)
+    p["cap_gpus"] = np.zeros(h, np.float32)
+    p["gpus_left"] = np.zeros(h, np.float32)
+    p["job_gpus"] = np.zeros(n, np.float32)
+    p["slots"] = np.full(h, 5, np.int32)
+    p["hvalid"] = np.ones(h, bool)
+    p["occ0"] = np.zeros(h, bool)
+    p["active"] = np.ones(n, bool)
+    idx, _ = pallas_result(p, block_n=8, block_h=128)  # two H tiles
+    assert (idx == 0).all()
+
+
+def test_match_rounds_pallas_equals_xla_full():
+    """End-to-end: match_rounds with use_pallas (interpret) must produce
+    the same assignment as the XLA path for ungrouped batches."""
+    rng = np.random.default_rng(11)
+    n, h = 64, 128
+    jobs = match_ops.make_jobs(
+        mem=rng.uniform(1, 8, n), cpus=rng.uniform(1, 2, n))
+    hosts = match_ops.make_hosts(
+        mem=rng.uniform(16, 64, h), cpus=np.full(h, 8.0))
+    forb = jnp.asarray(rng.random((n, h)) < 0.05)
+    a = match_ops.match_rounds(jobs, hosts, forb, rounds=6)
+    b = match_ops.match_rounds(jobs, hosts, forb, rounds=6,
+                               use_pallas=True, pallas_interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.job_host),
+                                  np.asarray(b.job_host))
+    np.testing.assert_allclose(np.asarray(a.mem_left),
+                               np.asarray(b.mem_left), rtol=1e-5)
