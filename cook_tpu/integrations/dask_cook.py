@@ -116,21 +116,27 @@ class CookCluster:
         """Reconcile the worker fleet to exactly n jobs: submit the
         difference or kill the newest surplus (SpecCluster.scale)."""
         with self._lock:
-            # job status is waiting|running|completed; completed covers
-            # every terminal job regardless of success
+            # one batched status query for the whole fleet; job status is
+            # waiting|running|completed (completed covers every terminal
+            # job regardless of success)
+            started = [w for w in self.workers if w.uuid]
+            statuses = {}
+            if started:
+                statuses = {j.uuid: j.status for j in
+                            self.client.query_jobs(w.uuid for w in started)}
             alive = [w for w in self.workers
-                     if w.status() != "completed"]
-            dead = [w for w in self.workers if w not in alive]
-            for w in dead:
-                self.workers.remove(w)
+                     if statuses.get(w.uuid) != "completed"]
+            self.workers = list(alive)
             while len(alive) < n:
                 job = CookJob(self.client, self.spec)
                 job.start()
                 self.workers.append(job)
                 alive.append(job)
-            for w in alive[n:]:
-                w.close()
-                self.workers.remove(w)
+            surplus = alive[n:]
+            if surplus:
+                self.client.kill(*[w.uuid for w in surplus if w.uuid])
+                for w in surplus:
+                    self.workers.remove(w)
 
     def adapt(self, minimum: int = 0, maximum: int = 10,
               queued_tasks: Optional[int] = None) -> int:
@@ -148,8 +154,12 @@ class CookCluster:
 
     def close(self) -> None:
         with self._lock:
-            for w in self.workers:
-                w.close()
+            uuids = [w.uuid for w in self.workers if w.uuid]
+            if uuids:
+                try:
+                    self.client.kill(*uuids)   # one batched kill
+                except Exception:
+                    pass
             self.workers.clear()
 
     def __enter__(self) -> "CookCluster":
@@ -161,10 +171,15 @@ class CookCluster:
 
 # -- distributed-native wrapper ---------------------------------------
 def spec_cluster(url: str, scheduler_addr: str,
-                 worker_spec: Optional[WorkerSpec] = None, **kw):
-    """A dask SpecCluster whose workers are CookJob-backed. Requires
-    `distributed`; raises ImportError otherwise (the reference's doc
-    flow `CookCluster(...)` + `Client(cluster)`)."""
+                 worker_spec: Optional[WorkerSpec] = None, n_workers: int = 0,
+                 **kw):
+    """A dask SpecCluster whose workers are CookJob-backed jobs dialing
+    an EXTERNALLY-run dask scheduler at `scheduler_addr` (the reference
+    design's CookCluster + Client flow). Requires `distributed`; raises
+    ImportError otherwise. The `worker` template makes `.scale(n)` mint
+    new CookJob workers. Cannot be exercised in this image (no dask);
+    the tested core is CookCluster above.
+    """
     if not HAVE_DISTRIBUTED:
         raise ImportError(
             "distributed is not installed; use CookCluster directly or "
@@ -172,6 +187,7 @@ def spec_cluster(url: str, scheduler_addr: str,
     from distributed import SpecCluster  # type: ignore
 
     spec = worker_spec or WorkerSpec(scheduler_addr=scheduler_addr)
+    spec.scheduler_addr = spec.scheduler_addr or scheduler_addr
     client = JobClient(url)
 
     class _AsyncCookJob(ProcessInterface):  # pragma: no cover - needs dask
@@ -187,5 +203,10 @@ def spec_cluster(url: str, scheduler_addr: str,
             self._job.close()
             await super().close()
 
-    return SpecCluster(workers={"cook": {"cls": _AsyncCookJob,
-                                         "options": {}}}, **kw)
+    template = {"cls": _AsyncCookJob, "options": {}}
+    return SpecCluster(
+        workers={i: template for i in range(n_workers)},
+        worker=template,           # scale() template for new workers
+        scheduler=None,            # scheduler runs externally at
+                                   # scheduler_addr; workers dial it
+        **kw)

@@ -19,6 +19,7 @@ that consume a (mea-culpa) retry.
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -117,14 +118,48 @@ class Coordinator:
         # explicitly, adopt the defaults a registered cluster carries so
         # the matcher and the pod builder can never disagree.
         if checkpoint_defaults is None:
-            for cluster in clusters.all():
-                cfg = getattr(cluster, "default_checkpoint_config", None)
-                if cfg:
-                    checkpoint_defaults = cfg
-                    break
+            cluster_cfgs = [
+                cfg for cluster in clusters.all()
+                if (cfg := getattr(cluster, "default_checkpoint_config",
+                                   None))]
+            distinct = {json.dumps(c, sort_keys=True)
+                        for c in cluster_cfgs}
+            if len(distinct) > 1:
+                # heterogeneous per-cluster defaults would let the
+                # matcher bin-pack with one overhead while another
+                # cluster's pod builder applies a different one —
+                # refuse instead of overcommitting nodes
+                raise ValueError(
+                    "clusters carry conflicting default_checkpoint_config; "
+                    "pass one checkpoint_defaults to the Coordinator")
+            if cluster_cfgs:
+                checkpoint_defaults = cluster_cfgs[0]
         self.checkpoint_defaults = checkpoint_defaults
+        # native (C++) forbidden-mask driver with resident job state;
+        # None -> numpy fallback (constraints.build_forbidden)
+        try:
+            from cook_tpu.native.matchbook import NativeForbiddenBuilder
+            self.forbidden_builder = NativeForbiddenBuilder.create()
+        except Exception:
+            self.forbidden_builder = None
         for cluster in clusters.all():
             cluster.set_status_callback(self._on_status)
+
+    # ------------------------------------------------------------------
+    def _build_forbidden(self, jobs, host_names, host_attrs, reservations,
+                         group_attr, group_hosts):
+        """Dense constraint mask via the native match-book driver when
+        available (native/matchbook.cpp), numpy otherwise. GLOB
+        constraints (not expressible via the REST API) force the numpy
+        path."""
+        fb = self.forbidden_builder
+        if fb is not None and not any(
+                op != "EQUALS" for j in jobs for (_, op, _) in j.constraints):
+            return fb.fill(jobs, host_names, host_attrs, reservations,
+                           group_attr, group_hosts)
+        return constraints_mod.build_forbidden(
+            jobs, host_names, host_attrs, reservations, group_attr,
+            group_hosts)
 
     # ------------------------------------------------------------------
     def _effective_mem(self, job: Job) -> float:
@@ -159,6 +194,11 @@ class Coordinator:
         if job_uuid and job_uuid in self.reservations and \
                 status == InstanceStatus.RUNNING:
             self.reservations.pop(job_uuid, None)
+        # free the native match-book slot of a finished job (a later
+        # /retry re-syncs it from scratch, including all prior hosts)
+        if self.forbidden_builder is not None and job is not None and \
+                job.state == JobState.COMPLETED:
+            self.forbidden_builder.forget(job.uuid)
 
     def _purge_reservations(self) -> None:
         """Drop reservations whose job is no longer waiting (killed,
@@ -228,7 +268,7 @@ class Coordinator:
             cap_gpus=_pad([o.cap_gpus or o.gpus for o in offers], H),
             valid=np.arange(H) < len(offers),
         )
-        forb_small = constraints_mod.build_forbidden(
+        forb_small = self._build_forbidden(
             pending, host_names, host_attrs, self.reservations,
             self._group_attr_pins(pending),
             self._group_unique_hosts(pending))
@@ -420,7 +460,7 @@ class Coordinator:
                             mem_fn=self._effective_mem)
         all_attrs = self._all_host_attributes()
         host_attrs = [all_attrs.get(h, {}) for h in host_names]
-        forb_small = constraints_mod.build_forbidden(
+        forb_small = self._build_forbidden(
             pending_sorted, host_names, host_attrs, self.reservations,
             self._group_attr_pins(pending_sorted),
             self._group_unique_hosts(pending_sorted))
@@ -548,6 +588,12 @@ class Coordinator:
                     self.store.update_instance(
                         inst.task_id, InstanceStatus.FAILED, reason_code=5000)
                     lost.append(inst.task_id)
+        # native match-book gc: jobs killed while WAITING never get a
+        # backend status, so their slots are only reclaimed here
+        if self.forbidden_builder is not None:
+            live = {u for u, j in self.store.jobs.items()
+                    if j.state != JobState.COMPLETED}
+            self.forbidden_builder.gc(live)
         return {"lost": lost}
 
     # ------------------------------------------------------------------

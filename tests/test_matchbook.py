@@ -1,0 +1,121 @@
+"""Native match-book driver (native/matchbook.cpp) vs the numpy
+constraint builder — same mask, bit for bit."""
+import numpy as np
+import pytest
+
+from cook_tpu.native.matchbook import NativeForbiddenBuilder
+from cook_tpu.scheduler.constraints import build_forbidden
+from cook_tpu.state.model import Instance, InstanceStatus, Job, new_uuid
+
+pytestmark = pytest.mark.skipif(
+    NativeForbiddenBuilder.create() is None,
+    reason="native toolchain unavailable")
+
+
+def mkjob(constraints=(), prior_hosts=(), group=None):
+    job = Job(uuid=new_uuid(), user="u", command="true", mem=100, cpus=1,
+              constraints=list(constraints), group=group)
+    for h in prior_hosts:
+        job.instances.append(Instance(
+            task_id=new_uuid(), job_uuid=job.uuid, hostname=h,
+            status=InstanceStatus.FAILED))
+    return job
+
+
+def random_setup(rng, n_jobs=40, n_hosts=64):
+    host_names = [f"host-{i}" for i in range(n_hosts)]
+    host_attrs = []
+    for i in range(n_hosts):
+        a = {"rack": f"r{i % 4}"}
+        if i % 3 == 0:
+            a["zone"] = f"z{i % 2}"
+        host_attrs.append(a)
+    jobs = []
+    for i in range(n_jobs):
+        cons, prior, group = [], [], None
+        if rng.random() < 0.4:
+            cons.append(("rack", "EQUALS", f"r{int(rng.integers(4))}"))
+        if rng.random() < 0.2:
+            cons.append(("zone", "EQUALS", f"z{int(rng.integers(2))}"))
+        if rng.random() < 0.3:
+            prior = list(rng.choice(host_names,
+                                    size=int(rng.integers(1, 4)),
+                                    replace=False))
+        if rng.random() < 0.25:
+            group = f"g{int(rng.integers(3))}"
+        jobs.append(mkjob(cons, prior, group))
+    reservations = {jobs[0].uuid: host_names[5],
+                    jobs[1].uuid: host_names[9]}
+    group_attr = {"g0": {"rack": "r1"}}
+    group_hosts = {"g1": {host_names[2], host_names[7]}}
+    return jobs, host_names, host_attrs, reservations, group_attr, \
+        group_hosts
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_native_equals_numpy(seed):
+    rng = np.random.default_rng(seed)
+    jobs, names, attrs, resv, gattr, ghosts = random_setup(rng)
+    ref = build_forbidden(jobs, names, attrs, resv, gattr, ghosts)
+    fb = NativeForbiddenBuilder.create()
+    got = fb.fill(jobs, names, attrs, resv, gattr, ghosts)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_incremental_sync_across_cycles():
+    fb = NativeForbiddenBuilder.create()
+    job = mkjob()
+    names = ["h0", "h1", "h2"]
+    attrs = [{}, {}, {}]
+    m1 = fb.fill([job], names, attrs)
+    assert not m1.any()
+    # a failure on h1 becomes a novel-host exclusion next cycle
+    job.instances.append(Instance(task_id=new_uuid(), job_uuid=job.uuid,
+                                  hostname="h1",
+                                  status=InstanceStatus.FAILED))
+    m2 = fb.fill([job], names, attrs)
+    assert m2[0].tolist() == [False, True, False]
+    # host set can change between cycles (h1 gone, h3 appears)
+    m3 = fb.fill([job], ["h0", "h3"], [{}, {}])
+    assert m3[0].tolist() == [False, False]
+
+
+def test_forget_and_gc_free_slots():
+    fb = NativeForbiddenBuilder.create()
+    jobs = [mkjob() for _ in range(5)]
+    fb.fill(jobs, ["h0"], [{}])
+    assert len(fb._jobs) == 5
+    fb.forget(jobs[0].uuid)
+    assert fb.gc({j.uuid for j in jobs[1:3]}) == 2
+    assert set(fb._jobs) == {jobs[1].uuid, jobs[2].uuid}
+    # forgotten job re-syncs from scratch including prior hosts
+    jobs[0].instances.append(Instance(
+        task_id=new_uuid(), job_uuid=jobs[0].uuid, hostname="h0",
+        status=InstanceStatus.FAILED))
+    m = fb.fill([jobs[0]], ["h0", "h1"], [{}, {}])
+    assert m[0].tolist() == [True, False]
+
+
+def test_constraint_on_absent_attribute_forbids_everywhere():
+    fb = NativeForbiddenBuilder.create()
+    job = mkjob(constraints=[("nonexistent", "EQUALS", "x")])
+    ref = build_forbidden([job], ["h0", "h1"], [{}, {}])
+    got = fb.fill([job], ["h0", "h1"], [{}, {}])
+    np.testing.assert_array_equal(got, ref)
+    assert got.all()
+
+
+def test_coordinator_uses_native_builder():
+    from tests.test_coordinator import build
+    store, cluster, coord = build()
+    assert coord.forbidden_builder is not None
+    from cook_tpu.state.model import JobState
+    job = mkjob(prior_hosts=["h0"])
+    store.create_jobs([job])
+    coord.match_cycle()
+    # novel-host honored through the native path: must land on h1
+    assert job.instances[-1].hostname == "h1"
+    # completed jobs are forgotten (slot freed)
+    cluster.advance(120.0)
+    assert job.state == JobState.COMPLETED
+    assert job.uuid not in coord.forbidden_builder._jobs
