@@ -134,7 +134,10 @@ class CookCluster:
                 alive.append(job)
             surplus = alive[n:]
             if surplus:
-                self.client.kill(*[w.uuid for w in surplus if w.uuid])
+                try:
+                    self.client.kill(*[w.uuid for w in surplus if w.uuid])
+                except Exception:
+                    pass   # best-effort, same contract as close()
                 for w in surplus:
                     self.workers.remove(w)
 
@@ -170,15 +173,24 @@ class CookCluster:
 
 
 # -- distributed-native wrapper ---------------------------------------
-def spec_cluster(url: str, scheduler_addr: str,
+def spec_cluster(url: str, scheduler_addr: str = "",
                  worker_spec: Optional[WorkerSpec] = None, n_workers: int = 0,
                  **kw):
-    """A dask SpecCluster whose workers are CookJob-backed jobs dialing
-    an EXTERNALLY-run dask scheduler at `scheduler_addr` (the reference
-    design's CookCluster + Client flow). Requires `distributed`; raises
-    ImportError otherwise. The `worker` template makes `.scale(n)` mint
-    new CookJob workers. Cannot be exercised in this image (no dask);
-    the tested core is CookCluster above.
+    """A dask SpecCluster whose workers are CookJob-backed jobs.
+
+    SpecCluster always manages its own in-process dask scheduler (that is
+    its contract: scheduler=None makes it start a default `Scheduler`);
+    each worker start receives that scheduler's address as the first
+    positional argument and the CookJob dials it — unless
+    `scheduler_addr` is given, which overrides the dial address (for
+    NAT/advertised-address setups where workers must use a different
+    route than the in-process listen address). For a dask scheduler run
+    entirely outside this process, use `CookCluster` +
+    `distributed.Client(addr)` directly.
+
+    Requires `distributed`; raises ImportError otherwise. The `worker`
+    template makes `.scale(n)` mint new CookJob workers. Cannot be
+    exercised in this image (no dask); the tested core is CookCluster.
     """
     if not HAVE_DISTRIBUTED:
         raise ImportError(
@@ -187,12 +199,14 @@ def spec_cluster(url: str, scheduler_addr: str,
     from distributed import SpecCluster  # type: ignore
 
     spec = worker_spec or WorkerSpec(scheduler_addr=scheduler_addr)
-    spec.scheduler_addr = spec.scheduler_addr or scheduler_addr
+    spec.scheduler_addr = scheduler_addr or spec.scheduler_addr
     client = JobClient(url)
 
     class _AsyncCookJob(ProcessInterface):  # pragma: no cover - needs dask
-        def __init__(self, *a, **k):
+        def __init__(self, scheduler_address=None, **k):
             super().__init__()
+            if not spec.scheduler_addr and scheduler_address:
+                spec.scheduler_addr = scheduler_address
             self._job = CookJob(client, spec)
 
         async def start(self):
@@ -207,6 +221,4 @@ def spec_cluster(url: str, scheduler_addr: str,
     return SpecCluster(
         workers={i: template for i in range(n_workers)},
         worker=template,           # scale() template for new workers
-        scheduler=None,            # scheduler runs externally at
-                                   # scheduler_addr; workers dial it
         **kw)

@@ -117,9 +117,12 @@ void mb_set_hosts(int64_t h, const int64_t* names, int64_t n) {
     b->reserved_owner.assign(n, -1);
 }
 
-// one (attr, value) pair of one host; builds the dense column lazily
+// one (attr, value) pair of one host; builds the dense column lazily.
+// Out-of-range host indices are dropped — this ABI is exposed to
+// evolving Python callers and must fail safe, not corrupt the heap.
 void mb_host_attr(int64_t h, int32_t host, int64_t attr, int64_t val) {
     Book* b = B(h);
+    if (host < 0 || host >= (int64_t)b->host_names.size()) return;
     auto& col = b->attr_cols[attr];
     if (col.empty()) col.assign(b->host_names.size(), -1);
     col[host] = val;
@@ -130,7 +133,9 @@ void mb_set_host_attrs(int64_t h, const int32_t* hosts,
                        const int64_t* attrs, const int64_t* vals,
                        int64_t n) {
     Book* b = B(h);
+    const int64_t H = static_cast<int64_t>(b->host_names.size());
     for (int64_t i = 0; i < n; i++) {
+        if (hosts[i] < 0 || hosts[i] >= H) continue;
         auto& col = b->attr_cols[attrs[i]];
         if (col.empty()) col.assign(b->host_names.size(), -1);
         col[hosts[i]] = vals[i];

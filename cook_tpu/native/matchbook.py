@@ -64,12 +64,18 @@ class _Interner:
 
     def __init__(self):
         self.ids: dict[str, int] = {}
+        self._next = 0
 
     def id(self, s: str) -> int:
         i = self.ids.get(s)
         if i is None:
-            i = self.ids[s] = len(self.ids)
+            i = self.ids[s] = self._next
+            self._next += 1
         return i
+
+    def drop(self, s: str) -> None:
+        """Evict one interned string (ids are never reused)."""
+        self.ids.pop(s, None)
 
 
 class NativeForbiddenBuilder:
@@ -95,7 +101,10 @@ class NativeForbiddenBuilder:
             raise OSError("native matchbook unavailable")
         self._h = self._lib.mb_create()
         self._strs = _Interner()
-        # job uuid -> (slot, n_prior_hosts_pushed, n_constraints_pushed)
+        # job uuid -> [slot, n_prior_hosts_pushed].  Constraints are
+        # pushed once at first sight: the REST API fixes a job's
+        # constraints at submission (rest/api.py) and nothing mutates
+        # them afterwards, so only the instance list needs delta-sync.
         self._jobs: dict[str, list] = {}
         # matchbook.cpp is single-writer by design; the coordinator calls
         # in from the match loop, the rebalancer loop, and backend status
@@ -114,13 +123,13 @@ class NativeForbiddenBuilder:
         ent = self._jobs.get(job.uuid)
         if ent is None:
             slot = self._lib.mb_add_job(self._h, self._strs.id(job.uuid))
-            ent = self._jobs[job.uuid] = [slot, 0, 0]
+            ent = self._jobs[job.uuid] = [slot, 0]
             for (attr, op, pattern) in job.constraints:
                 if op == "EQUALS":
                     self._lib.mb_job_constraint(
                         self._h, slot, self._strs.id("a:" + attr),
                         self._strs.id("v:" + str(pattern)))
-        slot, n_hosts, _ = ent
+        slot, n_hosts = ent
         insts = job.instances
         for inst in insts[n_hosts:]:
             self._lib.mb_job_prior_host(self._h, slot,
@@ -137,6 +146,10 @@ class NativeForbiddenBuilder:
         ent = self._jobs.pop(job_uuid, None)
         if ent is not None:
             self._lib.mb_remove_job(self._h, self._strs.id(job_uuid))
+            # Job uuids are unbounded over a coordinator's lifetime —
+            # evict the interned id with the C++ slot.  (Host/attr ids
+            # are naturally bounded by the cluster and stay.)
+            self._strs.drop(job_uuid)
 
     def gc(self, live_uuids) -> int:
         """Forget every tracked job not in live_uuids (catches jobs

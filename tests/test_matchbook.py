@@ -119,3 +119,27 @@ def test_coordinator_uses_native_builder():
     cluster.advance(120.0)
     assert job.state == JobState.COMPLETED
     assert job.uuid not in coord.forbidden_builder._jobs
+
+
+def test_forget_evicts_interned_uuid():
+    # job uuids are unbounded in a long-lived coordinator; forget()
+    # must release the interner entry along with the C++ slot
+    fb = NativeForbiddenBuilder.create()
+    jobs = [mkjob() for _ in range(8)]
+    fb.fill(jobs, ["h0"], [{}])
+    before = len(fb._strs.ids)
+    for j in jobs:
+        fb.forget(j.uuid)
+    assert len(fb._strs.ids) == before - len(jobs)
+    # a re-arriving uuid gets a fresh id and a working slot
+    m = fb.fill([jobs[0]], ["h0"], [{}])
+    assert m.shape == (1, 1)
+
+
+def test_out_of_range_host_attr_is_dropped_not_fatal():
+    # a host_attrs list longer than host_names must not corrupt the heap
+    fb = NativeForbiddenBuilder.create()
+    job = mkjob(constraints=[("rack", "EQUALS", "r0")])
+    got = fb.fill([job], ["h0"], [{"rack": "r0"}, {"rack": "r1"}])
+    assert got.shape == (1, 1)
+    assert not got[0, 0]
