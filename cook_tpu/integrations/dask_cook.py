@@ -137,7 +137,10 @@ class CookCluster:
                 try:
                     self.client.kill(*[w.uuid for w in surplus if w.uuid])
                 except Exception:
-                    pass   # best-effort, same contract as close()
+                    # kill failed: keep them tracked so the next
+                    # scale()/close() retries instead of leaking the
+                    # still-running jobs
+                    return
                 for w in surplus:
                     self.workers.remove(w)
 

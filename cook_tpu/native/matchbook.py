@@ -60,22 +60,66 @@ def _load():
 
 
 class _Interner:
-    """str -> stable int64 id (strings never cross the C ABI)."""
+    """str -> stable int64 id (strings never cross the C ABI).
+
+    Cluster-bounded strings (host names, attribute names, attribute
+    values observed on hosts) are interned forever — pinned — via
+    `id()`. Job-scoped strings (job uuids, constraint patterns —
+    unbounded over a coordinator's lifetime) go through
+    `id_ref()`/`drop_ref()` refcounting so their entries die with the
+    last job using them. A string seen through BOTH (a constraint
+    pattern that is also a live host-attr value) is pinned: evicting it
+    would mint a new id for the host side while C++ job constraints
+    still hold the old one, silently un-matching them.
+    """
+
+    _PINNED = -1
 
     def __init__(self):
         self.ids: dict[str, int] = {}
+        self._refs: dict[str, int] = {}
         self._next = 0
 
-    def id(self, s: str) -> int:
+    def _intern(self, s: str) -> int:
         i = self.ids.get(s)
         if i is None:
             i = self.ids[s] = self._next
             self._next += 1
         return i
 
-    def drop(self, s: str) -> None:
-        """Evict one interned string (ids are never reused)."""
-        self.ids.pop(s, None)
+    def id(self, s: str) -> int:
+        i = self._intern(s)
+        self._refs[s] = self._PINNED
+        return i
+
+    def id_ref(self, s: str) -> int:
+        i = self._intern(s)
+        n = self._refs.get(s, 0)
+        if n != self._PINNED:
+            self._refs[s] = n + 1
+        return i
+
+    def drop_ref(self, s: str) -> None:
+        """Release one reference; evict at zero (ids never reused)."""
+        n = self._refs.get(s)
+        if n is None or n == self._PINNED:
+            return
+        if n <= 1:
+            del self._refs[s]
+            self.ids.pop(s, None)
+        else:
+            self._refs[s] = n - 1
+
+    def peek(self, s: str) -> int:
+        """Existing id, or a fresh UNSTORED one. For transient mentions
+        (reservation owners) that must compare equal to a live job's id
+        when one exists but must never create a persistent entry."""
+        i = self.ids.get(s)
+        if i is not None:
+            return i
+        i = self._next
+        self._next += 1
+        return i
 
 
 class NativeForbiddenBuilder:
@@ -101,10 +145,11 @@ class NativeForbiddenBuilder:
             raise OSError("native matchbook unavailable")
         self._h = self._lib.mb_create()
         self._strs = _Interner()
-        # job uuid -> [slot, n_prior_hosts_pushed].  Constraints are
-        # pushed once at first sight: the REST API fixes a job's
-        # constraints at submission (rest/api.py) and nothing mutates
-        # them afterwards, so only the instance list needs delta-sync.
+        # job uuid -> [slot, n_prior_hosts_pushed, ref'd value strings].
+        # Constraints are pushed once at first sight: the REST API fixes
+        # a job's constraints at submission (rest/api.py) and nothing
+        # mutates them afterwards, so only the instance list needs
+        # delta-sync.
         self._jobs: dict[str, list] = {}
         # matchbook.cpp is single-writer by design; the coordinator calls
         # in from the match loop, the rebalancer loop, and backend status
@@ -122,14 +167,18 @@ class NativeForbiddenBuilder:
     def _sync_job(self, job) -> int:
         ent = self._jobs.get(job.uuid)
         if ent is None:
-            slot = self._lib.mb_add_job(self._h, self._strs.id(job.uuid))
-            ent = self._jobs[job.uuid] = [slot, 0]
+            slot = self._lib.mb_add_job(self._h,
+                                        self._strs.id_ref(job.uuid))
+            vals: list[str] = []
+            ent = self._jobs[job.uuid] = [slot, 0, vals]
             for (attr, op, pattern) in job.constraints:
                 if op == "EQUALS":
+                    v = "v:" + str(pattern)
                     self._lib.mb_job_constraint(
                         self._h, slot, self._strs.id("a:" + attr),
-                        self._strs.id("v:" + str(pattern)))
-        slot, n_hosts = ent
+                        self._strs.id_ref(v))
+                    vals.append(v)
+        slot, n_hosts, _ = ent
         insts = job.instances
         for inst in insts[n_hosts:]:
             self._lib.mb_job_prior_host(self._h, slot,
@@ -145,11 +194,16 @@ class NativeForbiddenBuilder:
     def _forget_locked(self, job_uuid: str) -> None:
         ent = self._jobs.pop(job_uuid, None)
         if ent is not None:
-            self._lib.mb_remove_job(self._h, self._strs.id(job_uuid))
-            # Job uuids are unbounded over a coordinator's lifetime —
-            # evict the interned id with the C++ slot.  (Host/attr ids
-            # are naturally bounded by the cluster and stay.)
-            self._strs.drop(job_uuid)
+            uid = self._strs.ids.get(job_uuid)
+            if uid is not None:
+                self._lib.mb_remove_job(self._h, uid)
+            # Job-scoped strings (uuid + constraint patterns) are
+            # unbounded over a coordinator's lifetime — release them
+            # with the C++ slot. Cluster-bounded host/attr strings are
+            # pinned and stay.
+            self._strs.drop_ref(job_uuid)
+            for v in ent[2]:
+                self._strs.drop_ref(v)
 
     def gc(self, live_uuids) -> int:
         """Forget every tracked job not in live_uuids (catches jobs
@@ -192,9 +246,6 @@ class NativeForbiddenBuilder:
                 acol.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 vcol.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 len(triples))
-        for owner_uuid, hostname in (reservations or {}).items():
-            lib.mb_reserve(h, sid("h:" + hostname), sid(owner_uuid))
-
         slots = np.empty(len(jobs), np.int32)
         for j, job in enumerate(jobs):
             slot = self._sync_job(job)
@@ -208,6 +259,13 @@ class NativeForbiddenBuilder:
                     job.group in group_cotask_hosts:
                 for hostname in group_cotask_hosts[job.group]:
                     lib.mb_job_tmp_exclude(h, slot, sid("h:" + hostname))
+
+        # Reservations AFTER job sync: peek() must see an owner's
+        # interned uuid when the owner is in this batch, or the owner
+        # would be locked out of its own reserved host.
+        for owner_uuid, hostname in (reservations or {}).items():
+            lib.mb_reserve(h, sid("h:" + hostname),
+                           self._strs.peek(owner_uuid))
 
         out = np.empty((len(jobs), len(host_names)), np.uint8)
         lib.mb_fill_forbidden(

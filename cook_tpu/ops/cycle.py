@@ -163,7 +163,7 @@ def rank_and_match(
         res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups,
                                    bonus=bonusc)
     else:
-        res = match_ops.match_rounds(jobs, hosts, forb, rounds=12,
+        res = match_ops.match_rounds(jobs, hosts, forb, rounds=4,
                                      num_groups=num_groups, bonus=bonusc,
                                      use_pallas=use_pallas)
     # scatter back: compact -> original pending order in one scatter

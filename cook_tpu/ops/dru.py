@@ -87,11 +87,12 @@ def dru_rank(
     s_mem = jnp.where(s_valid, mem[perm], 0.0)
     s_cpus = jnp.where(s_valid, cpus[perm], 0.0)
 
-    cum = segment_cumsum(jnp.stack([s_mem, s_cpus], axis=-1), s_user)
+    cum, within = _sorted_segment_cumsum(
+        jnp.stack([s_mem, s_cpus], axis=-1), s_user, s_valid)
     s_dru = jnp.maximum(cum[:, 0] / mem_share[perm], cum[:, 1] / cpus_share[perm])
     s_dru = jnp.where(s_valid, s_dru, PAD_DRU)
 
-    return _merge(perm, s_user, s_dru, s_valid)
+    return _merge(perm, s_user, s_dru, within)
 
 
 def gpu_dru_rank(
@@ -108,19 +109,43 @@ def gpu_dru_rank(
     s_user = user[perm]
     s_valid = valid[perm]
     s_gpus = jnp.where(s_valid, gpus[perm], 0.0)
-    cum = segment_cumsum(s_gpus, s_user)
+    cum, within = _sorted_segment_cumsum(s_gpus, s_user, s_valid)
     s_dru = jnp.where(s_valid, cum / gpu_share[perm], PAD_DRU)
-    return _merge(perm, s_user, s_dru, s_valid)
+    return _merge(perm, s_user, s_dru, within)
 
 
-def _merge(perm, s_user, s_dru, s_valid) -> RankedTasks:
+def _sorted_segment_cumsum(values, s_user, s_valid):
+    """Per-user inclusive cumsum + within-user rank for task arrays
+    already in user_task_sort order.
+
+    Shares one segment-start pass (associative max-scan — see the
+    ops.segments note on why never `cummax`, and measured faster here
+    than searchsorted, whose default method is a serial bit-scan loop)
+    between the cumulative sum and the within-user rank, which falls out
+    for free as `idx - start_idx` (saving the second scan `segment_rank`
+    would do).
+    """
+    import jax
+
+    n = s_user.shape[0]
+    s_key = jnp.where(s_valid, s_user, jnp.iinfo(jnp.int32).max)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    starts = jnp.where(idx == 0, True, s_key != jnp.roll(s_key, 1))
+    start_idx = jax.lax.associative_scan(jnp.maximum,
+                                         jnp.where(starts, idx, -1))
+    total = jnp.cumsum(values, axis=0)
+    base = jnp.take(total, start_idx, axis=0) - jnp.take(values, start_idx,
+                                                         axis=0)
+    return total - base, idx - start_idx
+
+
+def _merge(perm, s_user, s_dru, within) -> RankedTasks:
     """Global k-way merge: sort by (dru, user, within-user position).
 
     Matches dru.clj:111-121: ascending dru, deterministic tie-break by
     user (`sort-by first`), and each user's internal order preserved.
     """
     n = perm.shape[0]
-    within = segment_rank(s_user)
     merge_perm = jnp.lexsort((within, s_user, s_dru))
     order = perm[merge_perm]
 

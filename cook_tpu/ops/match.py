@@ -15,12 +15,27 @@ TPU-native re-design, two kernels:
                per-cycle considerable batch (reference default 1000 jobs,
                config.clj:319-324).
 
-  match_rounds batched variant for very large batches: R rounds of
-               (score -> each job picks its best host -> each host accepts
-               the feasible *prefix* of its claimants in queue order via a
-               segmented cumsum -> deplete). Converges to greedy within a
-               few rounds and runs thousands of decisions per device step;
-               used for the 100k-pending benchmark configs.
+  match_rounds batched variant for very large batches. Two round kinds:
+
+               *water-fill rounds* (the workhorse): hosts are ordered by
+               utilization descending (the direction cpuMemBinPacker
+               steers), their remaining capacities prefix-summed, job
+               demands prefix-summed in queue order, and each job bids on
+               the host whose cumulative-capacity window contains its
+               cumulative demand (two searchsorteds). This is O(N log H)
+               with no N x H matrix and lands nearly the whole batch in
+               one round — a naive "every job argmaxes fitness" round
+               collapses onto the single most-utilized host and lands
+               only ~hosts-worth of jobs per round.
+
+               *dense rounds* (mop-up): the full (score -> argmax ->
+               accept) round over the N x H fitness matrix, for jobs
+               water-fill can't serve: gpu jobs, jobs with forbidden
+               hosts, and any job when a data-locality bonus is present.
+
+               Hosts accept the feasible *prefix* of their claimants in
+               queue order via a segmented cumsum, so every accepted
+               assignment is valid (never oversubscribes) in both kinds.
 
 Fitness is the Fenzo cpuMemBinPacker (config.clj:92): the mean of
 post-assignment cpu and mem utilization on the host — prefers filling
@@ -170,14 +185,19 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
 
 @functools.partial(jax.jit, static_argnames=("rounds", "num_groups",
                                              "use_pallas",
-                                             "pallas_interpret"))
+                                             "pallas_interpret",
+                                             "dense_rounds", "spread"))
 def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                  rounds: int = 4, num_groups: int = 1,
                  bonus: jnp.ndarray | None = None,
                  use_pallas: bool = False,
-                 pallas_interpret: bool = False) -> MatchResult:
-    """Batched greedy approximation: all jobs bid at once, hosts accept
-    the feasible prefix of their bidders in queue order, repeat.
+                 pallas_interpret: bool = False,
+                 dense_rounds: int = 6,
+                 spread: float = 0.2) -> MatchResult:
+    """Batched greedy approximation: `rounds` water-fill rounds then
+    `dense_rounds` dense argmax rounds (see module docstring), with hosts
+    accepting the feasible prefix of their bidders in queue order after
+    every round.
 
     Group-unique coupling is approximated by letting at most the
     first-ranked member of each (group, host) pair through per round.
@@ -185,7 +205,7 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     accepted assignment is always *valid* (never oversubscribes), which is
     the safety property the scheduler relies on.
 
-    use_pallas: route the per-round dense feasibility+fitness+argmax
+    use_pallas: route the dense rounds' feasibility+fitness+argmax
     through the fused Pallas TPU kernel (ops.pallas_match). Requires
     num_groups == 1 (the kernel folds group-0 unique occupancy in; the
     multi-group gather stays on the XLA path).
@@ -193,6 +213,7 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     N = jobs.mem.shape[0]
     H = hosts.mem.shape[0]
     rank = jnp.arange(N)
+    BIG = jnp.float32(3.4e38)
     # pallas path needs block-divisible power-of-two shapes (the
     # coordinator's bucket() padding guarantees this; arbitrary direct
     # callers fall back to XLA instead of silently truncating)
@@ -202,45 +223,23 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         from cook_tpu.ops import pallas_match
         forb_u8 = forbidden.astype(jnp.uint8)
 
-    def one_round(state, _):
+    # Jobs water-fill can serve: cpu/mem-only demand and no per-host
+    # exclusions. Everyone else (gpu jobs, constrained jobs, all jobs
+    # under a locality bonus) goes through the dense rounds.
+    plain = jobs.valid & (jobs.gpus <= 0) & ~jnp.any(forbidden, axis=1)
+    if bonus is not None:
+        plain &= False
+        # The jitter exists to de-collapse pure bin-packing ties; a
+        # locality bonus is a real preference (weight ~0.25,
+        # data_locality.clj:192) that noise of similar magnitude would
+        # override, and it already diversifies bids by itself.
+        spread = 0.0
+    gclip = jnp.clip(jobs.group, 0, num_groups - 1)
+
+    def accept_bids(state, choice, bids):
+        """Hosts accept claimants in queue order while they still fit:
+        sort bidders by (choice, rank), segmented cumsum of demands."""
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
-        unassigned = jobs.valid & (job_host == NO_HOST)
-        gclip = jnp.clip(jobs.group, 0, num_groups - 1)
-
-        if use_pallas:
-            jobs_packed = pallas_match.pack_jobs(
-                jobs.mem, jobs.cpus, jobs.gpus, unassigned,
-                jobs.unique_group)
-            hosts_packed = pallas_match.pack_hosts(
-                mem_left, cpus_left, gpus_left, hosts.cap_mem,
-                hosts.cap_cpus, hosts.cap_gpus, slots_left, hosts.valid,
-                group_occ[0])
-            best_fit, best = pallas_match.best_host(
-                jobs_packed, hosts_packed, forb_u8, bonus,
-                interpret=pallas_interpret)
-            choice = jnp.clip(best, 0, H - 1)
-            bids = best_fit > -0.5
-        else:
-            ok = _feasible(jobs.mem[:, None], jobs.cpus[:, None],
-                           jobs.gpus[:, None],
-                           mem_left[None, :], cpus_left[None, :],
-                           gpus_left[None, :],
-                           hosts.cap_gpus[None, :], hosts.valid[None, :],
-                           slots_left[None, :], forbidden)
-            ok &= unassigned[:, None]
-            # group-unique vs assignments from previous rounds
-            ok &= ~(jobs.unique_group[:, None] & group_occ[gclip])
-            fit = _fitness(jobs.mem[:, None], jobs.cpus[:, None],
-                           mem_left[None, :], cpus_left[None, :],
-                           hosts.cap_mem[None, :], hosts.cap_cpus[None, :])
-            if bonus is not None:
-                fit = fit + bonus
-            fit = jnp.where(ok, fit, -1.0)
-            choice = jnp.argmax(fit, axis=1)
-            bids = fit[rank, choice] > -0.5  # job has any feasible host
-
-        # Hosts accept claimants in queue order while they still fit:
-        # sort bidders by (choice, rank), segmented cumsum of demands.
         sort_host = jnp.where(bids, choice, H)  # non-bidders to the end
         perm = jnp.lexsort((rank, sort_host))
         p_host = sort_host[perm]
@@ -267,7 +266,13 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         first_of_gh = jnp.zeros(N, bool).at[gperm].set(
             jnp.concatenate([jnp.array([True]),
                              gh_key[gperm][1:] != gh_key[gperm][:-1]]))
-        accept_sorted = bids[perm] & fits_prefix & (first_of_gh | ~p_unique)
+        # ... and hosts already holding a member from a previous round
+        # never accept another (the dense bid mask also checks this, but
+        # water-fill bids don't — acceptance is the single safety gate).
+        occupied = group_occ[jnp.clip(p_group, 0, num_groups - 1), ph]
+        accept_sorted = (bids[perm] & fits_prefix
+                         & (first_of_gh | ~p_unique)
+                         & ~(p_unique & occupied))
 
         accept = jnp.zeros(N, bool).at[perm].set(accept_sorted)
         new_host = jnp.where(accept, choice, job_host)
@@ -286,13 +291,132 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         gh_hit = (accept & jobs.unique_group)
         group_occ = group_occ.at[gclip, jnp.clip(choice, 0, H - 1)].max(gh_hit)
         return (new_host, mem_left, cpus_left, gpus_left, slots_left,
-                group_occ), None
+                group_occ)
 
-    init = (varying_full(jobs.valid, NO_HOST, (N,), jnp.int32),
-            hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots,
-            varying_full(hosts.valid, False, (num_groups, H), bool))
-    (job_host, mem_left, cpus_left, gpus_left, _, _), _ = jax.lax.scan(
-        one_round, init, None, length=rounds)
+    def water_round(state, round_i):
+        job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
+        unassigned = plain & (job_host == NO_HOST)
+        # Non-gpu jobs never land on gpu hosts (constraints.clj:102-128),
+        # so gpu hosts are unusable here.
+        usable = (hosts.valid & (slots_left > 0) & (hosts.cap_gpus <= 0)
+                  & (mem_left > 1e-6) & (cpus_left > 1e-6))
+
+        def window_bids(_):
+            # Round 0 — mass placement. Hosts in bin-packing fill order:
+            # utilization descending, the same direction the
+            # cpuMemBinPacker argmax walks; cumulative-capacity windows
+            # absorb the whole queue in one pass.
+            util = _fitness(0.0, 0.0, mem_left, cpus_left,
+                            hosts.cap_mem, hosts.cap_cpus)
+            order = jnp.argsort(jnp.where(usable, -util, BIG))
+            o_usable = usable[order]
+            cum_mem = jnp.cumsum(jnp.where(o_usable, mem_left[order], 0.0))
+            cum_cpus = jnp.cumsum(jnp.where(o_usable, cpus_left[order], 0.0))
+            # Cumulative demand of the bidding jobs in queue order; each
+            # job bids on the host whose capacity window covers its
+            # prefix on BOTH resources.
+            cm = jnp.cumsum(jnp.where(unassigned, jobs.mem, 0.0))
+            cc = jnp.cumsum(jnp.where(unassigned, jobs.cpus, 0.0))
+            slot = jnp.maximum(jnp.searchsorted(cum_mem, cm, side="left"),
+                               jnp.searchsorted(cum_cpus, cc, side="left"))
+            choice = order[jnp.clip(slot, 0, H - 1)]
+            bids = unassigned & (slot < H) \
+                & o_usable[jnp.clip(slot, 0, H - 1)]
+            return choice, bids
+
+        def pairing_bids(_):
+            # Later rounds — straggler placement. After round 0 the
+            # per-host remnants are often smaller than a single job, so
+            # cumulative windows keep splitting jobs across hosts that
+            # can't individually take them. Pair instead: k-th largest
+            # remaining job bids the k-th roomiest host, one job per
+            # host, alternating the pairing resource so a job big on the
+            # other axis doesn't hit the same misfit host forever.
+            jdemand = jnp.where(round_i % 2 == 1, jobs.mem, jobs.cpus)
+            hroom = jnp.where(round_i % 2 == 1, mem_left, cpus_left)
+            jrank_perm = jnp.argsort(jnp.where(unassigned, -jdemand, BIG))
+            jrank = jnp.zeros(N, jnp.int32).at[jrank_perm].set(
+                jnp.arange(N, dtype=jnp.int32))
+            hperm = jnp.argsort(jnp.where(usable, -hroom, BIG))
+            n_usable = jnp.sum(usable.astype(jnp.int32))
+            choice = hperm[jnp.clip(jrank, 0, H - 1)]
+            bids = unassigned & (jrank < n_usable)
+            return choice, bids
+
+        choice, bids = jax.lax.cond(round_i == 0, window_bids, pairing_bids,
+                                    None)
+        return accept_bids(state, choice, bids), None
+
+    def dense_round(state, _):
+        job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
+        unassigned = jobs.valid & (job_host == NO_HOST)
+
+        if use_pallas:
+            jobs_packed = pallas_match.pack_jobs(
+                jobs.mem, jobs.cpus, jobs.gpus, unassigned,
+                jobs.unique_group)
+            hosts_packed = pallas_match.pack_hosts(
+                mem_left, cpus_left, gpus_left, hosts.cap_mem,
+                hosts.cap_cpus, hosts.cap_gpus, slots_left, hosts.valid,
+                group_occ[0])
+            best_fit, best = pallas_match.best_host(
+                jobs_packed, hosts_packed, forb_u8, bonus,
+                interpret=pallas_interpret, spread=spread)
+            choice = jnp.clip(best, 0, H - 1)
+            bids = best_fit > -0.5
+        else:
+            ok = _feasible(jobs.mem[:, None], jobs.cpus[:, None],
+                           jobs.gpus[:, None],
+                           mem_left[None, :], cpus_left[None, :],
+                           gpus_left[None, :],
+                           hosts.cap_gpus[None, :], hosts.valid[None, :],
+                           slots_left[None, :], forbidden)
+            ok &= unassigned[:, None]
+            # group-unique vs assignments from previous rounds
+            ok &= ~(jobs.unique_group[:, None] & group_occ[gclip])
+            fit = _fitness(jobs.mem[:, None], jobs.cpus[:, None],
+                           mem_left[None, :], cpus_left[None, :],
+                           hosts.cap_mem[None, :], hosts.cap_cpus[None, :])
+            if bonus is not None:
+                fit = fit + bonus
+            # Deterministic per-(job, host) jitter spreads bids across
+            # hosts within `spread` of each job's best fitness — without
+            # it every job argmaxes the same most-utilized host and a
+            # round lands only one host's prefix. Fenzo accepts any host
+            # with fitness >= good-enough-fitness 0.8 (config.clj:337),
+            # so a 0.2 preference band is the reference's own slack.
+            z = (rank.astype(jnp.uint32)[:, None] * jnp.uint32(2654435761)
+                 + jnp.arange(H, dtype=jnp.uint32)[None, :] * jnp.uint32(40503))
+            z = z ^ (z >> 15)
+            z = z * jnp.uint32(2246822519)
+            z = z ^ (z >> 13)
+            noise = (z & jnp.uint32(0xFFFF)).astype(jnp.float32) \
+                / 65536.0 * spread
+            fit = jnp.where(ok, fit + noise, -1.0)
+            choice = jnp.argmax(fit, axis=1)
+            bids = fit[rank, choice] > -0.5  # job has any feasible host
+
+        return accept_bids(state, choice, bids), None
+
+    state = (varying_full(jobs.valid, NO_HOST, (N,), jnp.int32),
+             hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots,
+             varying_full(hosts.valid, False, (num_groups, H), bool))
+    if rounds > 0:
+        state, _ = jax.lax.scan(water_round, state,
+                                jnp.arange(rounds, dtype=jnp.int32))
+    if dense_rounds > 0:
+        # Skip the N x H dense passes at runtime when nothing is left to
+        # place. Any unassigned valid job keeps them on — plain
+        # stragglers water-fill couldn't pair (e.g. big on both axes
+        # with only single-axis room left) still deserve the exact
+        # argmax before the cycle gives up on them.
+        def run_dense(s):
+            s, _ = jax.lax.scan(dense_round, s, None, length=dense_rounds)
+            return s
+
+        need_dense = jnp.any(jobs.valid & (state[0] == NO_HOST))
+        state = jax.lax.cond(need_dense, run_dense, lambda s: s, state)
+    job_host, mem_left, cpus_left, gpus_left, _, _ = state
     return MatchResult(job_host, mem_left, cpus_left, gpus_left)
 
 

@@ -67,8 +67,14 @@ def pack_jobs(mem, cpus, gpus, active, unique) -> jnp.ndarray:
         [stack, jnp.zeros((N, JOB_COLS - len(cols)), jnp.float32)], axis=1)
 
 
-def _score_tile(jobs_ref, hosts_ref, forb_ref, bonus):
-    """(bn, bh) masked fitness for one tile (-1 where infeasible)."""
+def _score_tile(jobs_ref, hosts_ref, forb_ref, bonus, *, bn, bh, spread):
+    """(bn, bh) masked fitness for one tile (-1 where infeasible).
+
+    All mask algebra is done on f32 indicators: Mosaic (as of this
+    libtpu) cannot lower a select_n over i1 vectors (it round-trips
+    through i8 and dies on the i8->i1 trunci), so booleans only appear
+    as comparison results feeding arithmetic, never as select operands.
+    """
     jm = jobs_ref[:, J_MEM:J_MEM + 1]
     jc = jobs_ref[:, J_CPUS:J_CPUS + 1]
     jg = jobs_ref[:, J_GPUS:J_GPUS + 1]
@@ -84,15 +90,18 @@ def _score_tile(jobs_ref, hosts_ref, forb_ref, bonus):
     hvalid = hosts_ref[H_VALID:H_VALID + 1, :]
     occ0 = hosts_ref[H_OCC0:H_OCC0 + 1, :]
 
-    # feasibility (ops.match._feasible)
-    ok = (hvalid > 0) & (slots > 0) & (forb_ref[:, :] == 0)
-    ok &= (mem_left + EPS >= jm) & (cpus_left + EPS >= jc)
-    is_gpu_host = cap_gpus > 0
-    ok &= jnp.where(jg > 0, is_gpu_host & (gpus_left + EPS >= jg),
-                    ~is_gpu_host)
+    # feasibility (ops.match._feasible) as an f32 indicator product
+    okf = ((hvalid > 0) & (slots > 0)).astype(jnp.float32)
+    okf *= (forb_ref[:, :] == 0).astype(jnp.float32)
+    okf *= ((mem_left + EPS >= jm) & (cpus_left + EPS >= jc)).astype(
+        jnp.float32)
+    is_gpu = (cap_gpus > 0).astype(jnp.float32)
+    gpu_fits = (gpus_left + EPS >= jg).astype(jnp.float32) * is_gpu
+    okf *= jnp.where(jg > 0, gpu_fits, 1.0 - is_gpu)   # f32 select
     # group-0 unique-host occupancy (the num_groups == 1 fast path)
-    ok &= ~((ju > 0) & (occ0 > 0))
-    ok &= ja > 0
+    okf *= 1.0 - (ju > 0).astype(jnp.float32) * (occ0 > 0).astype(
+        jnp.float32)
+    okf *= (ja > 0).astype(jnp.float32)
 
     # cpuMemBinPacker fitness (ops.match._fitness)
     f_mem = jnp.where(cap_mem > 0, (cap_mem - mem_left + jm) / cap_mem, 0.0)
@@ -101,7 +110,22 @@ def _score_tile(jobs_ref, hosts_ref, forb_ref, bonus):
     fit = 0.5 * (f_mem + f_cpu)
     if bonus is not None:
         fit = fit + bonus[:, :]
-    return jnp.where(ok, fit, -1.0)
+    if spread:
+        # same per-(job, host) jitter as the XLA dense round in
+        # ops.match.match_rounds — bit-identical so the two paths agree
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        jj = (jax.lax.broadcasted_iota(jnp.uint32, (bn, bh), 0)
+              + jnp.uint32(i * bn))
+        hh = (jax.lax.broadcasted_iota(jnp.uint32, (bn, bh), 1)
+              + jnp.uint32(j * bh))
+        z = jj * jnp.uint32(2654435761) + hh * jnp.uint32(40503)
+        z = z ^ (z >> 15)
+        z = z * jnp.uint32(2246822519)
+        z = z ^ (z >> 13)
+        fit = fit + (z & jnp.uint32(0xFFFF)).astype(jnp.float32) \
+            / 65536.0 * spread
+    return jnp.where(okf > 0, fit, -1.0)
 
 
 def _accumulate(fit, bh, fit_ref, idx_ref):
@@ -123,24 +147,28 @@ def _accumulate(fit, bh, fit_ref, idx_ref):
     fit_ref[:, :] = jnp.where(better, tile_max, fit_ref[:, :])
 
 
-def _kernel(jobs_ref, hosts_ref, forb_ref, fit_ref, idx_ref, *, bh):
-    _accumulate(_score_tile(jobs_ref, hosts_ref, forb_ref, None), bh,
+def _kernel(jobs_ref, hosts_ref, forb_ref, fit_ref, idx_ref, *, bn, bh,
+            spread):
+    _accumulate(_score_tile(jobs_ref, hosts_ref, forb_ref, None,
+                            bn=bn, bh=bh, spread=spread), bh,
                 fit_ref, idx_ref)
 
 
 def _kernel_bonus(jobs_ref, hosts_ref, forb_ref, bonus_ref, fit_ref,
-                  idx_ref, *, bh):
-    _accumulate(_score_tile(jobs_ref, hosts_ref, forb_ref, bonus_ref), bh,
+                  idx_ref, *, bn, bh, spread):
+    _accumulate(_score_tile(jobs_ref, hosts_ref, forb_ref, bonus_ref,
+                            bn=bn, bh=bh, spread=spread), bh,
                 fit_ref, idx_ref)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_n", "block_h", "interpret"))
+                   static_argnames=("block_n", "block_h", "interpret",
+                                    "spread"))
 def best_host(jobs_packed: jnp.ndarray, hosts_packed: jnp.ndarray,
               forbidden_u8: jnp.ndarray,
               bonus: jnp.ndarray | None = None,
               block_n: int = 256, block_h: int = 1024,
-              interpret: bool = False):
+              interpret: bool = False, spread: float = 0.0):
     """Fused feasibility+fitness+argmax over hosts.
 
     jobs_packed: (N, 8) f32 from pack_jobs; hosts_packed: (16, H) f32
@@ -166,9 +194,10 @@ def best_host(jobs_packed: jnp.ndarray, hosts_packed: jnp.ndarray,
     ]
     args = [jobs_packed, hosts_packed, forbidden_u8]
     if bonus is None:
-        kernel = functools.partial(_kernel, bh=bh)
+        kernel = functools.partial(_kernel, bn=bn, bh=bh, spread=spread)
     else:
-        kernel = functools.partial(_kernel_bonus, bh=bh)
+        kernel = functools.partial(_kernel_bonus, bn=bn, bh=bh,
+                                   spread=spread)
         in_specs.append(pl.BlockSpec((bn, bh), lambda i, j: (i, j)))
         args.append(bonus)
     fit, idx = pl.pallas_call(

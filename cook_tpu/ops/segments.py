@@ -23,15 +23,26 @@ def segment_starts(seg_ids: jnp.ndarray) -> jnp.ndarray:
 def segment_cumsum(values: jnp.ndarray, seg_ids: jnp.ndarray) -> jnp.ndarray:
     """Inclusive cumulative sum that restarts at each segment boundary.
 
-    `seg_ids` must be sorted. Works on float or int arrays; leading axis is
-    the scan axis, extra trailing axes are carried through.
+    `seg_ids` must be run-contiguous (each segment's elements adjacent;
+    global order across segments doesn't matter). Works on float or int
+    arrays; leading axis is the scan axis, extra trailing axes are
+    carried through.
+
+    The running max that propagates each segment's start index uses
+    `lax.associative_scan` explicitly: the `cummax` primitive
+    (`jnp.maximum.accumulate`) lowers to a quadratic reduce-window on
+    TPU — 120 ms at 8k, 400 ms at 110k — while the associative scan is
+    log2(n) vectorized max passes (sub-ms at the same sizes).
     """
+    import jax
+
     total = jnp.cumsum(values, axis=0)
     starts = segment_starts(seg_ids)
     n = seg_ids.shape[0]
     idx = jnp.arange(n)
     # Index of the start of each element's segment, propagated forward.
-    start_idx = jnp.maximum.accumulate(jnp.where(starts, idx, -1))
+    start_idx = jax.lax.associative_scan(jnp.maximum,
+                                         jnp.where(starts, idx, -1))
     # Sum of everything strictly before the segment start.
     base = jnp.take(total, start_idx, axis=0) - jnp.take(values, start_idx, axis=0)
     return total - base

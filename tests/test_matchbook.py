@@ -143,3 +143,25 @@ def test_out_of_range_host_attr_is_dropped_not_fatal():
     got = fb.fill([job], ["h0"], [{"rack": "r0"}, {"rack": "r1"}])
     assert got.shape == (1, 1)
     assert not got[0, 0]
+
+
+def test_forget_releases_constraint_value_strings():
+    fb = NativeForbiddenBuilder.create()
+    names, attrs = ["h0"], [{"rack": "r0"}]
+    # job-scoped pattern: not a host attr value -> evicted with the job
+    j1 = mkjob(constraints=[("node", "EQUALS", "uuid-12345")])
+    fb.fill([j1], names, attrs)
+    assert "v:uuid-12345" in fb._strs.ids
+    fb.forget(j1.uuid)
+    assert "v:uuid-12345" not in fb._strs.ids
+    # pattern that is also a live host attr value stays pinned, and its
+    # id must remain stable for other jobs' C++-held constraints
+    j2 = mkjob(constraints=[("rack", "EQUALS", "r0")])
+    fb.fill([j2], names, attrs)
+    pinned_id = fb._strs.ids["v:r0"]
+    fb.forget(j2.uuid)
+    assert fb._strs.ids["v:r0"] == pinned_id
+    # a fresh job matching on that value still works after the forget
+    j3 = mkjob(constraints=[("rack", "EQUALS", "r0")])
+    got = fb.fill([j3], ["h0", "h1"], [{"rack": "r0"}, {"rack": "r1"}])
+    assert got[0].tolist() == [False, True]
