@@ -1,9 +1,15 @@
-"""Headline benchmark: sched decisions/sec @ 100k pending x 10k offers.
+"""Benchmarks over the BASELINE.json config ladder.
 
-Runs the fused scheduling cycle (DRU rank over 110k tasks -> considerable
-filter -> batched bin-packing match of an 8k considerable head onto 10k
-hosts) on the real TPU chip and reports decisions/sec and p99 cycle
-latency.
+Default (no argv): the headline config — sched decisions/sec @ 100k
+pending x 10k offers. Runs the fused scheduling cycle (DRU rank over
+110k tasks -> considerable filter -> batched bin-packing match of an 8k
+considerable head onto 10k hosts) on the real TPU chip and reports
+decisions/sec and p99 cycle latency as ONE JSON line.
+
+Other BASELINE.json configs, selectable by argv:
+  python bench.py small       10k pending x 1k offers, single chip
+  python bench.py rebalance   preemption sweep, 50k running jobs
+  python bench.py stream      ~1M-job day replay, streaming batched match
 
 Measurement model: the coordinator keeps job/offer tensors resident on
 device and dispatches cycles asynchronously, so a cycle's cost is the
@@ -16,31 +22,21 @@ payload) is reported separately as sync_rtt_ms.
 Baseline: the reference's design throughput bound — Fenzo considers 1000
 jobs per 1 s match-cycle tick (config.clj:319-324, mesos.clj:102), i.e.
 ~1000 decisions/sec. vs_baseline = decisions_per_sec / 1000.
-
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def main():
+def _cycle_setup(R, P, H, U, seed=0):
     import jax
     import jax.numpy as jnp
-    from cook_tpu.ops import cycle as cycle_ops
     from cook_tpu.ops import match as match_ops
 
-    R = 10_000       # running tasks (rank-cycle benchmark scale, benchmark.clj:41-57 uses 10k running)
-    P = 100_000      # pending jobs
-    H = 10_000       # offers/hosts
-    U = 500          # users
-    C = 8_192        # considerable head matched per cycle
-
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     INF = np.float32(3.4e38)
-
     dev = jax.devices()[0]
     args = (
         jnp.asarray(rng.integers(0, U, R), jnp.int32),
@@ -68,9 +64,16 @@ def main():
         None,  # forbidden: constraint-free headline config
         jnp.full(U, INF), jnp.full(U, INF), jnp.full(U, 1e9, jnp.float32),
     )
-    args = jax.device_put(args, dev)
+    return jax.device_put(args, dev), dev
 
+
+def bench_cycle(R=10_000, P=100_000, H=10_000, U=500, C=8_192,
+                label="100k-pending x 10k-offers"):
+    """Pipelined match-cycle latency/throughput (headline + `small`)."""
     import functools
+    from cook_tpu.ops import cycle as cycle_ops
+
+    args, dev = _cycle_setup(R, P, H, U)
     fn = functools.partial(cycle_ops.rank_and_match,
                            num_considerable=C, sequential=False)
 
@@ -113,7 +116,7 @@ def main():
     p99 = float(np.percentile(per_cycle_ms, 99))
 
     print(json.dumps({
-        "metric": "sched decisions/sec @ 100k-pending x 10k-offers",
+        "metric": f"sched decisions/sec @ {label}",
         "value": round(dps, 1),
         "unit": "decisions/sec",
         "vs_baseline": round(dps / 1000.0, 2),
@@ -125,6 +128,142 @@ def main():
         "compile_s": round(compile_s, 1),
         "device": str(dev),
     }))
+
+
+def bench_rebalance(T0=50_000, P=64, H=2_000, U=500):
+    """Preemption sweep over 50k running jobs (BASELINE config 4).
+
+    P=64 mirrors the reference's documented max-preemption example
+    (rebalancer-config.adoc:24); the reference runs this every 300 s.
+    """
+    import jax
+    import jax.numpy as jnp
+    from cook_tpu.ops import rebalance as reb
+
+    rng = np.random.default_rng(0)
+    T = T0 + P
+    INF = np.float32(3.4e38)
+    dev = jax.devices()[0]
+    tasks = reb.TaskState(
+        user=jnp.asarray(np.concatenate(
+            [rng.integers(0, U, T0), np.zeros(P)]), jnp.int32),
+        mem=jnp.asarray(np.concatenate(
+            [rng.uniform(1, 10, T0), np.zeros(P)]), jnp.float32),
+        cpus=jnp.asarray(np.concatenate(
+            [rng.uniform(0.5, 4, T0), np.zeros(P)]), jnp.float32),
+        priority=jnp.zeros(T, jnp.int32),
+        start_time=jnp.asarray(np.arange(T), jnp.int32),
+        host=jnp.asarray(np.concatenate(
+            [rng.integers(0, H, T0), np.zeros(P)]), jnp.int32),
+        valid=jnp.asarray(np.concatenate(
+            [np.ones(T0, bool), np.zeros(P, bool)])),
+        mem_share=jnp.full(T, 100.0, jnp.float32),
+        cpus_share=jnp.full(T, 20.0, jnp.float32),
+    )
+    pending = reb.PendingJobs(
+        user=jnp.asarray(rng.integers(0, U, P), jnp.int32),
+        mem=jnp.asarray(rng.uniform(1, 10, P), jnp.float32),
+        cpus=jnp.asarray(rng.uniform(0.5, 4, P), jnp.float32),
+        priority=jnp.zeros(P, jnp.int32),
+        start_time=jnp.asarray(np.arange(P) + T, jnp.int32),
+        valid=jnp.ones(P, bool),
+        mem_share=jnp.full(P, 100.0, jnp.float32),
+        cpus_share=jnp.full(P, 20.0, jnp.float32),
+    )
+    spare_mem = jnp.asarray(rng.uniform(0, 4, H), jnp.float32)
+    spare_cpus = jnp.asarray(rng.uniform(0, 2, H), jnp.float32)
+    forb = jnp.zeros((P, H), bool)
+    qm = jnp.full(U, INF)
+    qc = jnp.full(U, INF)
+    qn = jnp.full(U, 2.0 ** 31, jnp.float32)
+
+    t0 = time.perf_counter()
+    res = reb.rebalance(tasks, pending, spare_mem, spare_cpus, forb,
+                        qm, qc, qn, 0.5, 0.1)
+    placed = np.asarray(res.job_placed)
+    compile_s = time.perf_counter() - t0
+
+    N = 5
+    t0 = time.perf_counter()
+    for _ in range(N):
+        res = reb.rebalance(tasks, pending, spare_mem, spare_cpus, forb,
+                            qm, qc, qn, 0.5, 0.1)
+    _ = np.asarray(res.job_placed[:1])
+    sweep_ms = (time.perf_counter() - t0) / N * 1e3
+
+    print(json.dumps({
+        "metric": f"rebalancer sweep ms @ {T0 // 1000}k running, "
+                  f"{P} preemption decisions",
+        "value": round(sweep_ms, 1),
+        "unit": "ms/sweep",
+        # reference cadence is one sweep / 300 s (config.clj:386)
+        "vs_baseline": round(300_000.0 / sweep_ms, 1),
+        "placed": int(placed.sum()),
+        "preempted": int(np.asarray(res.preempted).sum()),
+        "compile_s": round(compile_s, 1),
+        "device": str(dev),
+    }))
+
+
+def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
+                 U=500, C=8_192):
+    """~1M-job day replay: streaming batched match (BASELINE config 5).
+
+    Each cycle schedules the considerable head of a resident 100k-job
+    backlog; scheduled jobs retire (short tasks — the cluster-trace day
+    is dominated by them) and the backlog refills. Reports end-to-end
+    placement throughput for one million jobs.
+    """
+    import functools
+    from cook_tpu.ops import cycle as cycle_ops
+
+    args, dev = _cycle_setup(R, P, H, U)
+    fn = functools.partial(cycle_ops.rank_and_match,
+                           num_considerable=C, sequential=False)
+    out = fn(*args)
+    matched = int((np.asarray(out.job_host) >= 0).sum())
+    if matched == 0:
+        raise RuntimeError("no placements; config broken")
+
+    placed_total = 0
+    cycles = 0
+    t0 = time.perf_counter()
+    while placed_total < total_jobs:
+        # pipeline 8 cycles per sync
+        for _ in range(8):
+            out = fn(*args)
+            cycles += 1
+        placed_total += int((np.asarray(out.job_host) >= 0).sum()) * 8
+    wall = time.perf_counter() - t0
+    jps = placed_total / wall
+
+    print(json.dumps({
+        "metric": "streaming placement throughput, ~1M-job day replay",
+        "value": round(jps, 1),
+        "unit": "jobs/sec",
+        "vs_baseline": round(jps / 1000.0, 2),
+        "jobs_placed": placed_total,
+        "cycles": cycles,
+        "wall_s": round(wall, 1),
+        "day_compression": round(86_400.0 / wall, 1),
+        "device": str(dev),
+    }))
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "headline"
+    if which == "headline":
+        bench_cycle()
+    elif which == "small":
+        bench_cycle(R=1_000, P=10_000, H=1_000, U=100, C=2_048,
+                    label="10k-pending x 1k-offers")
+    elif which == "rebalance":
+        bench_rebalance()
+    elif which == "stream":
+        bench_stream()
+    else:
+        raise SystemExit(f"unknown config {which!r}; "
+                         "one of: headline small rebalance stream")
 
 
 if __name__ == "__main__":
