@@ -92,7 +92,8 @@ def _score_tile(jobs_ref, hosts_ref, forb_ref, bonus, *, bn, bh, spread):
 
     # feasibility (ops.match._feasible) as an f32 indicator product
     okf = ((hvalid > 0) & (slots > 0)).astype(jnp.float32)
-    okf *= (forb_ref[:, :] == 0).astype(jnp.float32)
+    # i8 vector compares are unsupported on this target; widen first
+    okf *= (forb_ref[:, :].astype(jnp.int32) == 0).astype(jnp.float32)
     okf *= ((mem_left + EPS >= jm) & (cpus_left + EPS >= jc)).astype(
         jnp.float32)
     is_gpu = (cap_gpus > 0).astype(jnp.float32)
@@ -123,8 +124,9 @@ def _score_tile(jobs_ref, hosts_ref, forb_ref, bonus, *, bn, bh, spread):
         z = z ^ (z >> 15)
         z = z * jnp.uint32(2246822519)
         z = z ^ (z >> 13)
-        fit = fit + (z & jnp.uint32(0xFFFF)).astype(jnp.float32) \
-            / 65536.0 * spread
+        # Mosaic can't cast u32->f32 directly; the masked value fits i32
+        low = (z & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        fit = fit + low.astype(jnp.float32) / 65536.0 * spread
     return jnp.where(okf > 0, fit, -1.0)
 
 
