@@ -86,6 +86,7 @@ class SchedulerSettings:
     rebalancer_min_dru_diff: float = 0.5
     rebalancer_max_preemption: int = 64
     sequential_match_threshold: int = 2048
+    use_pallas: bool = False            # fused TPU kernel for dense rounds
 
     def validate(self) -> None:
         if self.max_jobs_considered < 1:

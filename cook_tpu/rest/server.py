@@ -201,7 +201,8 @@ def build_scheduler(config):
                 safe_dru_threshold=s.rebalancer_safe_dru_threshold,
                 min_dru_diff=s.rebalancer_min_dru_diff,
                 max_preemption=s.rebalancer_max_preemption),
-            sequential_match_threshold=s.sequential_match_threshold),
+            sequential_match_threshold=s.sequential_match_threshold,
+            use_pallas=s.use_pallas),
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,

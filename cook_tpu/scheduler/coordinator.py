@@ -66,6 +66,10 @@ class SchedulerConfig:
     rebalancer: RebalancerParams = field(default_factory=RebalancerParams)
     # batched matcher beyond this many considerable jobs
     sequential_match_threshold: int = 2048
+    # fused Pallas TPU kernel for the batched matcher's dense rounds;
+    # enable on real TPU deployments (match_rounds self-gates on shape
+    # and falls back to XLA when the bucketed sizes don't qualify)
+    use_pallas: bool = False
 
 
 @dataclass
@@ -293,7 +297,8 @@ class Coordinator:
             hosts, forbidden, qm, qc, qn,
             num_considerable=C, num_groups=jb.num_groups,
             sequential=C <= self.config.sequential_match_threshold,
-            considerable_limit=num_considerable, bonus=bonus)
+            considerable_limit=num_considerable, bonus=bonus,
+            use_pallas=self.config.use_pallas)
 
         job_host = np.asarray(res.job_host)
         considerable = np.asarray(res.considerable)
