@@ -290,6 +290,23 @@ def cmd_tail(fed: Federation, args) -> int:
     return 0
 
 
+def cmd_ssh(fed: Federation, args) -> int:
+    """exec ssh to the host of the job's latest instance, landing in the
+    sandbox directory (subcommands/ssh.py)."""
+    _, _, job = fed.find_job(args.uuid)
+    insts = sorted(job.instances, key=lambda i: i.start_time or 0)
+    if not insts:
+        raise SystemExit(f"job {args.uuid} has no instances yet")
+    inst = insts[-1]
+    if not inst.hostname:
+        raise SystemExit(f"instance {inst.task_id} has no host yet")
+    argv = ["ssh", "-t", inst.hostname]
+    if inst.sandbox_directory:
+        argv += [f"cd {inst.sandbox_directory} ; exec $SHELL -l"]
+    print(" ".join(argv), file=sys.stderr)
+    os.execvp("ssh", argv)
+
+
 def cmd_config(cfg: dict, args) -> int:
     if args.get:
         val = cfg
@@ -379,6 +396,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("path")
     s.add_argument("--lines", type=int, default=10)
 
+    s = sub.add_parser("ssh", help="ssh to a job's latest instance host")
+    s.add_argument("uuid")
+
     s = sub.add_parser("config", help="get/set configuration")
     s.add_argument("--get", default=None)
     s.add_argument("--set", nargs=2, metavar=("KEY", "VALUE"), default=None)
@@ -396,7 +416,7 @@ def main(argv=None) -> int:
         "submit": cmd_submit, "show": cmd_show, "wait": cmd_wait,
         "jobs": cmd_jobs, "kill": cmd_kill, "retry": cmd_retry,
         "why": cmd_why, "usage": cmd_usage, "ls": cmd_ls, "cat": cmd_cat,
-        "tail": cmd_tail,
+        "tail": cmd_tail, "ssh": cmd_ssh,
     }[args.cmd]
     try:
         return handler(fed, args)

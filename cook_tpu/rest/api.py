@@ -194,6 +194,8 @@ class CookApi:
         r.add("GET", "/list", self.list_jobs)
         r.add("GET", "/info", self.get_info)
         r.add("GET", "/debug", self.get_debug)
+        r.add("GET", "/data-local", self.data_local_status)
+        r.add("GET", "/data-local/:uuid", self.data_local_costs)
         return r
 
     # ------------------------------------------------------------------
@@ -716,6 +718,33 @@ class CookApi:
 
     def get_debug(self, req: Request) -> Response:
         return Response(200, {"healthy": True, "version": VERSION})
+
+    # -- data-locality debug endpoints (data_locality.clj debug REST,
+    # rest/api.clj data-local routes) ----------------------------------
+    def _data_locality(self):
+        dl = getattr(self.coord, "data_locality", None)
+        if dl is None:
+            raise ApiError(404, "data locality not configured")
+        return dl
+
+    def data_local_status(self, req: Request) -> Response:
+        dl = self._data_locality()
+        with dl._lock:
+            return Response(200, {
+                "weight": dl.weight,
+                "batch_size": dl.batch_size,
+                "cache_ttl_s": dl.cache_ttl_s,
+                "jobs_with_costs": len(dl._costs),
+                "last_update_times": dict(
+                    sorted(dl._fetched_at.items())[-50:]),
+            })
+
+    def data_local_costs(self, req: Request, uuid: str) -> Response:
+        dl = self._data_locality()
+        costs = dl.get_costs(uuid)
+        if not costs and self.store.get_job(uuid) is None:
+            raise ApiError(404, f"job {uuid} unknown")
+        return Response(200, {"uuid": uuid, "costs": costs})
 
 
 # ----------------------------------------------------------------------

@@ -197,7 +197,7 @@ class ConsoleReporter(Reporter):
 
 
 class JsonlReporter(Reporter):
-    """Append snapshots to a JSONL file (the Graphite-sink role)."""
+    """Append snapshots to a JSONL file."""
 
     def __init__(self, reg: MetricRegistry, path: str,
                  interval_s: float = 60.0):
@@ -208,3 +208,42 @@ class JsonlReporter(Reporter):
         with open(self.path, "a") as f:
             f.write(json.dumps({"ts": time.time(),
                                 "metrics": snapshot}) + "\n")
+
+
+class GraphiteReporter(Reporter):
+    """Push snapshots over the Graphite plaintext protocol
+    (`<prefix>.<name> <value> <unix-ts>\\n` per metric; the
+    {:kind :graphite} sink of reporter.clj:44-59). One connection per
+    flush; errors are swallowed by the Reporter loop and retried next
+    interval."""
+
+    def __init__(self, reg: MetricRegistry, host: str, port: int = 2003,
+                 prefix: str = "cook", interval_s: float = 60.0):
+        super().__init__(reg, interval_s)
+        self.host, self.port, self.prefix = host, port, prefix
+
+    @staticmethod
+    def _flatten(prefix: str, val, out: list) -> None:
+        if isinstance(val, dict):
+            for k, v in val.items():
+                if k == "type":      # metric-kind tag, not a value
+                    continue
+                # collapse {"value": v} so counters/gauges publish under
+                # their own name, graphite-style
+                sub = prefix if k == "value" else f"{prefix}.{k}"
+                GraphiteReporter._flatten(sub, v, out)
+        elif isinstance(val, (int, float)) and not isinstance(val, bool):
+            out.append((prefix, float(val)))
+
+    def publish(self, snapshot: dict) -> None:
+        import socket
+
+        lines: list = []
+        ts = int(time.time())
+        self._flatten(self.prefix, snapshot, lines)
+        payload = "".join(
+            f"{name.replace(' ', '_')} {value} {ts}\n"
+            for name, value in lines)
+        with socket.create_connection((self.host, self.port),
+                                      timeout=5) as sock:
+            sock.sendall(payload.encode())

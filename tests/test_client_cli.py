@@ -153,3 +153,40 @@ def test_cli_config(tmp_path, capsys, monkeypatch):
                      "clusters"]) == 0
     assert "c1" in capsys.readouterr().out
     assert load_config(cfg_path)["clusters"][0]["name"] == "c1"
+
+
+def test_cli_ssh_requires_instance(live, capsys):
+    store, cluster, coord, server = live
+    client = JobClient(server.url, user="alice")
+    uuid = client.submit(command="sleep 5", mem=64, cpus=1)
+    # no instance yet -> clear error instead of exec'ing ssh
+    with pytest.raises(SystemExit) as e:
+        cli_main(["--url", server.url, "--user", "alice", "ssh", uuid])
+    assert "no instances" in str(e.value)
+
+
+def test_rest_data_local_endpoints(live):
+    import urllib.request
+    from cook_tpu.scheduler.data_locality import DataLocalityCosts
+
+    store, cluster, coord, server = live
+    coord.data_locality = DataLocalityCosts(
+        fetcher=lambda uuids: {u: {"h0": 0.1} for u in uuids})
+    client = JobClient(server.url, user="alice")
+    uuid = client.submit(command="true", mem=64, cpus=1,
+                         datasets=[{"dataset": {"bucket": "b1"}}])
+
+    def get(path):
+        req = urllib.request.Request(server.url + path,
+                                     headers={"X-Cook-User": "alice"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    status = get("/data-local")
+    assert status["weight"] == 0.25 and "jobs_with_costs" in status
+    costs = get(f"/data-local/{uuid}")
+    assert costs["uuid"] == uuid
+    # unknown uuid -> 404
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        get(f"/data-local/{new_uuid()}")

@@ -215,3 +215,42 @@ def test_starvation_amount_is_capped_by_share():
     shares.set("u", "default", mem=500, cpus=5)
     out = starved_stats(running, waiting, shares, "default")
     assert out["u"]["mem"] == 400.0 and out["u"]["cpus"] == 4.0
+
+
+def test_graphite_reporter_plaintext_protocol():
+    import socket
+    import threading
+
+    from cook_tpu.utils.metrics import GraphiteReporter, MetricRegistry
+
+    received = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def accept():
+        conn, _ = srv.accept()
+        with conn:
+            buf = b""
+            while True:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+            received.append(buf.decode())
+
+    t = threading.Thread(target=accept)
+    t.start()
+    reg = MetricRegistry()
+    reg.counter("match.cycles").inc(7)
+    reg.timer("cycle ms").update(12.5)
+    rep = GraphiteReporter(reg, "127.0.0.1", port, prefix="cook")
+    rep.publish(reg.snapshot())
+    t.join(timeout=5)
+    srv.close()
+    lines = received[0].strip().splitlines()
+    assert any(line.startswith("cook.match.cycles 7.0 ") for line in lines)
+    # spaces in metric names are sanitized, 3 fields per line
+    assert all(len(line.split(" ")) == 3 for line in lines)
+    assert any("cycle_ms" in line for line in lines)
