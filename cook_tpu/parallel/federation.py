@@ -1,0 +1,139 @@
+"""Multi-slice federation: pool-sharded cycles over a 2-D (DCN x ICI)
+device mesh.
+
+The reference federates N compute clusters behind the ComputeCluster
+protocol: offers from every cluster merge into each pool's match cycle
+(scheduler.clj:977-985) and autoscaling jobs are distributed across
+clusters by uuid-hash (distribute-jobs-to-compute-clusters,
+scheduler.clj:816-826). The TPU-native analogue treats each TPU *slice*
+as a federation member:
+
+  - mesh axis "slice" spans slices (DCN — slow, scarce bandwidth),
+  - mesh axis "pools" spans chips within a slice (ICI — fast),
+  - each device owns a shard of pools and runs the fused cycle kernel
+    (ops/cycle.rank_and_match) for them, exactly like
+    parallel.pools.pool_sharded_cycle,
+  - cluster-wide aggregates reduce hierarchically: `psum` over "pools"
+    rides ICI inside every slice, then one small scalar `psum` over
+    "slice" crosses DCN. Keeping the axes distinct is what lets XLA
+    route the big reduction over ICI and ship only scalars over DCN
+    (the reference's per-cycle offer merge is likewise per-cluster
+    local with only totals crossing cluster boundaries).
+
+Job -> slice routing mirrors the reference's uuid-hash distribution:
+`distribute_jobs` below is the host-side helper the coordinator uses to
+decide which slice's pool shard a job's pool belongs to.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cook_tpu.ops import cycle as cycle_ops
+
+SLICE_AXIS = "slice"
+POOL_AXIS = "pools"
+
+
+def make_federation_mesh(n_slices: int,
+                         chips_per_slice: int | None = None) -> Mesh:
+    """(n_slices, chips_per_slice) mesh; the leading axis is the DCN
+    dimension. On real multi-slice hardware the device order from
+    jax.devices() already groups by slice, so a reshape yields
+    slice-major placement."""
+    devs = jax.devices()
+    per = chips_per_slice or len(devs) // n_slices
+    n = n_slices * per
+    if n > len(devs):
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    import numpy as np
+
+    grid = np.array(devs[:n]).reshape(n_slices, per)
+    return Mesh(grid, (SLICE_AXIS, POOL_AXIS))
+
+
+def distribute_jobs(uuids, n_slices: int) -> list[int]:
+    """Stable uuid-hash -> slice assignment
+    (distribute-jobs-to-compute-clusters scheduler.clj:816-826)."""
+    return [int(hashlib.md5(u.encode()).hexdigest(), 16) % n_slices
+            for u in uuids]
+
+
+class FederationStats(NamedTuple):
+    """Cluster-wide aggregates, replicated everywhere after one
+    ICI psum + one DCN psum."""
+
+    total_matched: jnp.ndarray
+    total_considerable: jnp.ndarray
+    total_pending: jnp.ndarray
+    per_slice_matched: jnp.ndarray   # (n_slices,) — federation members
+
+
+class FederationCycleOut(NamedTuple):
+    result: cycle_ops.CycleResult    # leading (slices, pools) axes
+    stats: FederationStats
+
+
+def federated_cycle(mesh: Mesh, num_considerable: int = 1024,
+                    num_groups: int = 1, sequential: bool = True):
+    """Build the jitted federated cycle fn for a 2-D mesh.
+
+    Returns fn(args) where every array in args carries leading
+    (n_slices, pools_per_slice) axes, both divisible by the respective
+    mesh axis sizes.
+    """
+    n_slices = mesh.shape[SLICE_AXIS]
+
+    kernel = functools.partial(
+        cycle_ops.rank_and_match,
+        num_considerable=num_considerable, num_groups=num_groups,
+        sequential=sequential)
+
+    def per_pool(args):
+        return kernel(*args)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(SLICE_AXIS, POOL_AXIS),
+        out_specs=(P(SLICE_AXIS, POOL_AXIS), P()))
+    def shard_fn(args):
+        # each device: vmap over its (slice-shard x pool-shard) pools
+        flat = jax.tree.map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), args)
+        res = jax.vmap(per_pool)(flat)
+        res = jax.tree.map(
+            lambda x: x.reshape(args[0].shape[:2] + x.shape[1:]), res)
+
+        pend_valid = args[14]
+        matched = jnp.sum((res.job_host >= 0).astype(jnp.int32))
+        considerable = jnp.sum(res.considerable.astype(jnp.int32))
+        pending = jnp.sum(pend_valid.astype(jnp.int32))
+        # hierarchical reduction: ICI first, then scalars over DCN
+        m_ici = jax.lax.psum(matched, POOL_AXIS)
+        c_ici = jax.lax.psum(considerable, POOL_AXIS)
+        p_ici = jax.lax.psum(pending, POOL_AXIS)
+        # per-slice split as a one-hot psum (replicated on every device,
+        # unlike all_gather whose varying-axis status the shard_map
+        # checker can't prove)
+        slice_idx = jax.lax.axis_index(SLICE_AXIS)
+        onehot = (jnp.arange(n_slices) == slice_idx).astype(jnp.int32)
+        per_slice = jax.lax.psum(onehot * m_ici, SLICE_AXIS)
+        stats = FederationStats(
+            total_matched=jax.lax.psum(m_ici, SLICE_AXIS),
+            total_considerable=jax.lax.psum(c_ici, SLICE_AXIS),
+            total_pending=jax.lax.psum(p_ici, SLICE_AXIS),
+            per_slice_matched=per_slice,
+        )
+        return res, stats
+
+    @jax.jit
+    def run(args):
+        res, stats = shard_fn(args)
+        return FederationCycleOut(result=res, stats=stats)
+
+    return run

@@ -154,3 +154,58 @@ def test_sharded_match_equals_single_device():
     sharded = np.asarray(fn(jobs, hosts, forb))
     single = np.asarray(match_ops.match_scan(jobs, hosts, forb).job_host)
     np.testing.assert_array_equal(sharded, single)
+
+
+def test_federated_cycle_2d_mesh():
+    """2x4 (DCN x ICI) mesh: per-pool results match single-device runs,
+    hierarchical psums agree, per-slice split sums to the total, and the
+    uuid-hash job distribution is stable."""
+    from cook_tpu.parallel import federation
+
+    rng = np.random.default_rng(5)
+    stacked = make_cycle_inputs(rng, n_pools=8)
+    # reshape the flat 8-pool stack to (2 slices, 4 pools)
+    args = (
+        stacked["run_user"], stacked["run_mem"], stacked["run_cpus"],
+        stacked["run_prio"], stacked["run_start"], stacked["run_valid"],
+        stacked["run_mem_share"], stacked["run_cpus_share"],
+        stacked["pend_user"], stacked["pend_mem"], stacked["pend_cpus"],
+        stacked["pend_gpus"], stacked["pend_prio"], stacked["pend_start"],
+        stacked["pend_valid"], stacked["pend_mem_share"],
+        stacked["pend_cpus_share"], stacked["pend_group"],
+        stacked["pend_unique_group"],
+        stacked["hosts"], stacked["forbidden"],
+        stacked["user_quota_mem"], stacked["user_quota_cpus"],
+        stacked["user_quota_count"],
+    )
+    args2d = jax.tree.map(
+        lambda x: x.reshape((2, 4) + x.shape[1:]), args)
+    mesh = federation.make_federation_mesh(2, 4)
+    runner = federation.federated_cycle(mesh, num_considerable=16)
+    out = runner(args2d)
+    assert out.result.job_host.shape[:2] == (2, 4)
+
+    job_host = np.asarray(out.result.job_host)
+    total = int(out.stats.total_matched)
+    assert total == int((job_host >= 0).sum())
+    per_slice = np.asarray(out.stats.per_slice_matched)
+    assert per_slice.shape == (2,)
+    assert per_slice.sum() == total
+    for s in range(2):
+        assert per_slice[s] == int((job_host[s] >= 0).sum())
+
+    # federated == independent per-pool cycles
+    for s in range(2):
+        for p in range(4):
+            single = cycle_ops.rank_and_match(
+                *[jax.tree.map(lambda x: x[s, p], a) for a in args2d],
+                num_considerable=16)
+            np.testing.assert_array_equal(job_host[s, p],
+                                          np.asarray(single.job_host))
+
+    # uuid-hash routing: stable and in-range (scheduler.clj:816-826)
+    uuids = [f"job-{i}" for i in range(100)]
+    d1 = federation.distribute_jobs(uuids, 3)
+    d2 = federation.distribute_jobs(uuids, 3)
+    assert d1 == d2
+    assert set(d1) == {0, 1, 2}
