@@ -127,3 +127,46 @@ def group_attr_requirements(group, running_cotask_hosts: list[dict[str, str]]
         if attr in attrs:
             return {attr: attrs[attr]}
     return {}
+
+
+def group_balanced_exclusions(group,
+                              running_cotask_hosts: list[dict[str, str]],
+                              host_names: list[str],
+                              host_attrs: list[dict[str, str]]) -> set:
+    """Hostnames a balanced host-placement group may NOT use this cycle
+    (balanced-host-placement-group-constraint, constraints.clj:424-450).
+
+    Reference semantics over the running cotasks' attr-value
+    frequencies: with minim = 0 when the `minimum` parameter exceeds the
+    number of distinct values seen (forcing spread onto new values),
+    else min(freqs), a host passes iff no cotasks exist, its value is
+    unseen, minim == maxim (already balanced), or its value's frequency
+    is below maxim. So the excluded hosts are exactly those whose value
+    sits at maxim while the distribution is (or counts as) imbalanced.
+    Same-cycle coupling is approximate — the mask is computed against
+    running cotasks once per cycle, like the attribute-equals pin.
+    """
+    hp = group.host_placement
+    if hp.get("type") != "balanced":
+        return set()
+    params = hp.get("parameters", {})
+    attr = params.get("attribute")
+    if not attr:
+        return set()
+    minimum = int(params.get("minimum", 0))
+    freqs: dict = {}
+    for attrs in running_cotask_hosts:
+        v = attrs.get(attr)
+        freqs[v] = freqs.get(v, 0) + 1
+    if not freqs:
+        return set()
+    minim = 0 if minimum > len(freqs) else min(freqs.values())
+    maxim = max(freqs.values())
+    if minim == maxim:
+        return set()
+    # None (attr absent) is a legitimate frequency bucket, matching the
+    # reference's nil handling: a host without the attr is excluded iff
+    # nil itself sits at maxim.
+    maxed = {v for v, n in freqs.items() if n == maxim}
+    return {host_names[i] for i, attrs in enumerate(host_attrs)
+            if attrs.get(attr) in maxed}

@@ -275,7 +275,7 @@ class Coordinator:
         forb_small = self._build_forbidden(
             pending, host_names, host_attrs, self.reservations,
             self._group_attr_pins(pending),
-            self._group_unique_hosts(pending))
+            self._group_unique_hosts(pending, host_names, host_attrs))
         forbidden = np.zeros((jb.user.shape[0], H), bool)
         forbidden[:len(pending), :len(offers)] = forb_small
         forbidden[:, len(offers):] = True
@@ -397,25 +397,46 @@ class Coordinator:
                 pins[job.group] = req
         return pins
 
-    def _group_unique_hosts(self, pending: list[Job]) -> dict[str, set]:
-        """group uuid -> hosts already holding running cotasks of a
-        unique host-placement group (cross-cycle uniqueness)."""
+    def _group_unique_hosts(self, pending: list[Job],
+                            host_names: Optional[list[str]] = None,
+                            host_attrs: Optional[list[dict]] = None
+                            ) -> dict[str, set]:
+        """group uuid -> hosts this cycle's group members may not use:
+        hosts already holding running cotasks of a *unique*
+        host-placement group (cross-cycle uniqueness), or hosts whose
+        attribute value would imbalance a *balanced* group
+        (constraints.clj:411-450)."""
         out: dict[str, set] = {}
         for job in pending:
             if not job.group or job.group in out:
                 continue
             group = self.store.groups.get(job.group)
-            if group is None or group.host_placement.get("type") != "unique":
+            if group is None:
                 continue
-            hosts = set()
-            for ju in group.jobs:
-                j = self.store.jobs.get(ju)
-                if not j:
-                    continue
-                for inst in j.active_instances:
-                    hosts.add(inst.hostname)
-            if hosts:
-                out[job.group] = hosts
+            ptype = group.host_placement.get("type")
+            if ptype == "unique":
+                hosts = set()
+                for ju in group.jobs:
+                    j = self.store.jobs.get(ju)
+                    if not j:
+                        continue
+                    for inst in j.active_instances:
+                        hosts.add(inst.hostname)
+                if hosts:
+                    out[job.group] = hosts
+            elif ptype == "balanced" and host_names is not None:
+                all_attrs = self._all_host_attributes()
+                cotask_attrs = []
+                for ju in group.jobs:
+                    j = self.store.jobs.get(ju)
+                    if not j:
+                        continue
+                    for inst in j.active_instances:
+                        cotask_attrs.append(all_attrs.get(inst.hostname, {}))
+                excl = constraints_mod.group_balanced_exclusions(
+                    group, cotask_attrs, host_names, host_attrs or [])
+                if excl:
+                    out[job.group] = excl
         return out
 
     def _all_host_attributes(self) -> dict[str, dict[str, str]]:
@@ -468,7 +489,8 @@ class Coordinator:
         forb_small = self._build_forbidden(
             pending_sorted, host_names, host_attrs, self.reservations,
             self._group_attr_pins(pending_sorted),
-            self._group_unique_hosts(pending_sorted))
+            self._group_unique_hosts(pending_sorted, host_names,
+                                     host_attrs))
         host_forb = np.ones((Pb, Hn), bool)
         host_forb[:len(pending_sorted), :len(host_names)] = forb_small
         host_forb[:len(pending_sorted), len(host_names):] = True

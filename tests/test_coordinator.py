@@ -288,3 +288,51 @@ def test_host_loss_fails_tasks_mea_culpa():
     stats = coord.match_cycle()
     assert stats.matched == 1
     assert job.instances[1].hostname != host
+
+
+def test_balanced_group_placement():
+    """balanced host-placement spreads group tasks across rack values
+    (constraints.clj:424-450): with 2 tasks on rack r1 and 1 on r2, the
+    next task must avoid r1 hosts while the spread is uneven."""
+    store, cluster, coord = build(hosts=[
+        MockHost("a1", mem=1000, cpus=16, attributes={"rack": "r1"}),
+        MockHost("a2", mem=1000, cpus=16, attributes={"rack": "r1"}),
+        MockHost("b1", mem=1000, cpus=16, attributes={"rack": "r2"}),
+    ], config=SchedulerConfig(max_jobs_considered=1))
+    g = Group(uuid=new_uuid(), user="alice",
+              host_placement={"type": "balanced",
+                              "parameters": {"attribute": "rack",
+                                             "minimum": 2}})
+    jobs = [mkjob(group=g.uuid) for _ in range(6)]
+    g.jobs = [j.uuid for j in jobs]
+    store.create_jobs(jobs, groups=[g])
+    # place one job per cycle so the running-cotask mask drives spread
+    for _ in range(8):
+        coord.match_cycle()
+    racks = [("r1" if j.instances[-1].hostname.startswith("a") else "r2")
+             for j in jobs if j.instances]
+    assert len(racks) == 6
+    # never more than 1 apart: 3 on each rack
+    assert abs(racks.count("r1") - racks.count("r2")) <= 1
+
+
+def test_balanced_minimum_forces_new_values():
+    """minimum > distinct values seen forces the next task onto an
+    unseen attribute value (minim = 0 branch)."""
+    from cook_tpu.scheduler.constraints import group_balanced_exclusions
+
+    g = Group(uuid=new_uuid(), user="alice",
+              host_placement={"type": "balanced",
+                              "parameters": {"attribute": "zone",
+                                             "minimum": 3}})
+    host_names = ["h0", "h1", "h2"]
+    host_attrs = [{"zone": "z1"}, {"zone": "z2"}, {"zone": "z3"}]
+    # cotasks on z1 and z2, evenly — but minimum=3 demands a third zone
+    excl = group_balanced_exclusions(
+        g, [{"zone": "z1"}, {"zone": "z2"}], host_names, host_attrs)
+    assert excl == {"h0", "h1"}
+    # once three zones are held evenly, nothing is excluded
+    excl = group_balanced_exclusions(
+        g, [{"zone": "z1"}, {"zone": "z2"}, {"zone": "z3"}],
+        host_names, host_attrs)
+    assert excl == set()
