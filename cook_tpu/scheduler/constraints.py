@@ -129,6 +129,54 @@ def group_attr_requirements(group, running_cotask_hosts: list[dict[str, str]]
     return {}
 
 
+def estimated_completion_forbidden(jobs: list[Job],
+                                   host_attrs: list[dict[str, str]],
+                                   now_ms: float,
+                                   expected_runtime_multiplier: float,
+                                   host_lifetime_mins: float,
+                                   agent_start_grace_period_mins: float = 0.0,
+                                   ) -> Optional[np.ndarray]:
+    """estimated-completion-constraint (constraints.clj:200-247): don't
+    place a job on a host expected to shut down before the job's
+    estimated completion.
+
+    Hosts advertise "host-start-time" (unix seconds); their death time
+    is start + host_lifetime_mins. A job's estimated end is now + the
+    max of (expected_runtime x multiplier) and the runtimes of prior
+    host-lost failures (the reference's :mesos-slave-removed), capped at
+    (host_lifetime - grace) so a full-lifetime job can still land on a
+    freshly started host. Jobs with no expected runtime signal are
+    unconstrained. Returns None when no host advertises a start time.
+    """
+    H = len(host_attrs)
+    death_ms = np.full(H, np.inf)
+    any_start = False
+    for h, attrs in enumerate(host_attrs):
+        start = attrs.get("host-start-time")
+        if start is not None:
+            any_start = True
+            death_ms[h] = float(start) * 1000.0 \
+                + host_lifetime_mins * 60_000.0
+    if not any_start:
+        return None
+
+    cap_ms = (host_lifetime_mins - agent_start_grace_period_mins) * 60_000.0
+    forb = np.zeros((len(jobs), H), bool)
+    for j, job in enumerate(jobs):
+        scaled = (job.expected_runtime_ms or 0) * expected_runtime_multiplier
+        lost_runtimes = [
+            (inst.end_time_ms - inst.start_time_ms)
+            for inst in job.instances
+            if inst.reason_code == 5000     # host-lost (slave removed)
+            and inst.end_time_ms and inst.start_time_ms]
+        expected = max([scaled] + lost_runtimes)
+        if expected <= 0:
+            continue
+        est_end = now_ms + min(expected, cap_ms)
+        forb[j] = est_end >= death_ms
+    return forb
+
+
 def group_balanced_exclusions(group,
                               running_cotask_hosts: list[dict[str, str]],
                               host_names: list[str],

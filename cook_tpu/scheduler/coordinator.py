@@ -57,6 +57,21 @@ class RebalancerParams:
 
 
 @dataclass
+class EstimatedCompletionConfig:
+    """estimated-completion-config (config.clj); constraint disabled
+    unless both multiplier and host lifetime are set."""
+
+    expected_runtime_multiplier: Optional[float] = None
+    host_lifetime_mins: Optional[float] = None
+    agent_start_grace_period_mins: float = 10.0
+
+    @property
+    def enabled(self) -> bool:
+        return (self.expected_runtime_multiplier is not None
+                and self.host_lifetime_mins is not None)
+
+
+@dataclass
 class SchedulerConfig:
     max_jobs_considered: int = 1024   # fenzo-max-jobs-considered
     scaleback: float = 0.95           # considerable scaleback factor
@@ -70,6 +85,8 @@ class SchedulerConfig:
     # enable on real TPU deployments (match_rounds self-gates on shape
     # and falls back to XLA when the bucketed sizes don't qualify)
     use_pallas: bool = False
+    estimated_completion: EstimatedCompletionConfig = field(
+        default_factory=EstimatedCompletionConfig)
 
 
 @dataclass
@@ -159,11 +176,21 @@ class Coordinator:
         fb = self.forbidden_builder
         if fb is not None and not any(
                 op != "EQUALS" for j in jobs for (_, op, _) in j.constraints):
-            return fb.fill(jobs, host_names, host_attrs, reservations,
+            forb = fb.fill(jobs, host_names, host_attrs, reservations,
                            group_attr, group_hosts)
-        return constraints_mod.build_forbidden(
-            jobs, host_names, host_attrs, reservations, group_attr,
-            group_hosts)
+        else:
+            forb = constraints_mod.build_forbidden(
+                jobs, host_names, host_attrs, reservations, group_attr,
+                group_hosts)
+        ec = self.config.estimated_completion
+        if ec.enabled:
+            overlay = constraints_mod.estimated_completion_forbidden(
+                jobs, host_attrs, time.time() * 1000.0,
+                ec.expected_runtime_multiplier, ec.host_lifetime_mins,
+                ec.agent_start_grace_period_mins)
+            if overlay is not None:
+                forb = forb | overlay
+        return forb
 
     # ------------------------------------------------------------------
     def _effective_mem(self, job: Job) -> float:

@@ -336,3 +336,55 @@ def test_balanced_minimum_forces_new_values():
         g, [{"zone": "z1"}, {"zone": "z2"}, {"zone": "z3"}],
         host_names, host_attrs)
     assert excl == set()
+
+
+def test_estimated_completion_constraint():
+    """Jobs with an expected runtime avoid hosts that will die first
+    (constraints.clj:200-247)."""
+    import time as _time
+
+    from cook_tpu.scheduler.coordinator import EstimatedCompletionConfig
+
+    now_s = _time.time()
+    store, cluster, coord = build(hosts=[
+        # dies in ~1 minute (lifetime 60min, started 59min ago)
+        MockHost("old", mem=1000, cpus=16,
+                 attributes={"host-start-time": str(now_s - 59 * 60)}),
+        # fresh host, dies in ~60 minutes
+        MockHost("new", mem=1000, cpus=16,
+                 attributes={"host-start-time": str(now_s)}),
+    ])
+    coord.config.estimated_completion = EstimatedCompletionConfig(
+        expected_runtime_multiplier=1.0, host_lifetime_mins=60.0)
+    # 30-minute job: only the fresh host qualifies
+    long_job = mkjob()
+    long_job.expected_runtime_ms = 30 * 60 * 1000
+    # no-signal job: unconstrained
+    quick_job = mkjob()
+    store.create_jobs([long_job, quick_job])
+    coord.match_cycle()
+    assert long_job.instances and long_job.instances[0].hostname == "new"
+    assert quick_job.instances  # placed somewhere
+
+
+def test_estimated_completion_grace_period_cap():
+    """A job expected to run a full host lifetime is capped so fresh
+    hosts (within the grace period) still qualify."""
+    import time as _time
+
+    from cook_tpu.scheduler.coordinator import EstimatedCompletionConfig
+
+    now_s = _time.time()
+    store, cluster, coord = build(hosts=[
+        MockHost("fresh", mem=1000, cpus=16,
+                 attributes={"host-start-time": str(now_s)}),
+    ])
+    coord.config.estimated_completion = EstimatedCompletionConfig(
+        expected_runtime_multiplier=1.0, host_lifetime_mins=60.0,
+        agent_start_grace_period_mins=10.0)
+    marathon = mkjob()
+    marathon.expected_runtime_ms = 2 * 60 * 60 * 1000   # 2h > lifetime
+    store.create_jobs([marathon])
+    coord.match_cycle()
+    # capped at (60-10)min < the fresh host's 60min remaining -> placed
+    assert marathon.instances and marathon.instances[0].hostname == "fresh"
