@@ -44,7 +44,8 @@ class CycleResult(NamedTuple):
 
 
 @functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
-                                             "sequential", "use_pallas"))
+                                             "sequential", "use_pallas",
+                                             "dru_mode"))
 def rank_and_match(
     # running tasks (R slots)
     run_user, run_mem, run_cpus, run_prio, run_start, run_valid,
@@ -64,6 +65,11 @@ def rank_and_match(
     considerable_limit=None,
     bonus=None,                # (P, H) f32 >= 0 fitness bonus (data locality)
     use_pallas: bool = False,  # fused Pallas TPU kernel in match_rounds
+    dru_mode: str = "default",  # "default" (cpu/mem) | "gpu" (pool
+                                # dru-mode :pool.dru-mode/gpu, schema.clj:816)
+    run_gpus=None,             # (R,) — required in gpu mode
+    run_gpu_share=None,        # (R,) — required in gpu mode
+    pend_gpu_share=None,       # (P,) — required in gpu mode
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -71,16 +77,21 @@ def rank_and_match(
 
     # ---- 1. rank union of running + pending --------------------------
     user = jnp.concatenate([run_user, pend_user])
-    mem = jnp.concatenate([run_mem, pend_mem])
-    cpus = jnp.concatenate([run_cpus, pend_cpus])
     prio = jnp.concatenate([run_prio, pend_prio])
     start = jnp.concatenate([run_start, pend_start])
     valid = jnp.concatenate([run_valid, pend_valid])
-    mshare = jnp.concatenate([run_mem_share, pend_mem_share])
-    cshare = jnp.concatenate([run_cpus_share, pend_cpus_share])
 
-    ranked = dru_ops.dru_rank(user, mem, cpus, prio, start, valid,
-                              mshare, cshare)
+    if dru_mode == "gpu":
+        gpus = jnp.concatenate([run_gpus, pend_gpus])
+        gshare = jnp.concatenate([run_gpu_share, pend_gpu_share])
+        ranked = dru_ops.gpu_dru_rank(user, gpus, prio, start, valid, gshare)
+    else:
+        mem = jnp.concatenate([run_mem, pend_mem])
+        cpus = jnp.concatenate([run_cpus, pend_cpus])
+        mshare = jnp.concatenate([run_mem_share, pend_mem_share])
+        cshare = jnp.concatenate([run_cpus_share, pend_cpus_share])
+        ranked = dru_ops.dru_rank(user, mem, cpus, prio, start, valid,
+                                  mshare, cshare)
     pending_dru = ranked.dru[R:]
     # fair-queue position among *pending* jobs only: order pending by
     # their global rank.

@@ -42,7 +42,7 @@ from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
 from cook_tpu.backends.kube import checkpoint as cp
 from cook_tpu.state.model import (REASON_BY_CODE, InstanceStatus, Job,
                                   JobState, now_ms)
-from cook_tpu.state.pools import PoolRegistry
+from cook_tpu.state.pools import DruMode, PoolRegistry
 from cook_tpu.state.store import JobStore, TransactionError
 
 
@@ -316,6 +316,10 @@ class Coordinator:
                 pending, host_names, jb.user.shape[0], H)
 
         C = min(bucket(self.config.max_jobs_considered), jb.user.shape[0])
+        # gpu-mode pools rank by cumulative gpus / gpu-share
+        # (dru.clj:65-77, :pool/dru-mode schema.clj:816); matching still
+        # bin-packs all resources
+        gpu_pool = self.pools.get(pool).dru_mode == DruMode.GPU
         res = cycle_ops.rank_and_match(
             tb.user, tb.mem, tb.cpus, tb.priority, tb.start_time, tb.valid,
             tb.mem_share, tb.cpus_share,
@@ -325,7 +329,11 @@ class Coordinator:
             num_considerable=C, num_groups=jb.num_groups,
             sequential=C <= self.config.sequential_match_threshold,
             considerable_limit=num_considerable, bonus=bonus,
-            use_pallas=self.config.use_pallas)
+            use_pallas=self.config.use_pallas,
+            dru_mode="gpu" if gpu_pool else "default",
+            run_gpus=tb.gpus if gpu_pool else None,
+            run_gpu_share=tb.gpu_share if gpu_pool else None,
+            pend_gpu_share=jb.gpu_share if gpu_pool else None)
 
         job_host = np.asarray(res.job_host)
         considerable = np.asarray(res.considerable)

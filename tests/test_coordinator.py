@@ -388,3 +388,38 @@ def test_estimated_completion_grace_period_cap():
     coord.match_cycle()
     # capped at (60-10)min < the fresh host's 60min remaining -> placed
     assert marathon.instances and marathon.instances[0].hostname == "fresh"
+
+
+def test_gpu_pool_ranks_by_gpu_dru():
+    """In a :pool.dru-mode/gpu pool the fair queue orders by cumulative
+    gpus/gpu-share, not cpu/mem (dru.clj:65-77, schema.clj:816)."""
+    from cook_tpu.state.pools import DruMode, Pool, PoolRegistry
+
+    pools = PoolRegistry()
+    pools.add(Pool(name="gpu", dru_mode=DruMode.GPU))
+    store = JobStore()
+    cluster = MockCluster([
+        MockHost("g0", mem=1000, cpus=64, gpus=8, pool="gpu"),
+    ])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, pools=pools)
+    coord.shares.set("default", "gpu", gpus=8.0, mem=1e6, cpus=1e6)
+
+    # alice already holds 5 gpus (tiny mem); bob holds 1 gpu but lots of
+    # mem+cpus. Under cpu/mem DRU bob looks greedier; under gpu DRU
+    # alice does, so bob must win the last slot.
+    a_run = mkjob(user="alice", mem=1, cpus=1, gpus=5.0, pool="gpu")
+    b_run = mkjob(user="bob", mem=800, cpus=32, gpus=1.0, pool="gpu")
+    store.create_jobs([a_run, b_run])
+    coord.match_cycle(pool="gpu")
+    assert a_run.state == JobState.RUNNING
+    assert b_run.state == JobState.RUNNING
+
+    # one 2-gpu slot left (8 - 6); both users want it
+    a_pend = mkjob(user="alice", mem=1, cpus=1, gpus=2.0, pool="gpu")
+    b_pend = mkjob(user="bob", mem=1, cpus=1, gpus=2.0, pool="gpu")
+    store.create_jobs([a_pend, b_pend])
+    coord.match_cycle(pool="gpu")
+    assert b_pend.state == JobState.RUNNING     # bob: 1+2 gpus < alice 5+2
+    assert a_pend.state == JobState.WAITING
