@@ -530,17 +530,46 @@ class Coordinator:
         host_forb[:len(pending_sorted), :len(host_names)] = forb_small
         host_forb[:len(pending_sorted), len(host_names):] = True
 
-        qm, qc, qn = quota_arrays(self.quotas, self.interner, pool)
-        tasks = rb_ops.TaskState(
-            user=tb.user, mem=tb.mem, cpus=tb.cpus, priority=tb.priority,
-            start_time=tb.start_time, host=tb.host, valid=tb.valid,
-            mem_share=tb.mem_share, cpus_share=tb.cpus_share)
-        pend = rb_ops.PendingJobs(
-            user=jb.user, mem=jb.mem, cpus=jb.cpus, priority=jb.priority,
-            start_time=jb.start_time, valid=jb.valid,
-            mem_share=jb.mem_share, cpus_share=jb.cpus_share)
+        gpu_pool = self.pools.get(pool).dru_mode == DruMode.GPU
+        qm, qc, qn = quota_arrays(
+            self.quotas, self.interner, pool,
+            resources=("gpus",) if gpu_pool else ("mem", "cpus"))
+        if gpu_pool:
+            # gpu-mode pools score preemption by cumulative gpus alone
+            # (compute-pending-gpu-job-dru rebalancer.clj:160-182): feed
+            # the kernel gpus in the mem lane with a zeroed cpu lane —
+            # DRU, feasibility prefix sums, and freed-capacity checks all
+            # become gpu-denominated with no kernel change.
+            zero_t = np.zeros_like(tb.cpus)
+            zero_j = np.zeros_like(jb.cpus)
+            spare_gpus = np.zeros(Hn, np.float32)
+            for o in offers:
+                spare_gpus[host_ids[o.hostname]] += o.gpus
+            tasks = rb_ops.TaskState(
+                user=tb.user, mem=tb.gpus, cpus=zero_t,
+                priority=tb.priority, start_time=tb.start_time,
+                host=tb.host, valid=tb.valid,
+                mem_share=tb.gpu_share, cpus_share=tb.cpus_share)
+            pend = rb_ops.PendingJobs(
+                user=jb.user, mem=jb.gpus, cpus=zero_j,
+                priority=jb.priority, start_time=jb.start_time,
+                valid=jb.valid, mem_share=jb.gpu_share,
+                cpus_share=jb.cpus_share)
+            spare_a, spare_b = spare_gpus, np.zeros(Hn, np.float32)
+        else:
+            tasks = rb_ops.TaskState(
+                user=tb.user, mem=tb.mem, cpus=tb.cpus,
+                priority=tb.priority, start_time=tb.start_time,
+                host=tb.host, valid=tb.valid,
+                mem_share=tb.mem_share, cpus_share=tb.cpus_share)
+            pend = rb_ops.PendingJobs(
+                user=jb.user, mem=jb.mem, cpus=jb.cpus,
+                priority=jb.priority, start_time=jb.start_time,
+                valid=jb.valid, mem_share=jb.mem_share,
+                cpus_share=jb.cpus_share)
+            spare_a, spare_b = spare_mem, spare_cpus
         res = rb_ops.rebalance(
-            tasks, pend, spare_mem, spare_cpus, host_forb,
+            tasks, pend, spare_a, spare_b, host_forb,
             qm, qc, qn.astype(np.int32) if qn.dtype != np.int32 else qn,
             params.safe_dru_threshold, params.min_dru_diff)
 

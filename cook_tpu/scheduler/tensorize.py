@@ -175,8 +175,10 @@ def tensorize_jobs(jobs: list[Job], shares: ShareStore, pool: str,
 
 
 def quota_arrays(quotas: QuotaStore, interner: UserInterner, pool: str,
-                 size: Optional[int] = None):
-    """Per-dense-user quota arrays for the kernels."""
+                 size: Optional[int] = None, resources=("mem", "cpus")):
+    """Per-dense-user quota arrays for the kernels. `resources` names
+    the two resource lanes (gpu-mode pools pass ("gpus",) and get an
+    unlimited second lane)."""
     size = size or interner.size_bucket()
     qm = np.full(size, F32_MAX, np.float32)
     qc = np.full(size, F32_MAX, np.float32)
@@ -185,7 +187,8 @@ def quota_arrays(quotas: QuotaStore, interner: UserInterner, pool: str,
         if uid >= size:
             continue
         q = quotas.get(user, pool)
-        qm[uid] = min(q["mem"], float(F32_MAX))
-        qc[uid] = min(q["cpus"], float(F32_MAX))
+        qm[uid] = min(q[resources[0]], float(F32_MAX))
+        if len(resources) > 1:
+            qc[uid] = min(q[resources[1]], float(F32_MAX))
         qn[uid] = min(q.get("count", UNLIMITED), 1e9)
     return qm, qc, qn

@@ -423,3 +423,39 @@ def test_gpu_pool_ranks_by_gpu_dru():
     coord.match_cycle(pool="gpu")
     assert b_pend.state == JobState.RUNNING     # bob: 1+2 gpus < alice 5+2
     assert a_pend.state == JobState.WAITING
+
+
+def test_gpu_pool_rebalancer_preempts_by_gpu_dru():
+    """gpu-mode rebalancer scores preemption on cumulative gpus
+    (compute-pending-gpu-job-dru rebalancer.clj:160-182)."""
+    from cook_tpu.state.pools import DruMode, Pool, PoolRegistry
+
+    pools = PoolRegistry()
+    pools.add(Pool(name="gpu", dru_mode=DruMode.GPU))
+    store = JobStore()
+    cluster = MockCluster([
+        MockHost("g0", mem=1000, cpus=64, gpus=8, pool="gpu"),
+    ])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(
+        store, reg, pools=pools,
+        config=SchedulerConfig(
+            rebalancer=RebalancerParams(safe_dru_threshold=0.0,
+                                        min_dru_diff=0.05,
+                                        max_preemption=4)))
+    coord.shares.set("default", "gpu", gpus=8.0, mem=1e6, cpus=1e6)
+
+    # greedy fills all 8 gpus; poor user's gpu job preempts
+    greedy = [mkjob(user="greedy", mem=10, cpus=1, gpus=2.0, pool="gpu")
+              for _ in range(4)]
+    store.create_jobs(greedy)
+    coord.match_cycle(pool="gpu")
+    assert all(j.state == JobState.RUNNING for j in greedy)
+    poor = mkjob(user="poor", mem=10, cpus=1, gpus=2.0, pool="gpu")
+    store.create_jobs([poor])
+    assert coord.match_cycle(pool="gpu").matched == 0
+    res = coord.rebalance_cycle(pool="gpu")
+    assert res["preempted"] >= 1
+    coord.match_cycle(pool="gpu")
+    assert poor.state == JobState.RUNNING
