@@ -35,6 +35,8 @@ class Offer:
     cap_mem: float = 0.0
     cap_cpus: float = 0.0
     cap_gpus: float = 0.0
+    # available port ranges, inclusive (mesos-style ranges resource)
+    ports: list[tuple[int, int]] = field(default_factory=list)
 
 
 @dataclass
@@ -57,6 +59,9 @@ class LaunchSpec:
     # apply the max-checkpoint-attempts cutoff (kubernetes/api.clj:642)
     checkpoint: Optional[dict] = None
     prior_failure_reasons: list[str] = field(default_factory=list)
+    # host ports assigned by the matcher (also exported as PORT0..N-1
+    # env, the mesos task port assignment task.clj:254-280)
+    ports: list[int] = field(default_factory=list)
 
 
 StatusCallback = Callable[..., None]

@@ -27,6 +27,8 @@ class MockHost:
     gpus: float = 0.0
     pool: str = "default"
     attributes: dict[str, str] = field(default_factory=dict)
+    # advertised host port range, inclusive
+    port_range: tuple[int, int] = (31000, 31099)
 
 
 @dataclass
@@ -47,6 +49,8 @@ class MockCluster(ComputeCluster):
         self.hosts = {h.hostname: h for h in hosts}
         self.used: dict[str, list[float]] = {
             h.hostname: [0.0, 0.0, 0.0] for h in hosts}
+        self.used_ports: dict[str, set[int]] = {
+            h.hostname: set() for h in hosts}
         self.tasks: dict[str, _RunningTask] = {}
         self._heap: list[tuple[float, str]] = []
         self.clock = 0.0
@@ -67,8 +71,25 @@ class MockCluster(ComputeCluster):
                     hostname=h.hostname, pool=pool,
                     mem=h.mem - um, cpus=h.cpus - uc, gpus=h.gpus - ug,
                     attributes=dict(h.attributes),
-                    cap_mem=h.mem, cap_cpus=h.cpus, cap_gpus=h.gpus))
+                    cap_mem=h.mem, cap_cpus=h.cpus, cap_gpus=h.gpus,
+                    ports=self._free_port_ranges(h)))
             return offers
+
+    def _free_port_ranges(self, h: MockHost) -> list[tuple[int, int]]:
+        """Advertised range minus ports held by running tasks, as
+        inclusive ranges (the mesos ranges resource shape)."""
+        used = self.used_ports.get(h.hostname, set())
+        lo, hi = h.port_range
+        ranges: list[tuple[int, int]] = []
+        start = None
+        for p in range(lo, hi + 2):
+            if p <= hi and p not in used:
+                if start is None:
+                    start = p
+            elif start is not None:
+                ranges.append((start, p - 1))
+                start = None
+        return ranges
 
     def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
         with self._lock:
@@ -87,6 +108,7 @@ class MockCluster(ComputeCluster):
                     continue
                 self.used[spec.hostname] = [um + spec.mem, uc + spec.cpus,
                                             ug + spec.gpus]
+                self.used_ports[spec.hostname] |= set(spec.ports)
                 runtime, success, reason = self.runtime_fn(spec)
                 t = _RunningTask(spec, self.clock + runtime, success, reason)
                 self.tasks[spec.task_id] = t
@@ -150,6 +172,7 @@ class MockCluster(ComputeCluster):
             um, uc, ug = self.used[spec.hostname]
             self.used[spec.hostname] = [um - spec.mem, uc - spec.cpus,
                                         ug - spec.gpus]
+            self.used_ports[spec.hostname] -= set(spec.ports)
 
     # -- test helpers --------------------------------------------------
     def fail_task(self, task_id: str, reason: int = 6000) -> None:

@@ -323,6 +323,7 @@ class CookApi:
             gpus=gpus, name=name, priority=priority, max_retries=max_retries,
             max_runtime_ms=max_runtime,
             expected_runtime_ms=spec.get("expected_runtime"),
+            ports=self._parse_ports(spec),
             pool=pool or "default", group=group, env=env, labels=labels,
             constraints=constraints, uris=spec.get("uris", []),
             container=spec.get("container"),
@@ -334,6 +335,13 @@ class CookApi:
                 spec.get("disable_mea_culpa_retries", False)),
             datasets=spec.get("datasets", []),
         )
+
+    @staticmethod
+    def _parse_ports(spec: dict) -> int:
+        ports = spec.get("ports", 0)
+        if not isinstance(ports, int) or ports < 0 or ports > 256:
+            raise ApiError(400, "ports must be an integer in [0, 256]")
+        return ports
 
     def _parse_group(self, spec: dict, user: str) -> Group:
         uuid = str(spec.get("uuid") or new_uuid()).lower()

@@ -303,6 +303,14 @@ class Coordinator:
             pending, host_names, host_attrs, self.reservations,
             self._group_attr_pins(pending),
             self._group_unique_hosts(pending, host_names, host_attrs))
+        # ports feasibility (the mesos ranges resource, task.clj:254-280):
+        # jobs requesting ports can't land on hosts without enough free
+        port_counts = np.array(
+            [sum(hi - lo + 1 for lo, hi in o.ports) for o in offers])
+        want_ports = np.array([j.ports for j in pending])
+        if want_ports.any():
+            forb_small = forb_small | (want_ports[:, None]
+                                       > port_counts[None, :])
         forbidden = np.zeros((jb.user.shape[0], H), bool)
         forbidden[:len(pending), :len(offers)] = forb_small
         forbidden[:, len(offers):] = True
@@ -342,6 +350,12 @@ class Coordinator:
 
         # launch matched tasks: store txn first, then backend launch
         # (launch-matched-tasks! scheduler.clj:754-805)
+        # per-host port pools for this cycle, consumed in queue order
+        port_pool: dict[str, list[int]] = {}
+        for o in offers:
+            if o.ports:
+                port_pool[o.hostname] = [p for lo, hi in o.ports
+                                         for p in range(lo, hi + 1)]
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
         for idx in np.argsort(queue_rank[:len(pending)]):
@@ -352,20 +366,32 @@ class Coordinator:
             if not self.user_launch_rl.try_acquire(job.user):
                 continue
             hostname = host_names[h]
+            assigned_ports: list[int] = []
+            if job.ports > 0:
+                pool_left = port_pool.get(hostname, [])
+                if len(pool_left) < job.ports:
+                    continue   # in-cycle port exhaustion; retry next cycle
+                assigned_ports = pool_left[:job.ports]
+                port_pool[hostname] = pool_left[job.ports:]
             try:
                 inst = self.store.create_instance(job.uuid, hostname,
                                                   offer_cluster[hostname])
             except TransactionError:
                 continue  # lost a race (job killed meanwhile)
+            inst.ports = assigned_ports
+            env = dict(job.env)
+            for i, p in enumerate(assigned_ports):
+                env[f"PORT{i}"] = str(p)   # task.clj:254-280 port env
             by_cluster.setdefault(offer_cluster[hostname], []).append(
                 LaunchSpec(task_id=inst.task_id, job_uuid=job.uuid,
                            hostname=hostname, command=job.command,
                            mem=job.mem, cpus=job.cpus, gpus=job.gpus,
-                           env=job.env, container=job.container,
+                           env=env, container=job.container,
                            progress_regex=job.progress_regex_string,
                            progress_output_file=job.progress_output_file,
                            checkpoint=job.checkpoint,
-                           prior_failure_reasons=_failure_reason_names(job)))
+                           prior_failure_reasons=_failure_reason_names(job),
+                           ports=assigned_ports))
             launched += 1
             self.launch_rl.spend("global")
             if job.uuid in self.reservations:

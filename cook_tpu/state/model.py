@@ -167,6 +167,8 @@ class Job:
     max_retries: int = 1
     max_runtime_ms: int = 2 ** 53
     expected_runtime_ms: Optional[int] = None
+    ports: int = 0                    # number of ports requested
+    #                                   (:job/ports, resource type ports)
     state: JobState = JobState.WAITING
     pool: str = "default"
     group: Optional[str] = None       # group uuid

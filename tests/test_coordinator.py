@@ -459,3 +459,38 @@ def test_gpu_pool_rebalancer_preempts_by_gpu_dru():
     assert res["preempted"] >= 1
     coord.match_cycle(pool="gpu")
     assert poor.state == JobState.RUNNING
+
+
+def test_port_assignment():
+    """Jobs requesting ports get distinct host ports, PORT0..N-1 env,
+    and exhaustion defers matching (the mesos ranges resource,
+    task.clj:254-280)."""
+    store, cluster, coord = build(hosts=[
+        MockHost("h0", mem=1000, cpus=16, port_range=(31000, 31002)),
+    ])
+    captured = {}
+    orig = cluster.launch_tasks
+
+    def capture(pool, specs):
+        for s in specs:
+            captured[s.job_uuid] = s
+        orig(pool, specs)
+
+    cluster.launch_tasks = capture
+    j1 = mkjob(ports=2)
+    j2 = mkjob(ports=2)     # only 1 port left after j1 -> must wait
+    j3 = mkjob()            # no ports -> unaffected
+    store.create_jobs([j1, j2, j3])
+    coord.match_cycle()
+    assert j1.state == JobState.RUNNING and j3.state == JobState.RUNNING
+    assert j2.state == JobState.WAITING
+    p1 = j1.instances[0].ports
+    assert len(p1) == 2 and len(set(p1)) == 2
+    assert all(31000 <= p <= 31002 for p in p1)
+    env = captured[j1.uuid].env
+    assert env["PORT0"] == str(p1[0]) and env["PORT1"] == str(p1[1])
+    # ports release on completion: j2 can then run
+    cluster.advance(61)
+    coord.match_cycle()
+    assert j2.state == JobState.RUNNING
+    assert len(j2.instances[0].ports) == 2
