@@ -339,7 +339,8 @@ class CookApi:
     @staticmethod
     def _parse_ports(spec: dict) -> int:
         ports = spec.get("ports", 0)
-        if not isinstance(ports, int) or ports < 0 or ports > 256:
+        if not isinstance(ports, int) or isinstance(ports, bool) \
+                or ports < 0 or ports > 256:
             raise ApiError(400, "ports must be an integer in [0, 256]")
         return ports
 
@@ -780,6 +781,7 @@ def job_response(job: Job, store) -> dict:
         "mem": job.mem,
         "cpus": job.cpus,
         "gpus": job.gpus,
+        "ports": job.ports,
         "max_retries": job.max_retries,
         "max_runtime": job.max_runtime_ms,
         "retries_remaining": job.retries_remaining(),

@@ -363,16 +363,19 @@ class Coordinator:
             if h < 0 or h >= len(offers):
                 continue
             job = pending[idx]
-            if not self.user_launch_rl.try_acquire(job.user):
-                continue
             hostname = host_names[h]
+            # port availability first: a deferred job must not burn a
+            # launch-rate token
             assigned_ports: list[int] = []
             if job.ports > 0:
                 pool_left = port_pool.get(hostname, [])
                 if len(pool_left) < job.ports:
                     continue   # in-cycle port exhaustion; retry next cycle
                 assigned_ports = pool_left[:job.ports]
-                port_pool[hostname] = pool_left[job.ports:]
+            if not self.user_launch_rl.try_acquire(job.user):
+                continue
+            if assigned_ports:
+                port_pool[hostname] = port_pool[hostname][job.ports:]
             try:
                 inst = self.store.create_instance(job.uuid, hostname,
                                                   offer_cluster[hostname])
