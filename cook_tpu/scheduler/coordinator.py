@@ -43,6 +43,7 @@ from cook_tpu.backends.kube import checkpoint as cp
 from cook_tpu.state.model import (REASON_BY_CODE, InstanceStatus, Job,
                                   JobState, now_ms)
 from cook_tpu.state.pools import DruMode, PoolRegistry
+from cook_tpu.utils.metrics import registry as metrics_registry
 from cook_tpu.state.store import JobStore, TransactionError
 
 
@@ -438,6 +439,13 @@ class Coordinator:
         stats.cycle_ms = (time.perf_counter() - t0) * 1e3
         self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
         self.metrics[f"match.{pool}.matched"] = launched
+        # registry timers/meters — the codahale instrumentation of the
+        # reference match loop (handle-resource-offer!-* timers
+        # scheduler.clj:857-868, matched/launched meters)
+        metrics_registry.timer(f"match.{pool}.cycle_ms").update(
+            stats.cycle_ms)
+        metrics_registry.meter(f"match.{pool}.matched").mark(launched)
+        metrics_registry.counter(f"match.{pool}.cycles").inc()
         return stats
 
     def _group_attr_pins(self, pending: list[Job]) -> dict[str, dict[str, str]]:
@@ -515,6 +523,7 @@ class Coordinator:
     # ------------------------------------------------------------------
     # rebalancer cycle (rebalancer.clj:428-518)
     def rebalance_cycle(self, pool: Optional[str] = None) -> dict:
+        t_reb0 = time.perf_counter()
         pool = pool or self.pools.default_pool
         params = self.config.rebalancer
         self._purge_reservations()
@@ -638,6 +647,9 @@ class Coordinator:
                 self.reservations[job_uuid] = hostname
 
         self.metrics[f"rebalance.{pool}.preempted"] = n_killed
+        metrics_registry.meter(f"rebalance.{pool}.preempted").mark(n_killed)
+        metrics_registry.timer(f"rebalance.{pool}.cycle_ms").update(
+            (time.perf_counter() - t_reb0) * 1e3)
         return {"preempted": n_killed, "placed": int(placed.sum()),
                 "decisions": decisions}
 
