@@ -196,13 +196,20 @@ def bench_rebalance(T0=50_000, P=64, H=2_000, U=500):
     placed = np.asarray(res.job_placed)
     compile_s = time.perf_counter() - t0
 
-    N = 5
-    t0 = time.perf_counter()
-    for _ in range(N):
-        res = reb.rebalance(tasks, pending, spare_mem, spare_cpus, forb,
-                            qm, qc, qn, 0.5, 0.1)
-    _ = np.asarray(res.job_placed[:1])
-    sweep_ms = (time.perf_counter() - t0) / N * 1e3
+    def sweep(n, **kw):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = reb.rebalance(tasks, pending, spare_mem, spare_cpus, forb,
+                              qm, qc, qn, 0.5, 0.1, **kw)
+        _ = np.asarray(r.job_placed[:1])
+        return (time.perf_counter() - t0) / n * 1e3, r
+
+    sweep_ms, res = sweep(5)
+    # top-k candidate compression (valid decisions, exact up to 8192
+    # candidates — see ops.rebalance.rebalance candidate_cap)
+    reb.rebalance(tasks, pending, spare_mem, spare_cpus, forb,
+                  qm, qc, qn, 0.5, 0.1, candidate_cap=8192)
+    capped_ms, res_c = sweep(5, candidate_cap=8192)
 
     print(json.dumps({
         "metric": f"rebalancer sweep ms @ {T0 // 1000}k running, "
@@ -213,6 +220,8 @@ def bench_rebalance(T0=50_000, P=64, H=2_000, U=500):
         "vs_baseline": round(300_000.0 / sweep_ms, 1),
         "placed": int(placed.sum()),
         "preempted": int(np.asarray(res.preempted).sum()),
+        "capped8192_ms": round(capped_ms, 1),
+        "capped8192_preempted": int(np.asarray(res_c.preempted).sum()),
         "compile_s": round(compile_s, 1),
         "device": str(dev),
     }))

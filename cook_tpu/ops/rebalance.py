@@ -22,12 +22,19 @@ pending jobs in fair-queue order:
 The reference walks a JVM priority map per job; here each step is a sort
 + segmented cumsum over all (tasks + hosts) at once, and the sequential
 outer loop is a lax.scan whose carry holds the mutable cluster state.
-DRUs are *fully recomputed* each step on device (cheap: one fused sort
-pipeline) instead of incrementally patched like dru.clj:123-139.
+DRUs are *fully recomputed* each step (next-state semantics without the
+incremental patching of dru.clj:123-139) — but WITHOUT re-sorting:
+every pending job owns a dedicated trailing fill slot (job j -> slot
+T-P+j), so all task keys (user, -priority, start, id) are known up
+front, the user-task sort happens ONCE outside the scan, and each step's
+DRU recompute is just a masked segmented cumsum over the pre-sorted
+frame (validity is the only thing that changes). The whole scan body
+runs in that sorted frame; results map back through the permutation at
+the end.
 
-Shapes: T task slots (running tasks padded, plus `max_preemption` empty
-slots that the scan fills with placed pending jobs), H hosts, P pending
-candidates, U users.
+Shapes: T task slots (running tasks padded, plus P trailing slots that
+hold the pending jobs' resources with valid=False until placed), H
+hosts, P pending candidates, U users.
 """
 from __future__ import annotations
 
@@ -84,7 +91,7 @@ def _key_leq(p1, s1, i1, p2, s2, i2):
     return lt
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(jax.jit, static_argnames=("candidate_cap",))
 def rebalance(tasks: TaskState,
               pending: PendingJobs,
               spare_mem: jnp.ndarray,
@@ -94,7 +101,8 @@ def rebalance(tasks: TaskState,
               user_quota_cpus: jnp.ndarray,
               user_quota_count: jnp.ndarray,
               safe_dru_threshold: jnp.ndarray | float,
-              min_dru_diff: jnp.ndarray | float) -> RebalanceResult:
+              min_dru_diff: jnp.ndarray | float,
+              candidate_cap: int | None = None) -> RebalanceResult:
     """Run one rebalancer cycle.
 
     host_forbidden: (P, H) bool — hosts each pending job may NOT use
@@ -103,47 +111,90 @@ def rebalance(tasks: TaskState,
     user_quota_*: (U,) per-user quota, +inf / INT_MAX when unset.
     The `tasks` arrays must have at least P trailing invalid slots: placed
     pending jobs are materialized there so later decisions see them.
+
+    candidate_cap: when set, each step's per-host prefix search runs
+    over only the top-K candidate victims by DRU instead of all T task
+    slots (the per-step sort shrinks from H+T to H+K). Decisions remain
+    *valid* (cumulative sums are real), but a host whose winning prefix
+    would need a candidate outside the global top-K can be missed —
+    exact when the candidate count stays under K. None = exact.
     """
     T = tasks.user.shape[0]
     H = spare_mem.shape[0]
     P = pending.user.shape[0]
-    task_idx = jnp.arange(T)
     safe_dru_threshold = jnp.float32(safe_dru_threshold)
     min_dru_diff = jnp.float32(min_dru_diff)
-
-    # Per-user running usage for the quota test (job-below-quota,
-    # rebalancer.clj:209-219).
     U = user_quota_mem.shape[0]
 
-    def usage_of(valid, user, vals):
+    # -- materialize every pending job in its dedicated fill slot -------
+    # job j owns slot T-P+j (valid=False until its step places it), so
+    # all task sort keys are known before the scan.
+    fill = jnp.arange(T - P, T)
+    t_user = tasks.user.at[fill].set(pending.user)
+    t_mem = tasks.mem.at[fill].set(pending.mem)
+    t_cpus = tasks.cpus.at[fill].set(pending.cpus)
+    t_prio = tasks.priority.at[fill].set(pending.priority)
+    t_start = tasks.start_time.at[fill].set(pending.start_time)
+    t_mshare = tasks.mem_share.at[fill].set(pending.mem_share)
+    t_cshare = tasks.cpus_share.at[fill].set(pending.cpus_share)
+    t_host0 = tasks.host
+    t_valid0 = tasks.valid.at[fill].set(False)
+
+    # -- the one sort: (user, -priority, start, id), validity-free ------
+    # (user_task_sort pushes invalid slots to the end, which would move
+    # as placements flip validity; sorting by true keys keeps the frame
+    # static — invalid slots just contribute zero to the masked cumsums)
+    ids = jnp.arange(T, dtype=jnp.int32)
+    perm0 = jnp.lexsort((ids, t_start, -t_prio, t_user))
+    s_user = t_user[perm0]
+    s_mem = t_mem[perm0]
+    s_cpus = t_cpus[perm0]
+    s_prio = t_prio[perm0]
+    s_start = t_start[perm0]
+    s_mshare = t_mshare[perm0]
+    s_cshare = t_cshare[perm0]
+    s_ids = ids[perm0]                  # original slot id of each row
+    # static per-user segment starts for the per-step masked cumsum
+    sidx = jnp.arange(T, dtype=jnp.int32)
+    starts = jnp.where(sidx == 0, True, s_user != jnp.roll(s_user, 1))
+    start_idx = jax.lax.associative_scan(jnp.maximum,
+                                         jnp.where(starts, sidx, -1))
+    # sorted position of each fill slot (for the validity flip)
+    inv0 = jnp.zeros(T, jnp.int32).at[perm0].set(sidx)
+    fill_pos = inv0[fill]
+
+    def usage_of(valid, vals):
         return jax.ops.segment_sum(jnp.where(valid, vals, 0.0),
-                                   jnp.where(valid, user, U),
+                                   jnp.where(valid, s_user, U),
                                    num_segments=U + 1)[:U]
 
     def step(carry, xs):
-        (t_user, t_mem, t_cpus, t_prio, t_start, t_host, t_valid,
-         t_mshare, t_cshare, preempted, sp_mem, sp_cpus, fill_ptr) = carry
+        (s_valid, s_host, preempted, sp_mem, sp_cpus) = carry
         (j_user, j_mem, j_cpus, j_prio, j_start, j_valid,
-         j_mshare, j_cshare, j_forbidden) = xs
+         j_mshare, j_cshare, j_forbidden, j_fill_pos) = xs
 
-        # -- recompute DRUs over current task set ----------------------
-        ranked = dru_ops.dru_rank(t_user, t_mem, t_cpus, t_prio, t_start,
-                                  t_valid, t_mshare, t_cshare)
-        dru = ranked.dru
+        # -- DRUs: masked per-user cumsum over the static frame --------
+        vals = jnp.stack([jnp.where(s_valid, s_mem, 0.0),
+                          jnp.where(s_valid, s_cpus, 0.0)], -1)
+        total = jnp.cumsum(vals, axis=0)
+        base = jnp.take(total, start_idx, axis=0) \
+            - jnp.take(vals, start_idx, axis=0)
+        cum = total - base
+        dru = jnp.maximum(cum[:, 0] / s_mshare, cum[:, 1] / s_cshare)
 
-        # -- pending job dru ------------------------------------------
-        same_user = t_valid & (t_user == j_user)
-        leq = _key_leq(t_prio, t_start, task_idx,
+        # -- pending job dru (rebalancer.clj:183-207) ------------------
+        same_user = s_valid & (s_user == j_user)
+        leq = _key_leq(s_prio, s_start, s_ids,
                        j_prio, j_start, jnp.int32(2**30))
         nearest = jnp.max(jnp.where(same_user & leq, dru, 0.0))
         own_share = jnp.maximum(j_mem / j_mshare, j_cpus / j_cshare)
         pending_dru = nearest + own_share
 
-        # -- quota test -----------------------------------------------
-        u_mem = usage_of(t_valid, t_user, t_mem)
-        u_cpus = usage_of(t_valid, t_user, t_cpus)
-        u_cnt = jax.ops.segment_sum(t_valid.astype(jnp.int32),
-                                    jnp.where(t_valid, t_user, U),
+        # -- quota test (job-below-quota, rebalancer.clj:209-219) ------
+        u_mem = usage_of(s_valid, s_mem)
+        u_cpus = usage_of(s_valid, s_cpus)
+        u_cnt = jax.ops.segment_sum(s_valid.astype(jnp.int32),
+                                    jnp.where(s_valid, s_user, U),
                                     num_segments=U + 1)[:U]
         uid = jnp.clip(j_user, 0, U - 1)
         below_quota = ((u_mem[uid] + j_mem <= user_quota_mem[uid])
@@ -151,22 +202,39 @@ def rebalance(tasks: TaskState,
                        & (u_cnt[uid] + 1 <= user_quota_count[uid]))
 
         # -- candidate victims ----------------------------------------
-        cand = (t_valid
+        cand = (s_valid
                 & (dru >= safe_dru_threshold)
                 & (dru - pending_dru > min_dru_diff)
-                & (below_quota | (t_user == j_user)))
+                & (below_quota | (s_user == j_user)))
 
         # -- per-host prefix feasibility ------------------------------
         # Build a combined sequence: one spare pseudo-entry per host
         # (dru=+inf) followed by that host's candidates in global
         # (-dru, user) order. Sort key: (host, -dru, user, idx).
-        seq_host = jnp.concatenate([jnp.arange(H, dtype=jnp.int32),
-                                    jnp.where(cand, t_host, H)])
-        seq_dru = jnp.concatenate([jnp.full(H, INF), jnp.where(cand, dru, 0.0)])
-        seq_user = jnp.concatenate([jnp.full(H, -1, jnp.int32), t_user])
-        seq_mem = jnp.concatenate([sp_mem, jnp.where(cand, t_mem, 0.0)])
-        seq_cpus = jnp.concatenate([sp_cpus, jnp.where(cand, t_cpus, 0.0)])
-        n_seq = H + T
+        if candidate_cap is not None and candidate_cap < T:
+            # compress to the top-K candidates by dru first
+            _, topi = jax.lax.top_k(jnp.where(cand, dru, -INF),
+                                    candidate_cap)
+            k_keep = cand[topi]
+            c_host = jnp.where(k_keep, s_host[topi], H)
+            c_dru = jnp.where(k_keep, dru[topi], 0.0)
+            c_user = s_user[topi]
+            c_mem = jnp.where(k_keep, s_mem[topi], 0.0)
+            c_cpus = jnp.where(k_keep, s_cpus[topi], 0.0)
+        else:
+            topi = None
+            c_host = jnp.where(cand, s_host, H)
+            c_dru = jnp.where(cand, dru, 0.0)
+            c_user = s_user
+            c_mem = jnp.where(cand, s_mem, 0.0)
+            c_cpus = jnp.where(cand, s_cpus, 0.0)
+        K = c_host.shape[0]
+        seq_host = jnp.concatenate([jnp.arange(H, dtype=jnp.int32), c_host])
+        seq_dru = jnp.concatenate([jnp.full(H, INF), c_dru])
+        seq_user = jnp.concatenate([jnp.full(H, -1, jnp.int32), c_user])
+        seq_mem = jnp.concatenate([sp_mem, c_mem])
+        seq_cpus = jnp.concatenate([sp_cpus, c_cpus])
+        n_seq = H + K
         perm = jnp.lexsort((jnp.arange(n_seq), seq_user, -seq_dru, seq_host))
         p_host = seq_host[perm]
         cums = segment_cumsum(
@@ -197,47 +265,44 @@ def rebalance(tasks: TaskState,
         # victims: candidates on best_host at sorted position <= cut
         sorted_pos_of = jnp.zeros(n_seq, jnp.int32).at[perm].set(
             jnp.arange(n_seq, dtype=jnp.int32))
-        task_sorted_pos = sorted_pos_of[H:]
-        victim = cand & (t_host == best_host) & (task_sorted_pos <= cut) & placed
+        cand_sorted_pos = sorted_pos_of[H:]
+        victim_k = (c_host == best_host) & (cand_sorted_pos <= cut) & placed
+        if topi is not None:
+            victim = jnp.zeros(T, bool).at[topi].set(victim_k)
+        else:
+            victim = cand & victim_k
 
-        freed_mem = jnp.sum(jnp.where(victim, t_mem, 0.0)) + jnp.where(placed, sp_mem[bh], 0.0)
-        freed_cpus = jnp.sum(jnp.where(victim, t_cpus, 0.0)) + jnp.where(placed, sp_cpus[bh], 0.0)
+        freed_mem = jnp.sum(jnp.where(victim, s_mem, 0.0)) \
+            + jnp.where(placed, sp_mem[bh], 0.0)
+        freed_cpus = jnp.sum(jnp.where(victim, s_cpus, 0.0)) \
+            + jnp.where(placed, sp_cpus[bh], 0.0)
 
         # -- state update (next-state, rebalancer.clj:269-308) ---------
-        t_valid = t_valid & ~victim
+        s_valid = s_valid & ~victim
         preempted = preempted | victim
-        sp_mem = jnp.where(placed, sp_mem.at[bh].set(freed_mem - j_mem), sp_mem)
-        sp_cpus = jnp.where(placed, sp_cpus.at[bh].set(freed_cpus - j_cpus), sp_cpus)
+        sp_mem = jnp.where(placed,
+                           sp_mem.at[bh].set(freed_mem - j_mem), sp_mem)
+        sp_cpus = jnp.where(placed,
+                            sp_cpus.at[bh].set(freed_cpus - j_cpus), sp_cpus)
 
-        # materialize the placed job as a running task in its fill slot
-        fp = jnp.clip(fill_ptr, 0, T - 1)
-        def put(arr, val):
-            return arr.at[fp].set(jnp.where(placed, val, arr[fp]))
-        t_user = put(t_user, j_user)
-        t_mem = put(t_mem, j_mem)
-        t_cpus = put(t_cpus, j_cpus)
-        t_prio = put(t_prio, j_prio)
-        t_start = put(t_start, j_start)
-        t_host = put(t_host, best_host)
-        t_mshare = put(t_mshare, j_mshare)
-        t_cshare = put(t_cshare, j_cshare)
-        t_valid = t_valid.at[fp].set(jnp.where(placed, True, t_valid[fp]))
-        fill_ptr = fill_ptr + placed.astype(jnp.int32)
+        # flip the job's fill slot live (values were preset before the
+        # scan; only validity and host assignment are dynamic)
+        s_valid = s_valid.at[j_fill_pos].set(
+            placed | s_valid[j_fill_pos])
+        s_host = s_host.at[j_fill_pos].set(
+            jnp.where(placed, best_host, s_host[j_fill_pos]))
 
-        carry = (t_user, t_mem, t_cpus, t_prio, t_start, t_host, t_valid,
-                 t_mshare, t_cshare, preempted, sp_mem, sp_cpus, fill_ptr)
-        return carry, (placed, best_host)
+        return (s_valid, s_host, preempted, sp_mem, sp_cpus), \
+            (placed, best_host)
 
-    first_free = jnp.int32(T - P)  # pending fill slots are the P trailing ones
-    carry = (tasks.user, tasks.mem, tasks.cpus, tasks.priority,
-             tasks.start_time, tasks.host, tasks.valid,
-             tasks.mem_share, tasks.cpus_share,
-             jnp.zeros(T, bool), spare_mem, spare_cpus, first_free)
+    carry = (t_valid0[perm0], t_host0[perm0], jnp.zeros(T, bool),
+             spare_mem, spare_cpus)
     xs = (pending.user, pending.mem, pending.cpus, pending.priority,
           pending.start_time, pending.valid, pending.mem_share,
-          pending.cpus_share, host_forbidden)
+          pending.cpus_share, host_forbidden, fill_pos)
     carry, (placed, hostv) = jax.lax.scan(step, carry, xs)
-    preempted = carry[9]
+    # map the preempted mask back from the sorted frame
+    preempted = jnp.zeros(T, bool).at[perm0].set(carry[2])
     return RebalanceResult(job_placed=placed, job_host=hostv,
                            preempted=preempted,
-                           spare_mem=carry[10], spare_cpus=carry[11])
+                           spare_mem=carry[3], spare_cpus=carry[4])

@@ -197,3 +197,55 @@ def test_randomized_multi_job_vs_oracle(seed):
     got_victims |= {placed_ids[k] for k, v in enumerate(preempted[n_tasks:])
                     if v and k < len(placed_ids)}
     assert got_victims == victims
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_candidate_cap_matches_exact_when_k_covers(seed):
+    """candidate_cap >= candidate count is bit-identical to exact."""
+    rng = np.random.default_rng(seed)
+    n_tasks, n_pend, n_hosts, n_users = 40, 6, 5, 4
+    shares = {u: (30.0, 10.0) for u in range(n_users)}
+    tasks = [
+        Task(id=i, user=int(rng.integers(0, n_users)),
+             mem=float(rng.integers(1, 20)), cpus=float(rng.integers(1, 8)),
+             priority=int(rng.integers(0, 3)), start_time=int(i),
+             host=int(rng.integers(0, n_hosts)))
+        for i in range(n_tasks)
+    ]
+    pend = [
+        Task(id=PENDING_ID_BASE + i, user=int(rng.integers(0, n_users)),
+             mem=float(rng.integers(1, 25)), cpus=float(rng.integers(1, 10)),
+             priority=int(rng.integers(0, 3)), start_time=int(100 + i))
+        for i in range(n_pend)
+    ]
+    spare = {h: (float(rng.integers(0, 6)), float(rng.integers(0, 3)))
+             for h in range(n_hosts)}
+
+    P = len(pend)
+    T = len(tasks) + P
+    ts = make_task_state(tasks, shares, T, n_users)
+    pj = make_pending(pend, shares)
+    sp_mem = np.zeros(n_hosts, np.float32)
+    sp_cpus = np.zeros(n_hosts, np.float32)
+    for h, (m, c) in spare.items():
+        sp_mem[h], sp_cpus[h] = m, c
+    forb = np.zeros((P, n_hosts), bool)
+    inf = np.float32(3.4e38)
+    args = (ts, pj, jnp.asarray(sp_mem), jnp.asarray(sp_cpus),
+            jnp.asarray(forb), jnp.full(n_users, inf),
+            jnp.full(n_users, inf), jnp.full(n_users, 2 ** 30, jnp.int32),
+            0.1, 0.05)
+    exact = rb.rebalance(*args)
+    # cap < T engages the top-k compression; still covers all 40
+    # possible candidates so results must be identical
+    capped = rb.rebalance(*args, candidate_cap=T - 1)
+    np.testing.assert_array_equal(np.asarray(exact.job_placed),
+                                  np.asarray(capped.job_placed))
+    np.testing.assert_array_equal(np.asarray(exact.job_host),
+                                  np.asarray(capped.job_host))
+    np.testing.assert_array_equal(np.asarray(exact.preempted),
+                                  np.asarray(capped.preempted))
+    # a small cap still yields only-valid decisions
+    tiny = rb.rebalance(*args, candidate_cap=8)
+    assert np.asarray(tiny.preempted).sum() <= np.asarray(
+        exact.preempted).sum() + 8
