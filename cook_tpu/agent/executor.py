@@ -33,6 +33,48 @@ DEFAULT_PROGRESS_REGEX = r"progress:?\s+(\d+)(?:\s+(.*))?"
 MAX_MESSAGE_LENGTH = 512
 
 
+def fetch_uri(uri: dict, sandbox: str) -> str:
+    """Fetch one FetchableURI into the sandbox (the mesos fetcher's
+    role for :job/uris — value/extract/executable; cache is accepted
+    but a no-op here). file:// and bare paths copy; http(s) downloads.
+    Returns the destination path; raises OSError on failure."""
+    import shutil
+    import tarfile
+    import urllib.parse
+    import urllib.request
+    import zipfile
+
+    value = uri.get("value") or ""
+    if not value:
+        raise OSError("uri without value")
+    parsed = urllib.parse.urlparse(value)
+    name = os.path.basename(parsed.path or value) or "download"
+    dest = os.path.join(sandbox, name)
+    try:
+        if parsed.scheme in ("http", "https"):
+            with urllib.request.urlopen(value, timeout=60) as r, \
+                    open(dest, "wb") as f:
+                shutil.copyfileobj(r, f)
+        else:
+            src = parsed.path if parsed.scheme == "file" else value
+            shutil.copy(src, dest)
+    except Exception as e:
+        raise OSError(f"fetch failed for {value}: {e}") from e
+    if uri.get("executable"):
+        os.chmod(dest, os.stat(dest).st_mode | 0o755)
+    if uri.get("extract"):
+        try:
+            if dest.endswith((".tar", ".tar.gz", ".tgz", ".tar.bz2")):
+                with tarfile.open(dest) as t:
+                    t.extractall(sandbox, filter="data")
+            elif dest.endswith(".zip"):
+                with zipfile.ZipFile(dest) as z:
+                    z.extractall(sandbox)
+        except Exception as e:
+            raise OSError(f"extract failed for {value}: {e}") from e
+    return dest
+
+
 @dataclass
 class TaskHandle:
     task_id: str
@@ -70,10 +112,19 @@ class Executor:
     def launch(self, task_id: str, command: str,
                env: Optional[dict] = None,
                progress_regex: str = "",
-               progress_output_file: str = "") -> str:
-        """Start the task; returns the sandbox directory."""
+               progress_output_file: str = "",
+               uris: Optional[list] = None) -> str:
+        """Start the task; returns the sandbox directory.
+
+        uris: [{"value": path-or-url, "extract": bool, "executable":
+        bool, "cache": bool}] fetched into the sandbox before the
+        command starts (FetchableURI / the mesos fetcher; a fetch
+        failure raises OSError so the backend can fail the task with
+        container-launch-failed)."""
         sandbox = os.path.join(self.sandbox_root, task_id)
         os.makedirs(sandbox, exist_ok=True)
+        for uri in uris or []:
+            fetch_uri(uri, sandbox)
         stdout = open(os.path.join(sandbox, "stdout"), "wb")
         stderr = open(os.path.join(sandbox, "stderr"), "wb")
         full_env = {**os.environ, **(env or {}),

@@ -62,6 +62,8 @@ class LaunchSpec:
     # host ports assigned by the matcher (also exported as PORT0..N-1
     # env, the mesos task port assignment task.clj:254-280)
     ports: list[int] = field(default_factory=list)
+    # FetchableURIs to stage into the sandbox before the command runs
+    uris: list[dict] = field(default_factory=list)
 
 
 StatusCallback = Callable[..., None]

@@ -81,7 +81,8 @@ class LocalCluster(ComputeCluster):
                 self.executor.launch(
                     spec.task_id, spec.command, env=spec.env,
                     progress_regex=spec.progress_regex,
-                    progress_output_file=spec.progress_output_file)
+                    progress_output_file=spec.progress_output_file,
+                    uris=spec.uris)
             except OSError:
                 with self._lock:
                     self._specs.pop(spec.task_id, None)

@@ -395,7 +395,7 @@ class Coordinator:
                            progress_output_file=job.progress_output_file,
                            checkpoint=job.checkpoint,
                            prior_failure_reasons=_failure_reason_names(job),
-                           ports=assigned_ports))
+                           ports=assigned_ports, uris=job.uris))
             launched += 1
             self.launch_rl.spend("global")
             if job.uuid in self.reservations:

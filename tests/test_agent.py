@@ -251,3 +251,41 @@ def test_local_cluster_capacity_accounting(local_stack):
     assert len(running) == 2
     offers = cluster.pending_offers("default")
     assert offers == [] or offers[0].mem <= 96
+
+
+def test_uri_fetch_into_sandbox(tmp_path):
+    """FetchableURIs stage into the sandbox before the command runs:
+    copy, executable bit, tar extraction, and failure -> OSError."""
+    import tarfile
+
+    from cook_tpu.agent.executor import fetch_uri
+
+    src = tmp_path / "data.txt"
+    src.write_text("payload")
+    tarball = tmp_path / "bundle.tar.gz"
+    with tarfile.open(tarball, "w:gz") as t:
+        t.add(src, arcname="inner.txt")
+    sandbox = tmp_path / "sb"
+    sandbox.mkdir()
+
+    dest = fetch_uri({"value": str(src)}, str(sandbox))
+    assert (sandbox / "data.txt").read_text() == "payload"
+    fetch_uri({"value": str(src), "executable": True}, str(sandbox))
+    assert os.access(dest, os.X_OK)
+    fetch_uri({"value": str(tarball), "extract": True}, str(sandbox))
+    assert (sandbox / "inner.txt").read_text() == "payload"
+    with pytest.raises(OSError):
+        fetch_uri({"value": str(tmp_path / "missing")}, str(sandbox))
+
+    # end-to-end: executor stages the uri, command consumes it
+    events = []
+    ex = Executor(str(tmp_path / "root"),
+                  on_status=lambda *a: events.append(a))
+    ex.launch("t-uri", "cat data.txt > out.txt",
+              uris=[{"value": str(src)}])
+    deadline = time.time() + 5
+    while time.time() < deadline and len(events) < 2:
+        time.sleep(0.05)
+    sb = events[0][2]["sandbox"]
+    assert (events[1][1], events[1][2]["exit_code"]) == ("exited", 0)
+    assert open(os.path.join(sb, "out.txt")).read() == "payload"
