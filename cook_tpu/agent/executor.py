@@ -44,8 +44,11 @@ def fetch_uri(uri: dict, sandbox: str) -> str:
     import urllib.request
     import zipfile
 
+    if not isinstance(uri, dict):
+        raise OSError(f"malformed uri entry {uri!r} (expected an object "
+                      "with a 'value' key)")
     value = uri.get("value") or ""
-    if not value:
+    if not isinstance(value, str) or not value:
         raise OSError("uri without value")
     parsed = urllib.parse.urlparse(value)
     name = os.path.basename(parsed.path or value) or "download"
@@ -63,13 +66,20 @@ def fetch_uri(uri: dict, sandbox: str) -> str:
     if uri.get("executable"):
         os.chmod(dest, os.stat(dest).st_mode | 0o755)
     if uri.get("extract"):
+        # sniff content, not extensions: tarfile handles gz/bz2/xz
+        # transparently, and an unextractable archive must FAIL, not
+        # silently no-op into a later file-not-found
         try:
-            if dest.endswith((".tar", ".tar.gz", ".tgz", ".tar.bz2")):
+            if tarfile.is_tarfile(dest):
                 with tarfile.open(dest) as t:
                     t.extractall(sandbox, filter="data")
-            elif dest.endswith(".zip"):
+            elif zipfile.is_zipfile(dest):
                 with zipfile.ZipFile(dest) as z:
                     z.extractall(sandbox)
+            else:
+                raise OSError(f"{name} is not a tar or zip archive")
+        except OSError:
+            raise
         except Exception as e:
             raise OSError(f"extract failed for {value}: {e}") from e
     return dest
@@ -79,9 +89,10 @@ def fetch_uri(uri: dict, sandbox: str) -> str:
 class TaskHandle:
     task_id: str
     sandbox: str
-    proc: subprocess.Popen
+    proc: Optional[subprocess.Popen] = None   # None while fetching uris
     threads: list = field(default_factory=list)
     killed: bool = False
+    done: bool = False
 
 
 class Executor:
@@ -118,13 +129,49 @@ class Executor:
 
         uris: [{"value": path-or-url, "extract": bool, "executable":
         bool, "cache": bool}] fetched into the sandbox before the
-        command starts (FetchableURI / the mesos fetcher; a fetch
-        failure raises OSError so the backend can fail the task with
-        container-launch-failed)."""
+        command starts. Fetching happens on the task's own thread (the
+        mesos fetcher runs async on the agent — a slow download must
+        never stall the caller's match loop); a fetch failure emits a
+        "fetch_failed" status so the backend can fail the task with
+        container-launch-failed."""
         sandbox = os.path.join(self.sandbox_root, task_id)
         os.makedirs(sandbox, exist_ok=True)
-        for uri in uris or []:
-            fetch_uri(uri, sandbox)
+        handle = TaskHandle(task_id=task_id, sandbox=sandbox)
+        with self._lock:
+            self.tasks[task_id] = handle
+        t0 = threading.Thread(
+            target=self._fetch_and_start,
+            args=(handle, command, env, progress_regex,
+                  progress_output_file, list(uris or [])),
+            daemon=True)
+        t0.start()
+        handle.threads = [t0]
+        return sandbox
+
+    def _fetch_and_start(self, handle: TaskHandle, command, env,
+                         progress_regex, progress_output_file,
+                         uris) -> None:
+        task_id, sandbox = handle.task_id, handle.sandbox
+        try:
+            for uri in uris:
+                if handle.killed:
+                    break
+                fetch_uri(uri, sandbox)
+        except OSError as e:
+            with self._lock:
+                self.tasks.pop(task_id, None)
+            handle.done = True
+            self.on_status(task_id, "fetch_failed",
+                           {"sandbox": sandbox, "error": str(e)})
+            return
+        if handle.killed:
+            with self._lock:
+                self.tasks.pop(task_id, None)
+            handle.done = True
+            self.on_status(task_id, "killed",
+                           {"sandbox": sandbox, "exit_code": None})
+            return
+
         stdout = open(os.path.join(sandbox, "stdout"), "wb")
         stderr = open(os.path.join(sandbox, "stderr"), "wb")
         full_env = {**os.environ, **(env or {}),
@@ -136,10 +183,10 @@ class Executor:
             start_new_session=True)  # own process group
         stdout.close()
         stderr.close()
-        handle = TaskHandle(task_id=task_id, sandbox=sandbox, proc=proc)
-        with self._lock:
-            self.tasks[task_id] = handle
+        handle.proc = proc
         self.on_status(task_id, "running", {"sandbox": sandbox})
+        if handle.killed:      # kill arrived during Popen
+            self._kill_group(handle)
 
         watcher_files = [os.path.join(sandbox, "stdout")]
         if progress_output_file:
@@ -153,16 +200,20 @@ class Executor:
         t3 = threading.Thread(target=self._reap, args=(handle,), daemon=True)
         for t in (t1, t2, t3):
             t.start()
-        handle.threads = [t1, t2, t3]
-        return sandbox
+        handle.threads += [t1, t2, t3]
 
     def kill(self, task_id: str) -> None:
-        """Graceful then forced kill of the whole process group."""
+        """Graceful then forced kill of the whole process group. A task
+        still fetching uris is flagged; its launch thread aborts."""
         with self._lock:
             handle = self.tasks.get(task_id)
         if handle is None:
             return
         handle.killed = True
+        if handle.proc is not None:
+            self._kill_group(handle)
+
+    def _kill_group(self, handle: TaskHandle) -> None:
         try:
             pgid = os.getpgid(handle.proc.pid)
             os.killpg(pgid, signal.SIGTERM)
@@ -181,7 +232,7 @@ class Executor:
     def alive_task_ids(self) -> set[str]:
         with self._lock:
             return {tid for tid, h in self.tasks.items()
-                    if h.proc.poll() is None}
+                    if h.proc is None or h.proc.poll() is None}
 
     # ------------------------------------------------------------------
     def _reap(self, handle: TaskHandle) -> None:

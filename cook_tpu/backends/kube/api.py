@@ -48,6 +48,9 @@ class Pod:
     # injected volumes/mounts (checkpointing tools volume etc.,
     # task-metadata->pod kubernetes/api.clj:598-611)
     volumes: list = field(default_factory=list)
+    # FetchableURIs staged by the pod's init-container (the reference
+    # renders these into the init-container spec, api.clj:661-882)
+    init_uris: list = field(default_factory=list)
 
     @property
     def synthetic(self) -> bool:

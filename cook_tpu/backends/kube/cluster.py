@@ -116,7 +116,8 @@ class KubeCluster(ComputeCluster):
                       env={**spec.env, **cp.checkpoint_env(ckpt)},
                       command=spec.command,
                       labels={"cook-job": spec.job_uuid},
-                      volumes=cp.checkpoint_volumes(ckpt))
+                      volumes=cp.checkpoint_volumes(ckpt),
+                      init_uris=list(spec.uris))
             self.controller.set_expected(spec.task_id,
                                          ExpectedState.STARTING,
                                          launch_pod=pod)

@@ -105,6 +105,14 @@ class LocalCluster(ComputeCluster):
             self.emit_status(task_id, InstanceStatus.RUNNING, None,
                              sandbox=sandbox)
             return
+        if event == "fetch_failed":
+            with self._lock:
+                self._specs.pop(task_id, None)
+            if self.heartbeats is not None:
+                self.heartbeats.untrack(task_id)
+            self.emit_status(task_id, InstanceStatus.FAILED, 99003,
+                             sandbox=sandbox)
+            return
         with self._lock:
             self._specs.pop(task_id, None)
         if self.heartbeats is not None:

@@ -325,7 +325,7 @@ class CookApi:
             expected_runtime_ms=spec.get("expected_runtime"),
             ports=self._parse_ports(spec),
             pool=pool or "default", group=group, env=env, labels=labels,
-            constraints=constraints, uris=spec.get("uris", []),
+            constraints=constraints, uris=self._parse_uris(spec),
             container=spec.get("container"),
             application=spec.get("application"),
             progress_output_file=spec.get("progress_output_file", ""),
@@ -335,6 +335,18 @@ class CookApi:
                 spec.get("disable_mea_culpa_retries", False)),
             datasets=spec.get("datasets", []),
         )
+
+    @staticmethod
+    def _parse_uris(spec: dict) -> list:
+        uris = spec.get("uris", [])
+        if not isinstance(uris, list):
+            raise ApiError(400, "uris must be a list")
+        for u in uris:
+            if not isinstance(u, dict) or \
+                    not isinstance(u.get("value"), str) or not u["value"]:
+                raise ApiError(
+                    400, "each uri must be an object with a string 'value'")
+        return uris
 
     @staticmethod
     def _parse_ports(spec: dict) -> int:

@@ -289,3 +289,24 @@ def test_uri_fetch_into_sandbox(tmp_path):
     sb = events[0][2]["sandbox"]
     assert (events[1][1], events[1][2]["exit_code"]) == ("exited", 0)
     assert open(os.path.join(sb, "out.txt")).read() == "payload"
+
+
+def test_uri_fetch_failure_emits_fetch_failed(tmp_path):
+    events = []
+    ex = Executor(str(tmp_path / "root"),
+                  on_status=lambda *a: events.append(a))
+    ex.launch("t-bad", "true", uris=[{"value": str(tmp_path / "nope")}])
+    assert wait_until(lambda: len(events) == 1)
+    assert events[0][1] == "fetch_failed"
+    assert "nope" in events[0][2]["error"]
+
+
+def test_uri_extract_unsupported_archive_fails(tmp_path):
+    from cook_tpu.agent.executor import fetch_uri
+
+    blob = tmp_path / "notanarchive.xyz"
+    blob.write_bytes(b"\x00\x01\x02definitely not a tar")
+    sandbox = tmp_path / "sb2"
+    sandbox.mkdir()
+    with pytest.raises(OSError):
+        fetch_uri({"value": str(blob), "extract": True}, str(sandbox))
