@@ -254,3 +254,58 @@ def test_graphite_reporter_plaintext_protocol():
     # spaces in metric names are sanitized, 3 fields per line
     assert all(len(line.split(" ")) == 3 for line in lines)
     assert any("cycle_ms" in line for line in lines)
+
+
+def test_ha_failover_end_to_end(tmp_path):
+    """The master/slave flow (test_master_slave.py in the reference):
+    leader A persists jobs to the event log; on leadership loss the
+    standby B acquires the lease, rebuilds the store from the log, and
+    schedules the surviving queue."""
+    from cook_tpu.backends.base import ClusterRegistry
+    from cook_tpu.backends.mock import MockCluster, MockHost
+    from cook_tpu.scheduler.coordinator import Coordinator
+    from cook_tpu.scheduler.leader import FileLeaderElector
+    from cook_tpu.state.model import Job, JobState, new_uuid
+    from cook_tpu.state.store import JobStore
+
+    import threading
+
+    lock = str(tmp_path / "leader.lock")
+    log = str(tmp_path / "events.log")
+
+    # --- scheduler A wins leadership and accepts jobs ---
+    became_a = threading.Event()
+    el_a = FileLeaderElector(lock, "http://a", retry_interval_s=0.05,
+                             on_loss=lambda: None)
+    el_a.start(became_a.set)
+    assert became_a.wait(5) and el_a.is_leader()
+
+    store_a = JobStore(log_path=log)
+    jobs = [Job(uuid=new_uuid(), user="alice", command="true",
+                mem=10, cpus=1) for _ in range(5)]
+    store_a.create_jobs(jobs)
+    # one job even gets killed pre-failover; the log must carry that
+    store_a.kill_job(jobs[4].uuid)
+
+    # --- A dies (lease released); B takes over ---
+    became_b = threading.Event()
+    el_b = FileLeaderElector(lock, "http://b", retry_interval_s=0.05,
+                             on_loss=lambda: None)
+    el_b.start(became_b.set)
+    time.sleep(0.2)
+    assert not el_b.is_leader()          # A still holds the lease
+    el_a.stop()
+    assert became_b.wait(5) and el_b.is_leader()
+    assert el_b.current_leader() == "http://b"
+
+    # --- B rebuilds from the log and schedules the queue ---
+    store_b = JobStore.restore(log_path=log)
+    assert len(store_b.jobs) == 5
+    assert store_b.jobs[jobs[4].uuid].state == JobState.COMPLETED
+    cluster = MockCluster([MockHost("h0", mem=1000, cpus=16)])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord_b = Coordinator(store_b, reg)
+    stats = coord_b.match_cycle()
+    assert stats.matched == 4            # the 4 surviving jobs run
+    el_b.stop()
