@@ -51,6 +51,10 @@ class Pod:
     # FetchableURIs staged by the pod's init-container (the reference
     # renders these into the init-container spec, api.clj:661-882)
     init_uris: list = field(default_factory=list)
+    # job container config: {"type": "docker", "docker": {"image": ...,
+    # "parameters": [...]}, "volumes": [...]} — the docker translation
+    # of task.clj:338-405 / pod image selection api.clj:661-882
+    container: Optional[dict] = None
 
     @property
     def synthetic(self) -> bool:

@@ -117,7 +117,8 @@ class KubeCluster(ComputeCluster):
                       command=spec.command,
                       labels={"cook-job": spec.job_uuid},
                       volumes=cp.checkpoint_volumes(ckpt),
-                      init_uris=list(spec.uris))
+                      init_uris=list(spec.uris),
+                      container=spec.container)
             self.controller.set_expected(spec.task_id,
                                          ExpectedState.STARTING,
                                          launch_pod=pod)

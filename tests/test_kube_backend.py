@@ -282,3 +282,19 @@ def test_scan_reconciles_missed_events():
     cluster.controller.actual[tid] = kube.pods[tid]
     cluster.controller.scan()
     assert job.success
+
+
+def test_pod_carries_uris_and_container():
+    """LaunchSpec uris/container flow onto the pod spec (init-container
+    fetch + docker translation, api.clj:661-882, task.clj:338-405)."""
+    kube, cluster, store, coord = build()
+    job = Job(uuid=new_uuid(), user="alice", command="true", mem=10, cpus=1,
+              uris=[{"value": "http://repo/lib.tar.gz", "extract": True}],
+              container={"type": "docker",
+                         "docker": {"image": "python:3.12"}})
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert job.state == JobState.RUNNING
+    pod = kube.pods[job.instances[0].task_id]
+    assert pod.init_uris == job.uris
+    assert pod.container["docker"]["image"] == "python:3.12"
