@@ -170,3 +170,28 @@ def test_cli_round_trip(tmp_path):
         rows = list(csv.DictReader(f))
     assert len(rows) >= 20
     assert set(Simulator.RUN_TRACE_COLUMNS) == set(rows[0])
+
+
+def test_analysis_module(tmp_path):
+    """Run-trace CSV -> analysis stats + charts (the reference's
+    analysis.ipynb role)."""
+    import os
+
+    from cook_tpu.sim.analysis import analyze, charts, load_run_trace
+
+    trace = parse_trace(generate_trace(n_jobs=60, n_users=4, seed=7))
+    hosts = parse_hosts(generate_hosts(n_hosts=6))
+    sim = Simulator(trace, hosts, SimConfig(cycle_step_ms=1000))
+    sim.run()
+    out = tmp_path / "run.csv"
+    sim.write_run_trace(str(out))
+
+    rows = load_run_trace(str(out))
+    res = analyze(rows)
+    assert res["jobs"] > 0 and res["tasks"] >= res["jobs"]
+    assert res["wait"]["n"] == res["jobs"] or res["wait"]["n"] <= res["jobs"]
+    assert "mean_ms" in res["wait"]
+    written = charts({"run": res}, str(tmp_path / "charts"))
+    assert len(written) == 2
+    for f in written:
+        assert os.path.getsize(f) > 1000
