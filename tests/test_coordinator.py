@@ -494,3 +494,41 @@ def test_port_assignment():
     coord.match_cycle()
     assert j2.state == JobState.RUNNING
     assert len(j2.instances[0].ports) == 2
+
+
+def test_multi_compute_cluster_federation():
+    """One coordinator federates offers from several compute clusters
+    per cycle (scheduler.clj:977-985); launches and kills route to the
+    owning cluster."""
+    store = JobStore()
+    east = MockCluster([MockHost("e0", mem=100, cpus=8)], name="east")
+    west = MockCluster([MockHost("w0", mem=100, cpus=8),
+                        MockHost("w1", mem=100, cpus=8)], name="west")
+    reg = ClusterRegistry()
+    reg.register(east)
+    reg.register(west)
+    coord = Coordinator(store, reg)
+
+    jobs = [mkjob(mem=40, cpus=4) for _ in range(6)]
+    store.create_jobs(jobs)
+    stats = coord.match_cycle()
+    assert stats.matched == 6       # 2 per host across both clusters
+    by_backend = {}
+    for j in jobs:
+        inst = j.instances[0]
+        by_backend.setdefault(inst.backend, []).append(inst)
+    assert set(by_backend) == {"east", "west"}
+    assert len(by_backend["east"]) == 2 and len(by_backend["west"]) == 4
+
+    # kill routes to the owning cluster only
+    victim = by_backend["west"][0]
+    for tid in store.kill_job(victim.job_uuid):
+        coord._backend_kill(tid)
+    assert victim.task_id not in west.tasks
+    assert len(east.tasks) == 2
+
+    # completions flow back per cluster
+    east.advance(200)
+    west.advance(200)
+    done = [j for j in jobs if j.state == JobState.COMPLETED]
+    assert len(done) == 6
