@@ -42,6 +42,7 @@ from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
 from cook_tpu.backends.kube import checkpoint as cp
 from cook_tpu.state.model import (REASON_BY_CODE, InstanceStatus, Job,
                                   JobState, now_ms)
+from cook_tpu.parallel import federation
 from cook_tpu.state.pools import DruMode, PoolRegistry
 from cook_tpu.utils.metrics import registry as metrics_registry
 from cook_tpu.state.store import JobStore, TransactionError
@@ -427,14 +428,20 @@ class Coordinator:
                 1, int(num_considerable * self.config.scaleback))
         stats.head_matched = head_matched
 
-        # autoscaling hook (trigger-autoscaling! scheduler.clj:828-846)
-        queue_depth = len(pending) - launched
-        unmatched_sizes = [(pending[i].mem, pending[i].cpus)
-                           for i in range(len(pending))
-                           if not pending[i].instances][:64]
-        for cluster in self.clusters.all():
-            cluster.autoscale(pool, queue_depth,
-                              pending_sizes=unmatched_sizes)
+        # autoscaling hook (trigger-autoscaling! scheduler.clj:828-846):
+        # unmatched jobs are distributed across compute clusters by
+        # uuid-hash (distribute-jobs-to-compute-clusters,
+        # scheduler.clj:816-826) so N clusters don't each scale up for
+        # the whole queue
+        unmatched = [pending[i] for i in range(len(pending))
+                     if not pending[i].instances][:256]
+        clusters = self.clusters.all()
+        assign = federation.distribute_jobs(
+            [j.uuid for j in unmatched], max(len(clusters), 1))
+        for ci, cluster in enumerate(clusters):
+            mine = [(j.mem, j.cpus) for j, a in zip(unmatched, assign)
+                    if a == ci][:64]
+            cluster.autoscale(pool, len(mine), pending_sizes=mine)
 
         stats.cycle_ms = (time.perf_counter() - t0) * 1e3
         self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
