@@ -27,7 +27,7 @@ decide which slice's pool shard a job's pool belongs to.
 from __future__ import annotations
 
 import functools
-import hashlib
+import zlib
 from typing import NamedTuple
 
 import jax
@@ -58,10 +58,12 @@ def make_federation_mesh(n_slices: int,
 
 
 def distribute_jobs(uuids, n_slices: int) -> list[int]:
-    """Stable uuid-hash -> slice assignment
-    (distribute-jobs-to-compute-clusters scheduler.clj:816-826)."""
-    return [int(hashlib.md5(u.encode()).hexdigest(), 16) % n_slices
-            for u in uuids]
+    """Stable uuid-hash -> slice/cluster assignment
+    (distribute-jobs-to-compute-clusters scheduler.clj:816-826).
+    crc32: process-independent (a job keeps its assignment across
+    scheduler restarts, no flapping) and cheap enough to run over the
+    whole unmatched queue every match cycle."""
+    return [zlib.crc32(u.encode()) % n_slices for u in uuids]
 
 
 class FederationStats(NamedTuple):

@@ -432,16 +432,19 @@ class Coordinator:
         # unmatched jobs are distributed across compute clusters by
         # uuid-hash (distribute-jobs-to-compute-clusters,
         # scheduler.clj:816-826) so N clusters don't each scale up for
-        # the whole queue
-        unmatched = [pending[i] for i in range(len(pending))
-                     if not pending[i].instances][:256]
+        # the whole queue. Retrying jobs (failed instances, state back
+        # to WAITING) are unmatched demand too — filter on *active*
+        # instances. queue_depth reports each cluster's full share; only
+        # the sizes sample is capped.
+        unmatched = [j for j in pending if not j.active_instances]
         clusters = self.clusters.all()
         assign = federation.distribute_jobs(
             [j.uuid for j in unmatched], max(len(clusters), 1))
         for ci, cluster in enumerate(clusters):
-            mine = [(j.mem, j.cpus) for j, a in zip(unmatched, assign)
-                    if a == ci][:64]
-            cluster.autoscale(pool, len(mine), pending_sizes=mine)
+            mine = [j for j, a in zip(unmatched, assign) if a == ci]
+            cluster.autoscale(pool, len(mine),
+                              pending_sizes=[(j.mem, j.cpus)
+                                             for j in mine[:64]])
 
         stats.cycle_ms = (time.perf_counter() - t0) * 1e3
         self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
