@@ -89,6 +89,9 @@ class SchedulerConfig:
     use_pallas: bool = False
     estimated_completion: EstimatedCompletionConfig = field(
         default_factory=EstimatedCompletionConfig)
+    # uncommitted jobs older than this are purged by the watchdog
+    # (clear-uncommitted-jobs uses "-7 days", tools.clj:752)
+    uncommitted_gc_age_ms: int = 7 * 24 * 3600 * 1000
 
 
 @dataclass
@@ -708,7 +711,14 @@ class Coordinator:
                             reason_code=4001)
                         self._backend_kill(inst.task_id)
                         killed_straggler.append(inst.task_id)
-        return {"lingering": killed_lingering, "stragglers": killed_straggler}
+
+        # uncommitted-job GC (clear-uncommitted-jobs-on-schedule,
+        # tools.clj:757-774: nuke uncommitted jobs older than a few
+        # days so they don't clutter the pending scan)
+        gced = self.store.gc_uncommitted(self.config.uncommitted_gc_age_ms)
+        return {"lingering": killed_lingering,
+                "stragglers": killed_straggler,
+                "uncommitted_gced": gced}
 
     def _backend_kill(self, task_id: str) -> None:
         for cluster in self.clusters.all():

@@ -532,3 +532,18 @@ def test_multi_compute_cluster_federation():
     west.advance(200)
     done = [j for j in jobs if j.state == JobState.COMPLETED]
     assert len(done) == 6
+
+
+def test_watchdog_gcs_stale_uncommitted_jobs():
+    """Partial submissions (commit latch never committed) are purged by
+    the watchdog after the GC age (tools.clj:757-774)."""
+    store, cluster, coord = build()
+    stale = mkjob()
+    store.create_jobs([stale], committed=False)
+    stale.submit_time_ms -= coord.config.uncommitted_gc_age_ms + 1000
+    fresh = mkjob()
+    store.create_jobs([fresh], committed=False)
+    out = coord.watchdog_cycle()
+    assert out["uncommitted_gced"] == [stale.uuid]
+    assert stale.uuid not in store.jobs
+    assert fresh.uuid in store.jobs         # too young to purge
