@@ -401,6 +401,11 @@ class Coordinator:
                            prior_failure_reasons=_failure_reason_names(job),
                            ports=assigned_ports, uris=job.uris))
             launched += 1
+            if self.heartbeats is not None:
+                # deadline starts at launch (the reference creates the
+                # timeout channel with the task, heartbeat.clj:125);
+                # sync() would only catch a silent executor ~5 min later
+                self.heartbeats.track(inst.task_id)
             self.launch_rl.spend("global")
             if job.uuid in self.reservations:
                 self.reservations.pop(job.uuid, None)
