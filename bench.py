@@ -143,6 +143,57 @@ def bench_cycle(R=10_000, P=100_000, H=10_000, U=500, C=8_192,
     }))
 
 
+def bench_pools(n_pools=8, R=1_250, P=12_500, H=1_250, U=100, C=1_024):
+    """Multi-pool fair-share: pool-sharded cycles with psum aggregates
+    (BASELINE config 3). On one chip the mesh has a single device and
+    pools vmap; on a pod slice the same program shards pools over ICI.
+    Total problem size matches the headline (8 x 12.5k = 100k pending).
+    """
+    import jax
+    import jax.numpy as jnp
+    from cook_tpu.ops import match as match_ops
+    from cook_tpu.parallel import pools as pool_par
+
+    dev = jax.devices()[0]
+    parts = [_cycle_setup(R, P, H, U, seed=s)[0] for s in range(n_pools)]
+    args = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+    mesh = pool_par.make_pool_mesh(1)
+    runner = pool_par.pool_sharded_cycle(mesh, num_considerable=C,
+                                         sequential=False)
+
+    t0 = time.perf_counter()
+    out = runner(args)
+    matched = int(out.stats.total_matched)
+    compile_s = time.perf_counter() - t0
+
+    def batch(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            o = runner(args)
+        _ = int(o.stats.total_matched)
+        return time.perf_counter() - t0
+
+    ms = []
+    for _ in range(10):
+        t1, t2 = batch(5), batch(10)
+        ms.append(max(t2 - t1, 0.0) / 5 * 1e3)
+    mean_ms = float(np.mean(ms))
+    dps = matched / (mean_ms / 1e3)
+
+    print(json.dumps({
+        "metric": f"multi-pool decisions/sec, {n_pools} pools x "
+                  f"{P // 1000}k pending, psum aggregates",
+        "value": round(dps, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(dps / 1000.0, 2),
+        "mean_cycle_ms": round(mean_ms, 2),
+        "p99_cycle_ms": round(float(np.percentile(ms, 99)), 2),
+        "matched_per_cycle": matched,
+        "compile_s": round(compile_s, 1),
+        "device": str(dev),
+    }))
+
+
 def bench_rebalance(T0=50_000, P=64, H=2_000, U=500):
     """Preemption sweep over 50k running jobs (BASELINE config 4).
 
@@ -279,13 +330,15 @@ def main():
     elif which == "small":
         bench_cycle(R=1_000, P=10_000, H=1_000, U=100, C=2_048,
                     label="10k-pending x 1k-offers")
+    elif which == "pools":
+        bench_pools()
     elif which == "rebalance":
         bench_rebalance()
     elif which == "stream":
         bench_stream()
     else:
         raise SystemExit(f"unknown config {which!r}; "
-                         "one of: headline small rebalance stream")
+                         "one of: headline small pools rebalance stream")
 
 
 if __name__ == "__main__":

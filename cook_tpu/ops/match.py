@@ -294,58 +294,57 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         return (new_host, mem_left, cpus_left, gpus_left, slots_left,
                 group_occ)
 
-    def water_round(state, round_i):
+    def _usable_hosts(mem_left, cpus_left, slots_left):
+        # Non-gpu jobs never land on gpu hosts (constraints.clj:102-128),
+        # so gpu hosts are unusable for water-fill.
+        return (hosts.valid & (slots_left > 0) & (hosts.cap_gpus <= 0)
+                & (mem_left > 1e-6) & (cpus_left > 1e-6))
+
+    def window_round(state):
+        # Round 0 — mass placement. Hosts in bin-packing fill order:
+        # utilization descending, the same direction the
+        # cpuMemBinPacker argmax walks; cumulative-capacity windows
+        # absorb the whole queue in one pass.
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
         unassigned = plain & (job_host == NO_HOST)
-        # Non-gpu jobs never land on gpu hosts (constraints.clj:102-128),
-        # so gpu hosts are unusable here.
-        usable = (hosts.valid & (slots_left > 0) & (hosts.cap_gpus <= 0)
-                  & (mem_left > 1e-6) & (cpus_left > 1e-6))
+        usable = _usable_hosts(mem_left, cpus_left, slots_left)
+        util = _fitness(0.0, 0.0, mem_left, cpus_left,
+                        hosts.cap_mem, hosts.cap_cpus)
+        order = jnp.argsort(jnp.where(usable, -util, BIG))
+        o_usable = usable[order]
+        cum_mem = jnp.cumsum(jnp.where(o_usable, mem_left[order], 0.0))
+        cum_cpus = jnp.cumsum(jnp.where(o_usable, cpus_left[order], 0.0))
+        # Cumulative demand of the bidding jobs in queue order; each
+        # job bids on the host whose capacity window covers its
+        # prefix on BOTH resources.
+        cm = jnp.cumsum(jnp.where(unassigned, jobs.mem, 0.0))
+        cc = jnp.cumsum(jnp.where(unassigned, jobs.cpus, 0.0))
+        slot = jnp.maximum(jnp.searchsorted(cum_mem, cm, side="left"),
+                           jnp.searchsorted(cum_cpus, cc, side="left"))
+        choice = order[jnp.clip(slot, 0, H - 1)]
+        bids = unassigned & (slot < H) & o_usable[jnp.clip(slot, 0, H - 1)]
+        return accept_bids(state, choice, bids)
 
-        def window_bids(_):
-            # Round 0 — mass placement. Hosts in bin-packing fill order:
-            # utilization descending, the same direction the
-            # cpuMemBinPacker argmax walks; cumulative-capacity windows
-            # absorb the whole queue in one pass.
-            util = _fitness(0.0, 0.0, mem_left, cpus_left,
-                            hosts.cap_mem, hosts.cap_cpus)
-            order = jnp.argsort(jnp.where(usable, -util, BIG))
-            o_usable = usable[order]
-            cum_mem = jnp.cumsum(jnp.where(o_usable, mem_left[order], 0.0))
-            cum_cpus = jnp.cumsum(jnp.where(o_usable, cpus_left[order], 0.0))
-            # Cumulative demand of the bidding jobs in queue order; each
-            # job bids on the host whose capacity window covers its
-            # prefix on BOTH resources.
-            cm = jnp.cumsum(jnp.where(unassigned, jobs.mem, 0.0))
-            cc = jnp.cumsum(jnp.where(unassigned, jobs.cpus, 0.0))
-            slot = jnp.maximum(jnp.searchsorted(cum_mem, cm, side="left"),
-                               jnp.searchsorted(cum_cpus, cc, side="left"))
-            choice = order[jnp.clip(slot, 0, H - 1)]
-            bids = unassigned & (slot < H) \
-                & o_usable[jnp.clip(slot, 0, H - 1)]
-            return choice, bids
-
-        def pairing_bids(_):
-            # Later rounds — straggler placement. After round 0 the
-            # per-host remnants are often smaller than a single job, so
-            # cumulative windows keep splitting jobs across hosts that
-            # can't individually take them. Pair instead: k-th largest
-            # remaining job bids the k-th roomiest host, one job per
-            # host, alternating the pairing resource so a job big on the
-            # other axis doesn't hit the same misfit host forever.
-            jdemand = jnp.where(round_i % 2 == 1, jobs.mem, jobs.cpus)
-            hroom = jnp.where(round_i % 2 == 1, mem_left, cpus_left)
-            jrank_perm = jnp.argsort(jnp.where(unassigned, -jdemand, BIG))
-            jrank = jnp.zeros(N, jnp.int32).at[jrank_perm].set(
-                jnp.arange(N, dtype=jnp.int32))
-            hperm = jnp.argsort(jnp.where(usable, -hroom, BIG))
-            n_usable = jnp.sum(usable.astype(jnp.int32))
-            choice = hperm[jnp.clip(jrank, 0, H - 1)]
-            bids = unassigned & (jrank < n_usable)
-            return choice, bids
-
-        choice, bids = jax.lax.cond(round_i == 0, window_bids, pairing_bids,
-                                    None)
+    def pairing_round(state, round_i):
+        # Later rounds — straggler placement. After round 0 the
+        # per-host remnants are often smaller than a single job, so
+        # cumulative windows keep splitting jobs across hosts that
+        # can't individually take them. Pair instead: k-th largest
+        # remaining job bids the k-th roomiest host, one job per
+        # host, alternating the pairing resource so a job big on the
+        # other axis doesn't hit the same misfit host forever.
+        job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
+        unassigned = plain & (job_host == NO_HOST)
+        usable = _usable_hosts(mem_left, cpus_left, slots_left)
+        jdemand = jnp.where(round_i % 2 == 1, jobs.mem, jobs.cpus)
+        hroom = jnp.where(round_i % 2 == 1, mem_left, cpus_left)
+        jrank_perm = jnp.argsort(jnp.where(unassigned, -jdemand, BIG))
+        jrank = jnp.zeros(N, jnp.int32).at[jrank_perm].set(
+            jnp.arange(N, dtype=jnp.int32))
+        hperm = jnp.argsort(jnp.where(usable, -hroom, BIG))
+        n_usable = jnp.sum(usable.astype(jnp.int32))
+        choice = hperm[jnp.clip(jrank, 0, H - 1)]
+        bids = unassigned & (jrank < n_usable)
         return accept_bids(state, choice, bids), None
 
     def dense_round(state, _):
@@ -403,8 +402,10 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
              hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots,
              varying_full(hosts.valid, False, (num_groups, H), bool))
     if rounds > 0:
-        state, _ = jax.lax.scan(water_round, state,
-                                jnp.arange(rounds, dtype=jnp.int32))
+        state = window_round(state)
+    if rounds > 1:
+        state, _ = jax.lax.scan(pairing_round, state,
+                                jnp.arange(1, rounds, dtype=jnp.int32))
     if dense_rounds > 0:
         # Skip the N x H dense passes at runtime when nothing is left to
         # place. Any unassigned valid job keeps them on — plain
