@@ -144,8 +144,10 @@ class Executor:
             args=(handle, command, env, progress_regex,
                   progress_output_file, list(uris or [])),
             daemon=True)
-        t0.start()
+        # register before start(): the thread appends its worker threads
+        # to handle.threads, which this assignment would otherwise race
         handle.threads = [t0]
+        t0.start()
         return sandbox
 
     def _fetch_and_start(self, handle: TaskHandle, command, env,

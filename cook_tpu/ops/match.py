@@ -218,7 +218,7 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     # coordinator's bucket() padding guarantees this; arbitrary direct
     # callers fall back to XLA instead of silently truncating)
     use_pallas = (use_pallas and num_groups == 1 and N >= 8 and H >= 128
-                  and N % min(256, N) == 0 and H % 128 == 0
+                  and N % 8 == 0 and N % min(256, N) == 0 and H % 128 == 0
                   and H % min(1024, H) == 0)
     if use_pallas:
         from cook_tpu.ops import pallas_match

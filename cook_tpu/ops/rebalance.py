@@ -62,6 +62,13 @@ class TaskState(NamedTuple):
     valid: jnp.ndarray      # bool (False once preempted / empty slot)
     mem_share: jnp.ndarray  # f32 per-task user share divisors
     cpus_share: jnp.ndarray
+    # Optional (T, E) feasibility-only resource lanes. They never enter
+    # DRU scoring but a prefix is only feasible when its cumulative sum
+    # covers the job in EVERY lane — the reference's has-enough-resource
+    # requires freed mem AND cpus AND gpus (rebalancer.clj:394-399), so
+    # gpu-mode pools put gpus in the mem lane (DRU) and the real
+    # mem/cpus here.
+    extra: jnp.ndarray | None = None
 
 
 class PendingJobs(NamedTuple):
@@ -75,6 +82,7 @@ class PendingJobs(NamedTuple):
     valid: jnp.ndarray
     mem_share: jnp.ndarray
     cpus_share: jnp.ndarray
+    extra: jnp.ndarray | None = None   # (P, E), pairs TaskState.extra
 
 
 class RebalanceResult(NamedTuple):
@@ -102,7 +110,8 @@ def rebalance(tasks: TaskState,
               user_quota_count: jnp.ndarray,
               safe_dru_threshold: jnp.ndarray | float,
               min_dru_diff: jnp.ndarray | float,
-              candidate_cap: int | None = None) -> RebalanceResult:
+              candidate_cap: int | None = None,
+              spare_extra: jnp.ndarray | None = None) -> RebalanceResult:
     """Run one rebalancer cycle.
 
     host_forbidden: (P, H) bool — hosts each pending job may NOT use
@@ -122,6 +131,13 @@ def rebalance(tasks: TaskState,
     T = tasks.user.shape[0]
     H = spare_mem.shape[0]
     P = pending.user.shape[0]
+    extra_given = [tasks.extra is not None, pending.extra is not None,
+                   spare_extra is not None]
+    if any(extra_given) and not all(extra_given):
+        raise ValueError(
+            "extra feasibility lanes must be given on all of tasks, "
+            f"pending, and spare_extra, or none (got tasks={extra_given[0]}, "
+            f"pending={extra_given[1]}, spare={extra_given[2]})")
     safe_dru_threshold = jnp.float32(safe_dru_threshold)
     min_dru_diff = jnp.float32(min_dru_diff)
     U = user_quota_mem.shape[0]
@@ -133,6 +149,14 @@ def rebalance(tasks: TaskState,
     t_user = tasks.user.at[fill].set(pending.user)
     t_mem = tasks.mem.at[fill].set(pending.mem)
     t_cpus = tasks.cpus.at[fill].set(pending.cpus)
+    # feasibility-only lanes, zero-width when absent so one code path
+    # serves both modes
+    t_extra = (jnp.zeros((T, 0), jnp.float32) if tasks.extra is None
+               else tasks.extra.at[fill].set(pending.extra))
+    p_extra = (jnp.zeros((P, 0), jnp.float32) if pending.extra is None
+               else pending.extra)
+    sp_extra0 = (jnp.zeros((H, 0), jnp.float32) if spare_extra is None
+                 else spare_extra)
     t_prio = tasks.priority.at[fill].set(pending.priority)
     t_start = tasks.start_time.at[fill].set(pending.start_time)
     t_mshare = tasks.mem_share.at[fill].set(pending.mem_share)
@@ -153,6 +177,7 @@ def rebalance(tasks: TaskState,
     s_start = t_start[perm0]
     s_mshare = t_mshare[perm0]
     s_cshare = t_cshare[perm0]
+    s_extra = t_extra[perm0]
     s_ids = ids[perm0]                  # original slot id of each row
     # static per-user segment starts for the per-step masked cumsum
     sidx = jnp.arange(T, dtype=jnp.int32)
@@ -169,9 +194,9 @@ def rebalance(tasks: TaskState,
                                    num_segments=U + 1)[:U]
 
     def step(carry, xs):
-        (s_valid, s_host, preempted, sp_mem, sp_cpus) = carry
+        (s_valid, s_host, preempted, sp_mem, sp_cpus, sp_extra) = carry
         (j_user, j_mem, j_cpus, j_prio, j_start, j_valid,
-         j_mshare, j_cshare, j_forbidden, j_fill_pos) = xs
+         j_mshare, j_cshare, j_forbidden, j_fill_pos, j_extra) = xs
 
         # -- DRUs: masked per-user cumsum over the static frame --------
         vals = jnp.stack([jnp.where(s_valid, s_mem, 0.0),
@@ -221,6 +246,7 @@ def rebalance(tasks: TaskState,
             c_user = s_user[topi]
             c_mem = jnp.where(k_keep, s_mem[topi], 0.0)
             c_cpus = jnp.where(k_keep, s_cpus[topi], 0.0)
+            c_extra = jnp.where(k_keep[:, None], s_extra[topi], 0.0)
         else:
             topi = None
             c_host = jnp.where(cand, s_host, H)
@@ -228,19 +254,22 @@ def rebalance(tasks: TaskState,
             c_user = s_user
             c_mem = jnp.where(cand, s_mem, 0.0)
             c_cpus = jnp.where(cand, s_cpus, 0.0)
+            c_extra = jnp.where(cand[:, None], s_extra, 0.0)
         K = c_host.shape[0]
         seq_host = jnp.concatenate([jnp.arange(H, dtype=jnp.int32), c_host])
         seq_dru = jnp.concatenate([jnp.full(H, INF), c_dru])
         seq_user = jnp.concatenate([jnp.full(H, -1, jnp.int32), c_user])
-        seq_mem = jnp.concatenate([sp_mem, c_mem])
-        seq_cpus = jnp.concatenate([sp_cpus, c_cpus])
+        seq_res = jnp.concatenate([
+            jnp.concatenate([sp_mem[:, None], sp_cpus[:, None], sp_extra],
+                            -1),
+            jnp.concatenate([c_mem[:, None], c_cpus[:, None], c_extra], -1),
+        ], 0)
+        j_req = jnp.concatenate([j_mem[None], j_cpus[None], j_extra])
         n_seq = H + K
         perm = jnp.lexsort((jnp.arange(n_seq), seq_user, -seq_dru, seq_host))
         p_host = seq_host[perm]
-        cums = segment_cumsum(
-            jnp.stack([seq_mem[perm], seq_cpus[perm]], -1), p_host)
-        feas = ((cums[:, 0] >= j_mem) & (cums[:, 1] >= j_cpus)
-                & (p_host < H))
+        cums = segment_cumsum(seq_res[perm], p_host)
+        feas = jnp.all(cums >= j_req[None, :], axis=1) & (p_host < H)
         feas &= ~j_forbidden[jnp.clip(p_host, 0, H - 1)]
         # first feasible position per host == the prefix with max min-dru
         pos = jnp.arange(n_seq)
@@ -276,6 +305,8 @@ def rebalance(tasks: TaskState,
             + jnp.where(placed, sp_mem[bh], 0.0)
         freed_cpus = jnp.sum(jnp.where(victim, s_cpus, 0.0)) \
             + jnp.where(placed, sp_cpus[bh], 0.0)
+        freed_extra = jnp.sum(jnp.where(victim[:, None], s_extra, 0.0), 0) \
+            + jnp.where(placed, sp_extra[bh], 0.0)
 
         # -- state update (next-state, rebalancer.clj:269-308) ---------
         s_valid = s_valid & ~victim
@@ -284,6 +315,9 @@ def rebalance(tasks: TaskState,
                            sp_mem.at[bh].set(freed_mem - j_mem), sp_mem)
         sp_cpus = jnp.where(placed,
                             sp_cpus.at[bh].set(freed_cpus - j_cpus), sp_cpus)
+        sp_extra = jnp.where(placed,
+                             sp_extra.at[bh].set(freed_extra - j_extra),
+                             sp_extra)
 
         # flip the job's fill slot live (values were preset before the
         # scan; only validity and host assignment are dynamic)
@@ -292,14 +326,14 @@ def rebalance(tasks: TaskState,
         s_host = s_host.at[j_fill_pos].set(
             jnp.where(placed, best_host, s_host[j_fill_pos]))
 
-        return (s_valid, s_host, preempted, sp_mem, sp_cpus), \
+        return (s_valid, s_host, preempted, sp_mem, sp_cpus, sp_extra), \
             (placed, best_host)
 
     carry = (t_valid0[perm0], t_host0[perm0], jnp.zeros(T, bool),
-             spare_mem, spare_cpus)
+             spare_mem, spare_cpus, sp_extra0)
     xs = (pending.user, pending.mem, pending.cpus, pending.priority,
           pending.start_time, pending.valid, pending.mem_share,
-          pending.cpus_share, host_forbidden, fill_pos)
+          pending.cpus_share, host_forbidden, fill_pos, p_extra)
     carry, (placed, hostv) = jax.lax.scan(step, carry, xs)
     # map the preempted mask back from the sorted frame
     preempted = jnp.zeros(T, bool).at[perm0].set(carry[2])

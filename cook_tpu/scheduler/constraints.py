@@ -22,11 +22,23 @@ Implemented constraint kinds:
 from __future__ import annotations
 
 import fnmatch
+import logging
 from typing import Optional
 
 import numpy as np
 
 from cook_tpu.state.model import Job
+
+logger = logging.getLogger(__name__)
+_warned_bad_start_times: set = set()
+
+
+def _warn_bad_start_time(value) -> None:
+    key = repr(value)
+    if key not in _warned_bad_start_times:
+        logger.warning("unparseable host-start-time attribute %r; "
+                       "treating host as unconstrained", value)
+        _warned_bad_start_times.add(key)
 
 
 def _matches(op: str, pattern: str, value: Optional[str]) -> bool:
@@ -154,8 +166,15 @@ def estimated_completion_forbidden(jobs: list[Job],
     for h, attrs in enumerate(host_attrs):
         start = attrs.get("host-start-time")
         if start is not None:
+            try:
+                start_s = float(start)
+            except (TypeError, ValueError):
+                # a malformed attribute must not break every match and
+                # rebalance cycle: treat the host as unconstrained
+                _warn_bad_start_time(start)
+                continue
             any_start = True
-            death_ms[h] = float(start) * 1000.0 \
+            death_ms[h] = start_s * 1000.0 \
                 + host_lifetime_mins * 60_000.0
     if not any_start:
         return None

@@ -593,9 +593,11 @@ class Coordinator:
         if gpu_pool:
             # gpu-mode pools score preemption by cumulative gpus alone
             # (compute-pending-gpu-job-dru rebalancer.clj:160-182): feed
-            # the kernel gpus in the mem lane with a zeroed cpu lane —
-            # DRU, feasibility prefix sums, and freed-capacity checks all
-            # become gpu-denominated with no kernel change.
+            # the kernel gpus in the mem lane with a zeroed cpu lane so
+            # DRU becomes gpu-denominated — but keep the real mem/cpus
+            # as feasibility-only extra lanes, because has-enough-resource
+            # (rebalancer.clj:394-399) requires the freed mem AND cpus AND
+            # gpus to cover the job before any victim is killed.
             zero_t = np.zeros_like(tb.cpus)
             zero_j = np.zeros_like(jb.cpus)
             spare_gpus = np.zeros(Hn, np.float32)
@@ -605,13 +607,16 @@ class Coordinator:
                 user=tb.user, mem=tb.gpus, cpus=zero_t,
                 priority=tb.priority, start_time=tb.start_time,
                 host=tb.host, valid=tb.valid,
-                mem_share=tb.gpu_share, cpus_share=tb.cpus_share)
+                mem_share=tb.gpu_share, cpus_share=tb.cpus_share,
+                extra=np.stack([tb.mem, tb.cpus], -1))
             pend = rb_ops.PendingJobs(
                 user=jb.user, mem=jb.gpus, cpus=zero_j,
                 priority=jb.priority, start_time=jb.start_time,
                 valid=jb.valid, mem_share=jb.gpu_share,
-                cpus_share=jb.cpus_share)
+                cpus_share=jb.cpus_share,
+                extra=np.stack([jb.mem, jb.cpus], -1))
             spare_a, spare_b = spare_gpus, np.zeros(Hn, np.float32)
+            spare_x = np.stack([spare_mem, spare_cpus], -1)
         else:
             tasks = rb_ops.TaskState(
                 user=tb.user, mem=tb.mem, cpus=tb.cpus,
@@ -624,10 +629,12 @@ class Coordinator:
                 valid=jb.valid, mem_share=jb.mem_share,
                 cpus_share=jb.cpus_share)
             spare_a, spare_b = spare_mem, spare_cpus
+            spare_x = None
         res = rb_ops.rebalance(
             tasks, pend, spare_a, spare_b, host_forb,
             qm, qc, qn.astype(np.int32) if qn.dtype != np.int32 else qn,
-            params.safe_dru_threshold, params.min_dru_diff)
+            params.safe_dru_threshold, params.min_dru_diff,
+            spare_extra=spare_x)
 
         preempted_rows = np.flatnonzero(np.asarray(res.preempted)[:tb.n])
         placed = np.asarray(res.job_placed)

@@ -46,9 +46,12 @@ def analyze(rows: list[dict]) -> dict:
     first_start_of_job: dict[str, float] = {}
     end_of_job: dict[str, float] = {}
     submit_of_job: dict[str, float] = {}
+    user_of_job: dict[str, str] = {}
+    run_of_job: dict[str, float] = defaultdict(float)
 
     for row in rows:
         jid = row["job_id"]
+        user_of_job[jid] = row.get("user", "")
         submit = _f(row, "submit_time_ms")
         start = _f(row, "start_time_ms")
         end = _f(row, "end_time_ms")
@@ -65,6 +68,7 @@ def analyze(rows: list[dict]) -> dict:
             end_of_job[jid] = max(end_of_job.get(jid, 0.0), end)
         if start is not None and end is not None:
             runtimes.append(end - start)
+            run_of_job[jid] += end - start
 
     for jid, submit in submit_of_job.items():
         start = first_start_of_job.get(jid)
@@ -72,13 +76,13 @@ def analyze(rows: list[dict]) -> dict:
             continue
         wait = start - submit
         waits.append(wait)
-        per_user[next(r["user"] for r in rows
-                      if r["job_id"] == jid)].append(wait)
+        per_user[user_of_job.get(jid, "")].append(wait)
         end = end_of_job.get(jid)
         if end is not None:
             turnarounds.append(end - submit)
-            # overhead = turnaround - pure runtime of the final attempt
-            overheads.append(wait)
+            # overhead = turnaround minus time actually spent running
+            # across all attempts (reporting.clj's overhead cut)
+            overheads.append((end - submit) - run_of_job[jid])
 
     def stats(xs):
         if not xs:
@@ -96,6 +100,7 @@ def analyze(rows: list[dict]) -> dict:
         "preemptions": preemptions,
         "wait": stats(waits),
         "turnaround": stats(turnarounds),
+        "overhead": stats(overheads),
         "runtime": stats(runtimes),
         "per_user_mean_wait_ms": {
             u: float(np.mean(w)) for u, w in sorted(per_user.items())},

@@ -461,6 +461,45 @@ def test_gpu_pool_rebalancer_preempts_by_gpu_dru():
     assert poor.state == JobState.RUNNING
 
 
+def test_gpu_pool_rebalancer_requires_mem_cpu_feasibility():
+    """gpu-mode preemption still requires the freed mem AND cpus to cover
+    the pending job (has-enough-resource rebalancer.clj:394-399): killing
+    gpu tasks whose freed mem can't host the job is a wasted preemption
+    the match cycle would refuse, repeating every cycle."""
+    from cook_tpu.state.pools import DruMode, Pool, PoolRegistry
+
+    pools = PoolRegistry()
+    pools.add(Pool(name="gpu", dru_mode=DruMode.GPU))
+    store = JobStore()
+    cluster = MockCluster([
+        MockHost("g0", mem=100, cpus=16, gpus=8, pool="gpu"),
+    ])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(
+        store, reg, pools=pools,
+        config=SchedulerConfig(
+            rebalancer=RebalancerParams(safe_dru_threshold=0.0,
+                                        min_dru_diff=0.05,
+                                        max_preemption=4)))
+    coord.shares.set("default", "gpu", gpus=8.0, mem=1e6, cpus=1e6)
+
+    greedy = [mkjob(user="greedy", mem=10, cpus=1, gpus=2.0, pool="gpu")
+              for _ in range(4)]
+    store.create_jobs(greedy)
+    coord.match_cycle(pool="gpu")
+    assert all(j.state == JobState.RUNNING for j in greedy)
+
+    # gpus are preemptible (2 needed, each victim frees 2) but even
+    # killing all four victims frees only 40 mem + 60 spare < 500
+    poor = mkjob(user="poor", mem=500, cpus=1, gpus=2.0, pool="gpu")
+    store.create_jobs([poor])
+    assert coord.match_cycle(pool="gpu").matched == 0
+    res = coord.rebalance_cycle(pool="gpu")
+    assert res["preempted"] == 0
+    assert all(j.state == JobState.RUNNING for j in greedy)
+
+
 def test_port_assignment():
     """Jobs requesting ports get distinct host ports, PORT0..N-1 env,
     and exhaustion defers matching (the mesos ranges resource,
