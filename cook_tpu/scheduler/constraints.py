@@ -38,6 +38,8 @@ def _warn_bad_start_time(value) -> None:
     if key not in _warned_bad_start_times:
         logger.warning("unparseable host-start-time attribute %r; "
                        "treating host as unconstrained", value)
+        if len(_warned_bad_start_times) >= 1000:   # bound the dedupe set
+            _warned_bad_start_times.clear()
         _warned_bad_start_times.add(key)
 
 

@@ -32,6 +32,7 @@ log = logging.getLogger(__name__)
 
 from cook_tpu.backends.base import ClusterRegistry, LaunchSpec, Offer
 from cook_tpu.ops import cycle as cycle_ops
+from cook_tpu.ops import dru as dru_ops
 from cook_tpu.ops import match as match_ops
 from cook_tpu.ops import rebalance as rb_ops
 from cook_tpu.scheduler import constraints as constraints_mod
@@ -538,6 +539,38 @@ class Coordinator:
     def _host_attrs_of(self, hostname: str) -> dict[str, str]:
         return self._all_host_attributes().get(hostname, {})
 
+    def _dru_pending_head(self, pending: list[Job], tb, pool: str,
+                          P: int) -> list[Job]:
+        """First P pending jobs in the fair queue's DRU order (the rank
+        cycle output the reference rebalancer consumes,
+        scheduler.clj:1335 -> rebalancer.clj:428-447). Mirrors the
+        rank-union step of cycle_ops.rank_and_match before its
+        considerable filter. tb: the already-tensorized running tasks
+        (trailing invalid slots are harmless)."""
+        gpu_pool = self.pools.get(pool).dru_mode == DruMode.GPU
+        jb = tensorize_jobs(pending, self.shares, pool, self.interner,
+                            groups=self.store.groups,
+                            mem_fn=self._effective_mem)
+        R = tb.user.shape[0]
+        user = np.concatenate([tb.user, jb.user])
+        prio = np.concatenate([tb.priority, jb.priority])
+        start = np.concatenate([tb.start_time, jb.start_time])
+        valid = np.concatenate([tb.valid, jb.valid])
+        if gpu_pool:
+            ranked = dru_ops.gpu_dru_rank(
+                user, np.concatenate([tb.gpus, jb.gpus]), prio, start,
+                valid, np.concatenate([tb.gpu_share, jb.gpu_share]))
+        else:
+            ranked = dru_ops.dru_rank(
+                user, np.concatenate([tb.mem, jb.mem]),
+                np.concatenate([tb.cpus, jb.cpus]), prio, start, valid,
+                np.concatenate([tb.mem_share, jb.mem_share]),
+                np.concatenate([tb.cpus_share, jb.cpus_share]))
+        rank = np.asarray(ranked.rank)[R:]
+        rank = np.where(jb.valid, rank, np.iinfo(np.int32).max)
+        order = np.argsort(rank, kind="stable")
+        return [pending[i] for i in order if i < len(pending)][:P]
+
     # ------------------------------------------------------------------
     # rebalancer cycle (rebalancer.clj:428-518)
     def rebalance_cycle(self, pool: Optional[str] = None) -> dict:
@@ -566,12 +599,14 @@ class Coordinator:
             spare_cpus[host_ids[o.hostname]] += o.cpus
 
         P = min(params.max_preemption, len(pending))
-        # take the fair-queue head: sort pending by (priority desc, submit)
-        pending_sorted = sorted(
-            pending, key=lambda j: (-j.priority, j.submit_time_ms))[:P]
         Pb = bucket(P)
         tb = tensorize_tasks(run_insts, self.shares, pool,
                              self.interner, host_ids, extra_slots=Pb)
+        # take the fair-queue head in DRU order: the reference rebalancer
+        # walks the rank cycle's DRU-ranked pending queue
+        # (rebalancer.clj:428-447), not raw (-priority, submit) — when
+        # the two disagree, preemption must serve the DRU-poorest user.
+        pending_sorted = self._dru_pending_head(pending, tb, pool, P)
         jb = tensorize_jobs(pending_sorted, self.shares, pool, self.interner,
                             groups=self.store.groups, pad_to=Pb,
                             mem_fn=self._effective_mem)

@@ -67,10 +67,10 @@ class JobStore:
 
     def _barrier(self) -> None:
         """Durability barrier, called once at the end of every public
-        transaction: with the native group-commit writer, block until
-        everything appended so far is fdatasync'd (the transactor ack
-        the reference relies on before HTTP 201-ing a submission).  The
-        Python fallback writer is line-buffered and has no sync()."""
+        transaction: block until everything appended so far is
+        fdatasync'd (the transactor ack the reference relies on before
+        HTTP 201-ing a submission). The native writer group-commits;
+        the Python fallback fsyncs per transaction."""
         if self._log is not None and hasattr(self._log, "sync") \
                 and not getattr(self, "_replaying", False):
             self._log.sync()
@@ -352,6 +352,7 @@ class JobStore:
             for u, gd in data["groups"].items():
                 store.groups[u] = Group(**gd)
         if log_path and os.path.exists(log_path):
+            _trim_torn_tail(log_path)
             store._replay(log_path, offset)
         if log_path:
             store._log_path = log_path
@@ -367,6 +368,8 @@ class JobStore:
                 for lineno, line in enumerate(f):
                     if lineno < offset or not line.strip():
                         continue
+                    # torn tails are truncated before replay; any decode
+                    # error here is real corruption and must surface
                     ev = json.loads(line)
                     self._apply_event(ev)
         finally:
@@ -436,9 +439,39 @@ def _job_from_dict(d: dict) -> Job:
     return job
 
 
+def _trim_torn_tail(path: str) -> None:
+    """Truncate a torn final line (crash mid-append). The torn event was
+    never acked — the durability barrier runs before any ack — so
+    dropping it is safe; leaving it would glue the next append onto it
+    and corrupt the log for every future recovery."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size == 0:
+        return
+    with open(path, "rb+") as f:
+        f.seek(size - 1)
+        if f.read(1) == b"\n":
+            return
+        pos, block = size, 65536
+        while pos > 0:
+            step = min(block, pos)
+            f.seek(pos - step)
+            buf = f.read(step)
+            nl = buf.rfind(b"\n")
+            if nl != -1:
+                f.truncate(pos - step + nl + 1)
+                return
+            pos -= step
+        f.truncate(0)
+
+
 def _make_log_writer(path: str):
     """Prefer the native C++ group-commit writer (native/eventlog.cpp);
     fall back to the pure-Python writer if the toolchain is missing."""
+    if os.path.exists(path):
+        _trim_torn_tail(path)
     try:
         from cook_tpu.native.eventlog import NativeLogWriter
         return NativeLogWriter(path)
@@ -448,10 +481,17 @@ def _make_log_writer(path: str):
 
 class _PyLogWriter:
     """Fallback pure-Python append-only log (the C++ writer in
-    cook_tpu/native is preferred; see native/eventlog.cpp)."""
+    cook_tpu/native is preferred; see native/eventlog.cpp).
+
+    sync() gives the same durability guarantee as the native writer's
+    group commit: the commit latch exists so a submission is only acked
+    after its events are on disk (rest/api.clj:659 semantics), so the
+    fallback must fsync too — it just pays one fsync per transaction
+    instead of amortizing across concurrent committers."""
 
     def __init__(self, path: str):
         self._n = 0
+        self._dirty = False
         if os.path.exists(path):
             with open(path) as f:
                 self._n = sum(1 for _ in f)
@@ -462,6 +502,15 @@ class _PyLogWriter:
         with self._lock:
             self._f.write(line + "\n")
             self._n += 1
+            self._dirty = True
+
+    def sync(self) -> None:
+        with self._lock:
+            if not self._dirty:
+                return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._dirty = False
 
     def lines(self) -> int:
         with self._lock:

@@ -461,6 +461,46 @@ def test_gpu_pool_rebalancer_preempts_by_gpu_dru():
     assert poor.state == JobState.RUNNING
 
 
+def test_rebalancer_serves_dru_queue_not_priority():
+    """The rebalancer must walk the DRU-ranked pending queue
+    (rebalancer.clj:428-447 consumes the rank cycle's output): when
+    priority order and DRU order disagree, the single preemption slot
+    goes to the DRU-poorest user's job, not the highest-priority one."""
+    store, cluster, coord = build(
+        hosts=[MockHost("h0", mem=100, cpus=10)],
+        config=SchedulerConfig(
+            rebalancer=RebalancerParams(safe_dru_threshold=0.0,
+                                        min_dru_diff=0.1,
+                                        max_preemption=1)))
+    coord.shares.set("default", "default", mem=100.0, cpus=10.0)
+
+    # greedy fills 80% of the host; rich holds the rest at high priority
+    greedy = [mkjob(user="greedy", mem=40, cpus=4) for _ in range(2)]
+    rich_run = mkjob(user="rich", mem=20, cpus=2, priority=95)
+    store.create_jobs(greedy + [rich_run])
+    coord.match_cycle()
+    assert all(j.state == JobState.RUNNING for j in greedy + [rich_run])
+
+    # rich's pending outranks poor's on priority, but poor (zero usage)
+    # is DRU-poorest: rich pending dru = 0.2 + 0.3, poor = 0.3
+    rich_pend = mkjob(user="rich", mem=30, cpus=3, priority=90)
+    poor_pend = mkjob(user="poor", mem=30, cpus=3, priority=10)
+    store.create_jobs([rich_pend, poor_pend])
+    assert coord.match_cycle().matched == 0
+
+    res = coord.rebalance_cycle()
+    assert res["preempted"] == 1
+    assert [u for u, _ in res["decisions"]] == [poor_pend.uuid]
+    # the victim is greedy's highest-DRU task, not rich's
+    preempted_users = {store.jobs[i.job_uuid].user
+                       for j in greedy + [rich_run]
+                       for i in j.instances if i.preempted}
+    assert preempted_users == {"greedy"}
+    coord.match_cycle()
+    assert poor_pend.state == JobState.RUNNING
+    assert rich_pend.state == JobState.WAITING
+
+
 def test_gpu_pool_rebalancer_requires_mem_cpu_feasibility():
     """gpu-mode preemption still requires the freed mem AND cpus to cover
     the pending job (has-enough-resource rebalancer.clj:394-399): killing
