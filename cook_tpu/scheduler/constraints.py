@@ -127,6 +127,69 @@ def build_forbidden(jobs: list[Job], host_names: list[str],
     return forb
 
 
+def explain_forbidden(job: Job, host_names: list[str],
+                      host_attrs: list[dict[str, str]],
+                      reservations: Optional[dict[str, str]] = None,
+                      group_cotask_attr=None, group_cotask_hosts=None,
+                      ) -> dict[str, np.ndarray]:
+    """Named per-constraint host masks for ONE job: which constraint
+    forbade which hosts. The placement-failure explainer's data source
+    (summarize-placement-failure fenzo_utils.clj:45-86) — mirrors
+    build_forbidden's per-job body, but keeps each contribution separate
+    so /unscheduled_jobs can report failed-constraint names with counts.
+    Only called for unplaced jobs, so the per-job cost is fine."""
+    H = len(host_names)
+    reservations = reservations or {}
+    group_cotask_attr = group_cotask_attr or {}
+    group_cotask_hosts = group_cotask_hosts or {}
+    host_idx = {h: i for i, h in enumerate(host_names)}
+    out: dict[str, np.ndarray] = {}
+
+    novel = np.zeros(H, bool)
+    for inst in job.instances:
+        hi = host_idx.get(inst.hostname)
+        if hi is not None:
+            novel[hi] = True
+    if novel.any():
+        out["novel-host"] = novel
+
+    for (attr, op, pattern) in job.constraints:
+        vals = np.array([a.get(attr) for a in host_attrs], dtype=object)
+        if op == "EQUALS":
+            mask = vals != pattern
+        else:
+            mask = ~np.array([_matches(op, pattern, v) for v in vals], bool)
+        if mask.any():
+            key = f"user-constraint/{attr}"
+            out[key] = out[key] | mask if key in out else mask
+
+    reserved = np.zeros(H, bool)
+    for owner_uuid, hostname in reservations.items():
+        hi = host_idx.get(hostname)
+        if hi is not None and owner_uuid != job.uuid:
+            reserved[hi] = True
+    if reserved.any():
+        out["rebalancer-reservation"] = reserved
+
+    if job.group and job.group in group_cotask_attr:
+        mask = np.zeros(H, bool)
+        for attr, required in group_cotask_attr[job.group].items():
+            vals = np.array([a.get(attr) for a in host_attrs], dtype=object)
+            mask |= vals != required
+        if mask.any():
+            out["group-attribute-equals"] = mask
+
+    if job.group and job.group in group_cotask_hosts:
+        mask = np.zeros(H, bool)
+        for hostname in group_cotask_hosts[job.group]:
+            hi = host_idx.get(hostname)
+            if hi is not None:
+                mask[hi] = True
+        if mask.any():
+            out["group-unique-host"] = mask
+    return out
+
+
 def group_attr_requirements(group, running_cotask_hosts: list[dict[str, str]]
                             ) -> dict[str, str]:
     """For an attribute-equals group, derive the pinned attribute value

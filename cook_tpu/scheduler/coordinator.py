@@ -305,18 +305,21 @@ class Coordinator:
             cap_gpus=_pad([o.cap_gpus or o.gpus for o in offers], H),
             valid=np.arange(H) < len(offers),
         )
-        forb_small = self._build_forbidden(
+        group_pins = self._group_attr_pins(pending)
+        group_uhosts = self._group_unique_hosts(pending, host_names,
+                                                host_attrs)
+        forb_constraints = self._build_forbidden(
             pending, host_names, host_attrs, self.reservations,
-            self._group_attr_pins(pending),
-            self._group_unique_hosts(pending, host_names, host_attrs))
+            group_pins, group_uhosts)
         # ports feasibility (the mesos ranges resource, task.clj:254-280):
         # jobs requesting ports can't land on hosts without enough free
         port_counts = np.array(
             [sum(hi - lo + 1 for lo, hi in o.ports) for o in offers])
         want_ports = np.array([j.ports for j in pending])
+        forb_small = forb_constraints
         if want_ports.any():
-            forb_small = forb_small | (want_ports[:, None]
-                                       > port_counts[None, :])
+            forb_small = forb_constraints | (want_ports[:, None]
+                                             > port_counts[None, :])
         forbidden = np.zeros((jb.user.shape[0], H), bool)
         forbidden[:len(pending), :len(offers)] = forb_small
         forbidden[:, len(offers):] = True
@@ -415,13 +418,13 @@ class Coordinator:
         stats.matched = launched
 
         # placement-failure bookkeeping for /unscheduled_jobs
-        # (fenzo_utils.clj:74; record-placement-failures!)
-        for idx, job in enumerate(pending):
-            if considerable[idx] and job_host[idx] < 0:
-                job.last_placement_failure = {
-                    "reasons": ["no-host-with-sufficient-resources"],
-                    "at_ms": now_ms(),
-                }
+        # (record-placement-failures! fenzo_utils.clj:74): structured
+        # per-resource / per-constraint summaries from the kernel's
+        # masks and post-match remaining capacity, not a constant string
+        self._record_placement_failures(
+            pending, considerable, job_host, offers, host_names,
+            host_attrs, res, forb_constraints, port_counts,
+            group_pins, group_uhosts)
 
         # head-of-queue scaleback (scheduler.clj:1002-1036): if the head
         # considerable job failed to place, shrink next cycle's batch.
@@ -538,6 +541,83 @@ class Coordinator:
 
     def _host_attrs_of(self, hostname: str) -> dict[str, str]:
         return self._all_host_attributes().get(hostname, {})
+
+    def _record_placement_failures(self, pending, considerable, job_host,
+                                   offers, host_names, host_attrs, res,
+                                   forb_constraints, port_counts,
+                                   group_pins, group_uhosts) -> None:
+        """Persist per-resource insufficiency counts and failed-constraint
+        names for every considerable-but-unmatched job
+        (summarize-placement-failure fenzo_utils.clj:45-86;
+        :job/last-fenzo-placement-failure). forb_constraints is the
+        cycle's constraint-only mask (no ports merge) so port shortages
+        are reported as a resource like mem/cpus, against the post-match
+        remaining capacity the job actually failed against."""
+        unplaced = [i for i in range(len(pending))
+                    if considerable[i] and job_host[i] < 0]
+        if not unplaced:
+            return
+        n = len(offers)
+        mem_left = np.asarray(res.mem_left)[:n]
+        cpus_left = np.asarray(res.cpus_left)[:n]
+        gpus_left = np.asarray(res.gpus_left)[:n]
+        ports_avail = np.asarray(port_counts[:n], np.float64)
+        t_ms = now_ms()
+        for idx in unplaced:
+            job = pending[idx]
+            allowed = ~forb_constraints[idx][:n]
+            n_allowed = int(allowed.sum())
+            mem_req = float(self._effective_mem(job))
+            resources: dict[str, dict] = {}
+
+            def add_res(name, req, left):
+                if req <= 0:
+                    return
+                pool_ok = left[allowed] if n_allowed else left
+                short = int((pool_ok < req).sum())
+                if short:
+                    resources[name] = {
+                        "requested": float(req),
+                        "max_offered": float(pool_ok.max())
+                        if len(pool_ok) else 0.0,
+                        "insufficient_hosts": short,
+                    }
+
+            add_res("mem", mem_req, mem_left)
+            add_res("cpus", job.cpus, cpus_left)
+            add_res("gpus", job.gpus, gpus_left)
+            add_res("ports", job.ports, ports_avail)
+
+            masks = constraints_mod.explain_forbidden(
+                job, host_names, host_attrs, self.reservations,
+                group_pins, group_uhosts)
+            constraints = {name: int(m[:n].sum())
+                           for name, m in masks.items() if m[:n].any()}
+            # constraint-forbidden hosts not attributed to a named mask
+            # (e.g. the estimated-completion overlay)
+            named = np.zeros(n, bool)
+            for m in masks.values():
+                named |= m[:n]
+            residual = int((forb_constraints[idx][:n] & ~named).sum())
+            if residual:
+                constraints["other"] = residual
+
+            reasons = [
+                f"insufficient-{r}: requested {v['requested']:g}, "
+                f"max offered {v['max_offered']:g} "
+                f"({v['insufficient_hosts']}/{n} hosts short)"
+                for r, v in resources.items()
+            ] + [f"constraint {name} forbids {cnt}/{n} hosts"
+                 for name, cnt in constraints.items()]
+            if not reasons:
+                reasons = ["no-host-with-sufficient-resources"]
+            job.last_placement_failure = {
+                "at_ms": t_ms,
+                "hosts_considered": n,
+                "resources": resources,
+                "constraints": constraints,
+                "reasons": reasons,
+            }
 
     def _dru_pending_head(self, pending: list[Job], tb, pool: str,
                           P: int) -> list[Job]:

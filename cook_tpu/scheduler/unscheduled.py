@@ -68,9 +68,13 @@ def reasons(store: JobStore, job: Job,
                     "queue.", {"queue-position": queue_position}])
 
     if job.last_placement_failure:
+        pf = job.last_placement_failure
         out.append(["The job couldn't be placed on any available hosts.",
-                    {"reasons": job.last_placement_failure.get("reasons", []),
-                     "at_ms": job.last_placement_failure.get("at_ms")}])
+                    {"reasons": pf.get("reasons", []),
+                     "resources": pf.get("resources", {}),
+                     "constraints": pf.get("constraints", {}),
+                     "hosts_considered": pf.get("hosts_considered"),
+                     "at_ms": pf.get("at_ms")}])
     elif not out:
         # mark under investigation: next failed match cycle records details
         out.append(["The job is now under investigation. Check back in a "

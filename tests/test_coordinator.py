@@ -461,6 +461,25 @@ def test_gpu_pool_rebalancer_preempts_by_gpu_dru():
     assert poor.state == JobState.RUNNING
 
 
+def test_placement_failure_reports_each_short_resource():
+    """Host A lacks only ports, host B lacks only mem: the summary must
+    attribute both exclusions (fenzo_utils.clj:45-86), not fold the port
+    shortage into the constraint mask."""
+    store, cluster, coord = build(hosts=[
+        MockHost("a", mem=1000, cpus=16, port_range=(31000, 30999)),  # 0 ports
+        MockHost("b", mem=50, cpus=16, port_range=(31000, 31010)),
+    ])
+    job = mkjob(mem=100, ports=1)
+    store.create_jobs([job])
+    assert coord.match_cycle().matched == 0
+    pf = job.last_placement_failure
+    assert pf["resources"]["mem"]["insufficient_hosts"] == 1
+    assert pf["resources"]["mem"]["requested"] == 100.0
+    assert pf["resources"]["ports"]["insufficient_hosts"] == 1
+    assert pf["constraints"] == {}
+    assert pf["hosts_considered"] == 2
+
+
 def test_rebalancer_serves_dru_queue_not_priority():
     """The rebalancer must walk the DRU-ranked pending queue
     (rebalancer.clj:428-447 consumes the rank cycle's output): when

@@ -250,8 +250,28 @@ def test_unscheduled_jobs_placement_failure(stack):
     (uuid,) = submit(api, mem=10 ** 5)  # bigger than any host
     coord.match_cycle()
     resp = call(api, "GET", "/unscheduled_jobs", query={"job": uuid})
-    reasons = [r["reason"] for r in resp.body[0]["reasons"]]
-    assert any("couldn't be placed" in r for r in reasons)
+    entry = next(r for r in resp.body[0]["reasons"]
+                 if "couldn't be placed" in r["reason"])
+    # structured per-resource summary (fenzo_utils.clj:45-86 parity):
+    # requested vs best offer vs how many hosts fell short
+    mem = entry["data"]["resources"]["mem"]
+    assert mem["requested"] == 10 ** 5
+    assert mem["max_offered"] == 1000.0
+    assert mem["insufficient_hosts"] == 2
+    assert entry["data"]["hosts_considered"] == 2
+    assert any("insufficient-mem" in r for r in entry["data"]["reasons"])
+
+
+def test_unscheduled_jobs_constraint_failure(stack):
+    store, _, coord, api = stack
+    (uuid,) = submit(api, constraints=[["rack", "EQUALS", "nowhere"]])
+    coord.match_cycle()
+    resp = call(api, "GET", "/unscheduled_jobs", query={"job": uuid})
+    entry = next(r for r in resp.body[0]["reasons"]
+                 if "couldn't be placed" in r["reason"])
+    assert entry["data"]["constraints"] == {"user-constraint/rack": 2}
+    assert "resources" in entry["data"] and \
+        entry["data"]["resources"] == {}
 
 
 def test_progress_endpoint(stack):
