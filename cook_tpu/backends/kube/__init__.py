@@ -10,11 +10,19 @@ Equivalent of the reference's kubernetes/ layer:
                 launches via expected-state writes, synthetic-pod
                 autoscaling, startup reconstruction
                 (kubernetes/compute_cluster.clj)
+  http_api.py   the real-apiserver KubeApi: list/watch streams with
+                resourceVersion resume + reconnect, pod CRUD, bearer
+                auth (kubernetes/api.clj:200,281,333,1088 +
+                WatchHelper.java)
+  standin.py    HTTP-level apiserver stand-in serving a FakeKube over
+                the genuine wire protocol (watch JSON, 410 Gone) for
+                tests/dev
 """
 from cook_tpu.backends.kube.api import FakeKube, KubeApi, Node, Pod, PodPhase
 from cook_tpu.backends.kube.cluster import KubeCluster
 from cook_tpu.backends.kube.controller import (ExpectedState, KubeController,
                                                PodState)
+from cook_tpu.backends.kube.http_api import HttpKube
 
-__all__ = ["FakeKube", "KubeApi", "Node", "Pod", "PodPhase", "KubeCluster",
-           "KubeController", "ExpectedState", "PodState"]
+__all__ = ["FakeKube", "HttpKube", "KubeApi", "Node", "Pod", "PodPhase",
+           "KubeCluster", "KubeController", "ExpectedState", "PodState"]

@@ -34,6 +34,14 @@ class ClusterSettings:
     sandbox_root: str = "/tmp/cook_tpu_sandboxes"   # local
     file_server_port: int = 12322                   # local
     max_synthetic_pods: int = 30                    # kube
+    # kube with a real apiserver: base URL + auth (HttpKube); when
+    # kube_url is empty a kube cluster runs against the in-memory fake
+    # (dev mode, like the reference's minimesos/testutil setups)
+    kube_url: str = ""
+    kube_namespace: str = "cook"
+    kube_token_path: str = ""
+    kube_ca_path: str = ""
+    kube_insecure: bool = False
 
     def validate(self) -> None:
         if self.kind not in ("mock", "local", "kube"):

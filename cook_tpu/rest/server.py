@@ -156,10 +156,19 @@ def build_scheduler(config):
                 progress_aggregator=progress, heartbeats=heartbeats))
         elif c.kind == "kube":
             from cook_tpu.backends.kube import FakeKube, KubeCluster, Node
-            kube = FakeKube([Node(f"{c.name}-n{i}", mem=c.host_mem,
-                                  cpus=c.host_cpus, gpus=c.host_gpus,
-                                  pool=c.pool)
-                             for i in range(c.hosts)])
+            if c.kube_url:
+                # real apiserver over HTTP (kubernetes/api.clj role)
+                from cook_tpu.backends.kube.http_api import HttpKube
+                kube = HttpKube(
+                    c.kube_url, namespace=c.kube_namespace,
+                    token_path=c.kube_token_path or None,
+                    ca_path=c.kube_ca_path or None,
+                    insecure=c.kube_insecure)
+            else:
+                kube = FakeKube([Node(f"{c.name}-n{i}", mem=c.host_mem,
+                                      cpus=c.host_cpus, gpus=c.host_gpus,
+                                      pool=c.pool)
+                                 for i in range(c.hosts)])
             clusters.register(KubeCluster(
                 kube, name=c.name, max_synthetic_pods=c.max_synthetic_pods,
                 default_checkpoint_config=config.checkpoint or None))
