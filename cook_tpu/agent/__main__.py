@@ -1,0 +1,3 @@
+from cook_tpu.agent.daemon import main
+
+main()

@@ -1,0 +1,293 @@
+"""Standalone network agent: run tasks on this machine for a remote
+coordinator.
+
+The reference's on-node story is executor + sidecar under a Mesos agent
+speaking framework messages through libmesos
+(/root/reference/executor/cook/executor.py:421,
+mesos_compute_cluster.clj:94-195). This daemon replaces that transport
+with plain HTTP:
+
+  - registers with the coordinator (POST /agents/register) advertising
+    capacity + its own control URL, and re-registers whenever the
+    coordinator says it doesn't know us (coordinator restart);
+  - serves POST /launch and POST /kill from the coordinator plus
+    GET /state for debugging;
+  - runs tasks through cook_tpu.agent.executor.Executor (process
+    groups, sandboxes, stdout/stderr, progress regex) and relays
+    status/progress upstream (POST /agents/status, /agents/progress);
+  - heartbeats (POST /agents/heartbeat) with the live task list so the
+    coordinator can detect lost tasks/agents;
+  - serves sandboxes over the sidecar FileServer.
+
+Entry point:  python -m cook_tpu.agent --coordinator URL [--mem MB]
+              [--cpus N] [--pool P] [--hostname H] [--port P] ...
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from cook_tpu.agent.executor import Executor
+from cook_tpu.agent.file_server import FileServer
+from cook_tpu.utils.httpjson import json_request
+
+logger = logging.getLogger(__name__)
+
+
+class AgentDaemon:
+    def __init__(self, coordinator_url: str,
+                 hostname: Optional[str] = None,
+                 mem: float = 8192.0, cpus: float = 8.0, gpus: float = 0.0,
+                 pool: str = "default",
+                 sandbox_root: str = "/tmp/cook_tpu_agent_sandboxes",
+                 port: int = 0, file_server_port: int = 0,
+                 heartbeat_interval_s: float = 5.0,
+                 attributes: Optional[dict] = None,
+                 advertise_host: str = "127.0.0.1",
+                 agent_token: str = "",
+                 bind_host: str = "127.0.0.1"):
+        self.coordinator_url = coordinator_url.rstrip("/")
+        self.hostname = hostname or socket.gethostname()
+        self.mem, self.cpus, self.gpus = mem, cpus, gpus
+        self.pool = pool
+        self.attributes = attributes or {}
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.advertise_host = advertise_host
+        self.agent_token = agent_token
+        self._stop = threading.Event()
+        self.executor = Executor(
+            sandbox_root,
+            on_status=self._on_status,
+            on_progress=self._on_progress,
+            on_heartbeat=lambda tid: None,   # agent-level heartbeat below
+            heartbeat_interval_s=heartbeat_interval_s)
+        self.file_server = FileServer(sandbox_root, port=file_server_port)
+
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                # the agent's /launch runs arbitrary commands: when a
+                # token is configured the coordinator must present it
+                if daemon.agent_token and \
+                        self.headers.get("X-Cook-Agent-Token", "") \
+                        != daemon.agent_token:
+                    self._json(401, {"error": "bad agent token"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    self._json(400, {"error": "malformed json"})
+                    return
+                if self.path == "/launch":
+                    self._json(200, daemon.handle_launch(payload))
+                elif self.path == "/kill":
+                    self._json(200, daemon.handle_kill(payload))
+                else:
+                    self._json(404, {"error": "no route"})
+
+            def do_GET(self):
+                if self.path == "/state":
+                    self._json(200, daemon.state())
+                else:
+                    self._json(404, {"error": "no route"})
+
+        self.httpd = ThreadingHTTPServer((bind_host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._server_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def url(self) -> str:
+        return f"http://{self.advertise_host}:{self.port}"
+
+    def start(self) -> "AgentDaemon":
+        self.file_server.start()
+        self._server_thread.start()
+        self._register(block=True)
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for tid in list(self.executor.alive_task_ids()):
+            self.executor.kill(tid)
+        self.httpd.shutdown()
+        self.file_server.stop()
+
+    def run_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.is_set():
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            self.stop()
+
+    # -- coordinator-facing --------------------------------------------
+    def _register_payload(self) -> dict:
+        return {
+            "hostname": self.hostname, "url": self.url,
+            "mem": self.mem, "cpus": self.cpus, "gpus": self.gpus,
+            "pool": self.pool, "attributes": self.attributes,
+            "file_server_url":
+                f"http://{self.advertise_host}:{self.file_server.port}",
+            "tasks": sorted(self.executor.alive_task_ids()),
+        }
+
+    def _register(self, block: bool = False) -> None:
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                self._post("/agents/register", self._register_payload())
+                logger.info("registered with %s as %s",
+                            self.coordinator_url, self.hostname)
+                return
+            except Exception as e:
+                if not block:
+                    raise
+                logger.warning("register failed (%s); retrying in %.1fs",
+                               e, backoff)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self.heartbeat_interval_s)
+            try:
+                resp = self._post("/agents/heartbeat", {
+                    "hostname": self.hostname,
+                    "tasks": sorted(self.executor.alive_task_ids())})
+                if resp.get("reregister"):
+                    self._register(block=True)
+                for tid in resp.get("kill", []):
+                    # coordinator doesn't know this task: orphan from a
+                    # torn launch or a previous coordinator life
+                    logger.warning("killing orphan task %s", tid)
+                    self.executor.kill(tid)
+            except Exception as e:
+                logger.warning("heartbeat failed: %s", e)
+
+    def _on_status(self, task_id: str, event: str, info: dict) -> None:
+        self._post_retry("/agents/status", {
+            "task_id": task_id, "event": event,
+            "exit_code": info.get("exit_code"),
+            "sandbox": info.get("sandbox", ""),
+            "hostname": self.hostname})
+
+    def _on_progress(self, task_id: str, sequence: int, percent: int,
+                     message: str) -> None:
+        self._post_retry("/agents/progress", {
+            "task_id": task_id, "sequence": sequence,
+            "percent": percent, "message": message}, attempts=1)
+
+    def _post(self, path: str, payload: dict) -> dict:
+        headers = {}
+        if self.agent_token:
+            headers["X-Cook-Agent-Token"] = self.agent_token
+        return json_request("POST", self.coordinator_url + path, payload,
+                            headers=headers)
+
+    def _post_retry(self, path: str, payload: dict,
+                    attempts: int = 3) -> None:
+        delay = 0.2
+        for i in range(attempts):
+            try:
+                self._post(path, payload)
+                return
+            except Exception as e:
+                if i == attempts - 1:
+                    # the heartbeat task-list diff is the safety net for
+                    # dropped terminal statuses
+                    logger.warning("status post %s dropped after %d "
+                                   "attempts: %s", path, attempts, e)
+                    return
+                time.sleep(delay)
+                delay *= 2
+
+    # -- coordinator-issued work ---------------------------------------
+    def handle_launch(self, payload: dict) -> dict:
+        for spec in payload.get("specs", []):
+            env = dict(spec.get("env", {}))
+            for i, p in enumerate(spec.get("ports", [])):
+                env[f"PORT{i}"] = str(p)
+            try:
+                self.executor.launch(
+                    spec["task_id"], spec.get("command", ""), env=env,
+                    progress_regex=spec.get("progress_regex", ""),
+                    progress_output_file=spec.get("progress_output_file",
+                                                  ""),
+                    uris=spec.get("uris", []))
+            except Exception as e:
+                logger.warning("launch %s failed: %s", spec.get("task_id"),
+                               e)
+                self._post_retry("/agents/status", {
+                    "task_id": spec["task_id"], "event": "fetch_failed",
+                    "hostname": self.hostname})
+        return {"ok": True}
+
+    def handle_kill(self, payload: dict) -> dict:
+        self.executor.kill(payload.get("task_id", ""))
+        return {"ok": True}
+
+    def state(self) -> dict:
+        return {"hostname": self.hostname,
+                "tasks": sorted(self.executor.alive_task_ids()),
+                "mem": self.mem, "cpus": self.cpus, "pool": self.pool}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="cook_tpu.agent",
+        description="cook_tpu network agent (remote task execution)")
+    ap.add_argument("--coordinator", required=True,
+                    help="coordinator base URL, e.g. http://head:12321")
+    ap.add_argument("--hostname", default=None)
+    ap.add_argument("--mem", type=float, default=8192.0)
+    ap.add_argument("--cpus", type=float, default=8.0)
+    ap.add_argument("--gpus", type=float, default=0.0)
+    ap.add_argument("--pool", default="default")
+    ap.add_argument("--sandbox-root",
+                    default="/tmp/cook_tpu_agent_sandboxes")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--file-server-port", type=int, default=0)
+    ap.add_argument("--heartbeat-interval", type=float, default=5.0)
+    ap.add_argument("--advertise-host", default="127.0.0.1")
+    ap.add_argument("--bind-host", default="127.0.0.1",
+                    help="interface for the control server (set to "
+                         "0.0.0.0 for real remote deployments)")
+    ap.add_argument("--agent-token", default="")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    AgentDaemon(
+        args.coordinator, hostname=args.hostname, mem=args.mem,
+        cpus=args.cpus, gpus=args.gpus, pool=args.pool,
+        sandbox_root=args.sandbox_root, port=args.port,
+        file_server_port=args.file_server_port,
+        heartbeat_interval_s=args.heartbeat_interval,
+        advertise_host=args.advertise_host, bind_host=args.bind_host,
+        agent_token=args.agent_token).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,359 @@
+"""Network-agent backend: remote execution over an HTTP control plane.
+
+The libmesos/executor communication role (SURVEY §7.8): the reference's
+executor is a *network* participant that registers with its agent and
+streams status/progress/heartbeats as framework messages
+(/root/reference/executor/cook/executor.py:421,
+mesos_compute_cluster.clj:94-195). Here:
+
+  coordinator side (this module)     agent side (cook_tpu.agent.daemon)
+  ------------------------------     ----------------------------------
+  AgentCluster (ComputeCluster)      AgentDaemon process
+    offers = registered agents'        registers over POST /agents/register
+      capacity minus assigned work     heartbeats POST /agents/heartbeat
+    launch -> POST {agent}/launch      runs tasks via agent.executor
+    kill   -> POST {agent}/kill        status  -> POST /agents/status
+    agent-lost watchdog: heartbeat     progress-> POST /agents/progress
+      timeout fails tasks 5000         serves sandboxes via FileServer
+
+Exactly-once discipline matches the other backends: the store txn
+happens before launch; agent death surfaces as mea-culpa host-lost so
+retries don't burn user attempts (schema.clj:1018-1062 semantics).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
+from cook_tpu.state.model import InstanceStatus, now_ms
+from cook_tpu.utils.httpjson import json_request
+
+logger = logging.getLogger(__name__)
+
+REASON_HOST_LOST = 5000           # mea-culpa (model.py REASONS)
+REASON_LAUNCH_FAILED = 99003
+
+
+@dataclass
+class AgentInfo:
+    hostname: str
+    url: str                      # the agent's own control server
+    mem: float
+    cpus: float
+    gpus: float = 0.0
+    pool: str = "default"
+    attributes: dict = field(default_factory=dict)
+    file_server_url: str = ""
+    last_heartbeat_ms: int = 0
+    alive: bool = True
+
+
+class AgentCluster(ComputeCluster):
+    """ComputeCluster over registered network agents."""
+
+    def __init__(self, name: str = "agents",
+                 heartbeat_timeout_s: float = 30.0,
+                 progress_aggregator=None, heartbeats=None,
+                 request_timeout_s: float = 10.0,
+                 lost_task_grace_s: float = 5.0,
+                 agent_token: str = ""):
+        self.name = name
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.lost_task_grace_s = lost_task_grace_s
+        self.agent_token = agent_token
+        self.progress = progress_aggregator
+        self.heartbeats = heartbeats
+        self.agents: dict[str, AgentInfo] = {}
+        # task -> (spec, host, launched_ms)
+        self._specs: dict[str, tuple[LaunchSpec, str, int]] = {}
+        # heartbeat-diff strike counts: a task is only failed lost after
+        # missing from TWO consecutive heartbeats, so an in-flight
+        # terminal status post (executor pops the task before POSTing)
+        # has a window to land
+        self._missing: dict[str, int] = {}
+        self._lock = threading.RLock()
+
+    # -- agent control-plane entry points (wired to REST routes) -------
+    def register_agent(self, payload: dict) -> dict:
+        """POST /agents/register. Re-registration after an agent restart
+        reconciles: any task we believed was running there that the
+        fresh agent does not report is failed host-lost (the
+        reconciliation role of re-registration,
+        mesos_compute_cluster.clj:119-133)."""
+        hostname = payload["hostname"]
+        info = AgentInfo(
+            hostname=hostname,
+            url=payload["url"].rstrip("/"),
+            mem=float(payload.get("mem", 0.0)),
+            cpus=float(payload.get("cpus", 0.0)),
+            gpus=float(payload.get("gpus", 0.0)),
+            pool=payload.get("pool", "default"),
+            attributes=dict(payload.get("attributes", {})),
+            file_server_url=payload.get("file_server_url", ""),
+            last_heartbeat_ms=now_ms())
+        reported = set(payload.get("tasks", []))
+        grace_cutoff = now_ms() - int(self.lost_task_grace_s * 1000)
+        with self._lock:
+            self.agents[hostname] = info
+            lost = [tid for tid, (_, h, t0) in self._specs.items()
+                    if h == hostname and tid not in reported
+                    and t0 < grace_cutoff]
+        for tid in lost:
+            self._fail_lost(tid, "agent re-registered without task")
+        logger.info("agent %s registered (%s); %d tasks lost",
+                    hostname, info.url, len(lost))
+        return {"ok": True, "hostname": hostname}
+
+    def agent_heartbeat(self, payload: dict) -> dict:
+        """POST /agents/heartbeat: {hostname, tasks: [alive ids]}.
+        Tasks we track on that agent but absent from the report are
+        failed host-lost (safety net under executor status reports).
+        Unknown hostnames get told to re-register (a restarted
+        coordinator has an empty registry)."""
+        hostname = payload.get("hostname", "")
+        reported = set(payload.get("tasks", []))
+        grace_cutoff = now_ms() - int(self.lost_task_grace_s * 1000)
+        lost = []
+        with self._lock:
+            info = self.agents.get(hostname)
+            if info is None or not info.alive:
+                return {"ok": False, "reregister": True}
+            info.last_heartbeat_ms = now_ms()
+            known_here = set()
+            for tid, (_, h, t0) in self._specs.items():
+                if h != hostname:
+                    continue
+                known_here.add(tid)
+                if tid in reported or t0 >= grace_cutoff:
+                    # present, or launched after the heartbeat's task
+                    # list could have been snapshotted: not lost
+                    self._missing.pop(tid, None)
+                    continue
+                strikes = self._missing.get(tid, 0) + 1
+                self._missing[tid] = strikes
+                if strikes >= 2:
+                    lost.append(tid)
+            # reported-but-unknown: an orphan from a failed launch POST
+            # or a previous coordinator life; tell the agent to kill it
+            # so it stops consuming real capacity
+            orphans = sorted(reported - known_here)
+        for tid in lost:
+            self._fail_lost(tid, "missing from two consecutive heartbeats")
+        # a live agent task keeps the per-task heartbeat fresh: the
+        # HeartbeatWatcher must not fire 3000 while the agent reports it
+        if self.heartbeats is not None:
+            for tid in reported:
+                self.heartbeats.notify(tid)
+        return {"ok": True, "kill": orphans}
+
+    def status_report(self, payload: dict) -> dict:
+        """POST /agents/status: executor events relayed over the wire.
+        Same event -> instance-status mapping as the in-process local
+        backend (executor exit-code reporting)."""
+        task_id = payload["task_id"]
+        event = payload.get("event", "")
+        exit_code = payload.get("exit_code")
+        sandbox = payload.get("sandbox", "")
+        with self._lock:
+            entry = self._specs.get(task_id)
+            if entry is None:
+                # not a task we launched (or already resolved as lost):
+                # don't let an arbitrary poster flip instance state
+                return {"ok": False, "unknown": True}
+            info = self.agents.get(entry[1])
+            output_url = info.file_server_url if info else ""
+        if event == "running":
+            self.emit_status(task_id, InstanceStatus.RUNNING, None,
+                             sandbox=sandbox, output_url=output_url)
+            return {"ok": True}
+        if event == "fetch_failed":
+            self._forget(task_id)
+            self.emit_status(task_id, InstanceStatus.FAILED,
+                             REASON_LAUNCH_FAILED, sandbox=sandbox,
+                             output_url=output_url)
+            return {"ok": True}
+        self._forget(task_id)
+        if event == "killed":
+            self.emit_status(task_id, InstanceStatus.FAILED, 1004,
+                             exit_code=exit_code, sandbox=sandbox,
+                             output_url=output_url)
+        elif exit_code == 0:
+            self.emit_status(task_id, InstanceStatus.SUCCESS, None,
+                             exit_code=0, sandbox=sandbox,
+                             output_url=output_url)
+        else:
+            self.emit_status(task_id, InstanceStatus.FAILED, 1003,
+                             exit_code=exit_code, sandbox=sandbox,
+                             output_url=output_url)
+        return {"ok": True}
+
+    def progress_report(self, payload: dict) -> dict:
+        """POST /agents/progress (the framework-message progress path,
+        progress.clj:102)."""
+        if self.progress is not None:
+            self.progress.handle(
+                payload["task_id"], int(payload.get("sequence", 0)),
+                int(payload.get("percent", 0)),
+                str(payload.get("message", "")))
+        if self.heartbeats is not None:
+            self.heartbeats.notify(payload["task_id"])
+        return {"ok": True}
+
+    # -- ComputeCluster protocol ---------------------------------------
+    def pending_offers(self, pool: str) -> list[Offer]:
+        offers = []
+        with self._lock:
+            for info in self.agents.values():
+                if not info.alive or info.pool != pool:
+                    continue
+                used_mem = used_cpus = used_gpus = 0.0
+                for spec, h, _ in self._specs.values():
+                    if h == info.hostname:
+                        used_mem += spec.mem
+                        used_cpus += spec.cpus
+                        used_gpus += spec.gpus
+                mem = info.mem - used_mem
+                cpus = info.cpus - used_cpus
+                if mem <= 0 and cpus <= 0:
+                    continue
+                offers.append(Offer(
+                    hostname=info.hostname, pool=pool, mem=mem, cpus=cpus,
+                    gpus=info.gpus - used_gpus,
+                    attributes={"backend": "agent", **info.attributes},
+                    cap_mem=info.mem, cap_cpus=info.cpus,
+                    cap_gpus=info.gpus))
+        return offers
+
+    def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
+        by_host: dict[str, list[LaunchSpec]] = {}
+        for spec in specs:
+            by_host.setdefault(spec.hostname, []).append(spec)
+        for hostname, host_specs in by_host.items():
+            with self._lock:
+                info = self.agents.get(hostname)
+                if info is None or not info.alive:
+                    info = None
+                else:
+                    t0 = now_ms()
+                    for s in host_specs:
+                        self._specs[s.task_id] = (s, hostname, t0)
+            if info is None:
+                for s in host_specs:
+                    self.emit_status(s.task_id, InstanceStatus.FAILED,
+                                     REASON_HOST_LOST)
+                continue
+            try:
+                self._post(info.url + "/launch", {
+                    "specs": [_spec_wire(s) for s in host_specs]})
+            except Exception as e:
+                logger.warning("launch to agent %s failed: %s", hostname, e)
+                for s in host_specs:
+                    # the POST may have half-landed (e.g. timed out after
+                    # delivery): best-effort kill so no orphan runs on;
+                    # the heartbeat orphan reconciliation is the backstop
+                    try:
+                        self._post(info.url + "/kill",
+                                   {"task_id": s.task_id})
+                    except Exception:
+                        pass
+                    self._forget(s.task_id)
+                    self.emit_status(s.task_id, InstanceStatus.FAILED,
+                                     REASON_LAUNCH_FAILED)
+
+    def kill_task(self, task_id: str) -> None:
+        with self._lock:
+            entry = self._specs.get(task_id)
+        if entry is None:
+            return
+        _, hostname, _ = entry
+        with self._lock:
+            info = self.agents.get(hostname)
+        if info is None:
+            return
+        try:
+            self._post(info.url + "/kill", {"task_id": task_id})
+        except Exception as e:
+            # the agent is unreachable: the watchdog will fail the task
+            # host-lost when the heartbeat lapses
+            logger.warning("kill of %s on %s failed: %s",
+                           task_id, hostname, e)
+
+    def known_task_ids(self) -> set[str]:
+        with self._lock:
+            return set(self._specs)
+
+    def host_attributes(self) -> dict[str, dict[str, str]]:
+        with self._lock:
+            return {h: {"backend": "agent", **i.attributes}
+                    for h, i in self.agents.items() if i.alive}
+
+    # -- agent-lost watchdog (heartbeat timeout -> host lost) ----------
+    def check_agents(self, wall_ms: Optional[int] = None) -> list[str]:
+        """Fail tasks of agents whose heartbeat lapsed; mark the agent
+        dead until it re-registers (slave-removed semantics; reason 5000
+        is mea-culpa so the retry doesn't burn a user attempt)."""
+        wall_ms = wall_ms or now_ms()
+        cutoff = wall_ms - int(self.heartbeat_timeout_s * 1000)
+        dead = []
+        with self._lock:
+            for hostname, info in self.agents.items():
+                if info.alive and info.last_heartbeat_ms < cutoff:
+                    info.alive = False
+                    dead.append(hostname)
+            lost = [tid for tid, (_, h, _) in self._specs.items()
+                    if h in dead]
+        for hostname in dead:
+            logger.warning("agent %s lost (heartbeat timeout)", hostname)
+        for tid in lost:
+            self._fail_lost(tid, "agent heartbeat timeout")
+        return dead
+
+    def advance(self, dt: float) -> None:
+        """Real-time tick hook (the server's tick loop calls advance on
+        clusters that have one)."""
+        self.check_agents()
+
+    # ------------------------------------------------------------------
+    def _fail_lost(self, task_id: str, why: str) -> None:
+        logger.warning("task %s lost: %s", task_id, why)
+        self._forget(task_id)
+        self.emit_status(task_id, InstanceStatus.FAILED, REASON_HOST_LOST)
+
+    def _forget(self, task_id: str) -> None:
+        with self._lock:
+            self._specs.pop(task_id, None)
+            self._missing.pop(task_id, None)
+        if self.heartbeats is not None:
+            self.heartbeats.untrack(task_id)
+
+    def describe_agents(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "hostname": a.hostname, "url": a.url, "pool": a.pool,
+                "mem": a.mem, "cpus": a.cpus, "gpus": a.gpus,
+                "alive": a.alive,
+                "last_heartbeat_ms": a.last_heartbeat_ms,
+            } for a in self.agents.values()]
+
+    def _post(self, url: str, payload: dict) -> dict:
+        headers = {}
+        if self.agent_token:
+            headers["X-Cook-Agent-Token"] = self.agent_token
+        return json_request("POST", url, payload, headers=headers,
+                            timeout=self.request_timeout_s)
+
+
+def _spec_wire(s: LaunchSpec) -> dict:
+    return {
+        "task_id": s.task_id, "job_uuid": s.job_uuid,
+        "hostname": s.hostname, "command": s.command,
+        "mem": s.mem, "cpus": s.cpus, "gpus": s.gpus,
+        "env": s.env, "container": s.container,
+        "progress_regex": s.progress_regex,
+        "progress_output_file": s.progress_output_file,
+        "ports": s.ports, "uris": s.uris,
+    }

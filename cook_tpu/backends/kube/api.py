@@ -174,7 +174,7 @@ class FakeKube(KubeApi):
 
     def schedule_pending(self) -> int:
         """Bind unscheduled pods to nodes with room (kube-scheduler)."""
-        n = 0
+        bound = []
         with self._lock:
             pending = [p for p in self.pods.values()
                        if p.phase == PodPhase.PENDING and not p.node]
@@ -182,10 +182,14 @@ class FakeKube(KubeApi):
                 for node in self.nodes.values():
                     if self._fits(pod, node):
                         pod.node = node.name
-                        n += 1
-                        self._emit_pod("modified", pod)
+                        bound.append(pod)
                         break
-        return n
+        # emit outside the lock: watch callbacks may take their own
+        # locks (e.g. the HTTP stand-in's), and holding ours here would
+        # invert the order a concurrent list request uses
+        for pod in bound:
+            self._emit_pod("modified", pod)
+        return len(bound)
 
     def start_pod(self, name: str) -> None:
         """kubelet starts a scheduled pod."""
@@ -245,7 +249,7 @@ class FakeKube(KubeApi):
         (kubernetes/compute_cluster.clj:339-409)."""
         if not self.autoscaler_node_template:
             return 0
-        added = 0
+        new_nodes = []
         with self._lock:
             unschedulable = [p for p in self.pods.values()
                              if p.phase == PodPhase.PENDING and not p.node
@@ -259,10 +263,11 @@ class FakeKube(KubeApi):
                             mem=t.mem, cpus=t.cpus, gpus=t.gpus,
                             pool=t.pool)
                 self.nodes[node.name] = node
-                added += 1
-                self._emit_node("added", node)
+                new_nodes.append(node)
                 unschedulable = unschedulable[1:]
-        return added
+        for node in new_nodes:   # emit outside the lock (see above)
+            self._emit_node("added", node)
+        return len(new_nodes)
 
     # ------------------------------------------------------------------
     def _emit_pod(self, kind: str, pod: Pod) -> None:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import shlex
 import ssl
 import threading
 import time
@@ -107,10 +108,13 @@ def pod_to_json(pod: Pod, namespace: str) -> dict:
         spec["nodeName"] = pod.node
     if pod.init_uris:
         # URI fetch init-container (the reference renders fetches into
-        # an init-container, api.clj:661-882)
+        # an init-container, api.clj:661-882); shell-quote so URIs with
+        # &, ;, spaces, or query strings can't split into extra tokens
         fetch = " && ".join(
-            f"wget -O /cook-sandbox/{os.path.basename(u) or 'fetched'} "
-            f"{u}" for u in pod.init_uris)
+            "wget -O "
+            + shlex.quote("/cook-sandbox/"
+                          + (os.path.basename(u) or "fetched"))
+            + " " + shlex.quote(u) for u in pod.init_uris)
         spec["initContainers"] = [{
             "name": "cook-init", "image": "busybox:latest",
             "command": ["/bin/sh", "-c", fetch],
@@ -165,7 +169,10 @@ def pod_from_json(obj: dict) -> Pod:
         cmd = ic.get("command") or []
         if ic.get("name") == "cook-init" and len(cmd) >= 3:
             for part in cmd[2].split(" && "):
-                toks = part.split()
+                try:
+                    toks = shlex.split(part)
+                except ValueError:
+                    toks = part.split()
                 if toks:
                     init_uris.append(toks[-1])
     return Pod(
@@ -256,6 +263,11 @@ class HttpKube(KubeApi):
         # likewise synthesized from watch state, compute_cluster.clj:48)
         self._cache: dict[str, dict] = {}
         self._cache_ready: dict[str, threading.Event] = {}
+        self._cache_lock = threading.Lock()
+        # names whose DELETED event arrived recently: blocks the
+        # create_pod write-through from resurrecting a pod that was
+        # created and deleted before the POST returned
+        self._recent_deletes: dict[str, float] = {}
         self._ctx: Optional[ssl.SSLContext] = None
         if self.base_url.startswith("https"):
             if insecure:
@@ -295,13 +307,15 @@ class HttpKube(KubeApi):
 
     def list_pods(self) -> list[Pod]:
         if self._cache_ready.get("pods", threading.Event()).is_set():
-            return list(self._cache["pods"].values())
+            with self._cache_lock:
+                return list(self._cache["pods"].values())
         data = self._json("GET", self._pods_path())
         return [pod_from_json(i) for i in data.get("items", [])]
 
     def list_nodes(self) -> list[Node]:
         if self._cache_ready.get("nodes", threading.Event()).is_set():
-            return list(self._cache["nodes"].values())
+            with self._cache_lock:
+                return list(self._cache["nodes"].values())
         data = self._json("GET", "/api/v1/nodes")
         return [node_from_json(i) for i in data.get("items", [])]
 
@@ -313,6 +327,15 @@ class HttpKube(KubeApi):
             if e.code == 409:        # already exists: launch retry, fine
                 return
             raise
+        # write through to the watch cache so the very next offers
+        # cycle already counts this pod's consumption (the ADDED event
+        # will overwrite with the server's view); a DELETED that already
+        # streamed for this name wins — don't resurrect a phantom
+        with self._cache_lock:
+            cache = self._cache.get("pods")
+            if cache is not None and pod.name not in cache \
+                    and pod.name not in self._recent_deletes:
+                cache[pod.name] = pod
 
     def delete_pod(self, name: str) -> None:
         try:
@@ -322,6 +345,10 @@ class HttpKube(KubeApi):
             if e.code == 404:        # already gone
                 return
             raise
+        with self._cache_lock:
+            cache = self._cache.get("pods")
+            if cache is not None and name in cache:
+                cache[name].deleting = True
 
     # -- watches (api.clj:200,281,333) ---------------------------------
     def watch_pods(self, cb: WatchCallback) -> None:
@@ -362,6 +389,8 @@ class HttpKube(KubeApi):
             for name, obj in known.items():
                 if name not in seen:
                     cb("deleted", obj)
+        with self._cache_lock:
+            self._recent_deletes.clear()   # relist supersedes tombstones
         return rv, seen
 
     def _watch_loop(self, kind: str, path: str, translate, cb,
@@ -376,7 +405,8 @@ class HttpKube(KubeApi):
                     rv, known = self._relist(path, translate, cb, known,
                                              diff_deletes)
                     if kind in ("pods", "nodes"):
-                        self._cache[kind] = known
+                        with self._cache_lock:
+                            self._cache[kind] = known
                         self._cache_ready.setdefault(
                             kind, threading.Event()).set()
                 rv = self._stream_watch(path, rv, translate, cb, known)
@@ -431,11 +461,16 @@ class HttpKube(KubeApi):
                         name = obj.get("metadata", {}).get("name", "")
                         tobj = translate(obj)
                         if etype == "DELETED":
-                            known.pop(name, None)
+                            with self._cache_lock:
+                                known.pop(name, None)
+                                if len(self._recent_deletes) > 4096:
+                                    self._recent_deletes.clear()
+                                self._recent_deletes[name] = time.time()
                             cb("deleted", tobj)
                         else:
                             first = name not in known
-                            known[name] = tobj
+                            with self._cache_lock:
+                                known[name] = tobj
                             cb("added" if first and etype == "ADDED"
                                else "modified", tobj)
                     if new_rv:

@@ -204,10 +204,19 @@ class ApiServerStandIn:
                 self._serve_watch(h, "pods", qs)
             else:
                 self.list_counts["pods"] += 1
+                # take the fake's lock BEFORE ours: the watch fan-out
+                # path holds the fake's lock when it calls _on_pod ->
+                # our lock, so the reverse order here would deadlock.
+                # And read rv BEFORE the snapshot: a stale rv with newer
+                # items only means duplicate (idempotent) events on a
+                # later watch, while a newer rv with older items would
+                # permanently hide the missed event from watchers.
                 with self._lock:
-                    items = [pod_wire(p, self.namespace, self._rv)
-                             for p in self.fake.list_pods()]
                     rv = self._rv
+                pods = self.fake.list_pods()
+                with self._lock:
+                    items = [pod_wire(p, self.namespace, rv)
+                             for p in pods]
                 self._send_json(h, 200, {
                     "kind": "PodList",
                     "metadata": {"resourceVersion": str(rv)},
@@ -218,9 +227,9 @@ class ApiServerStandIn:
             else:
                 self.list_counts["nodes"] += 1
                 with self._lock:
-                    items = [node_wire(n, self._rv)
-                             for n in self.fake.list_nodes()]
                     rv = self._rv
+                nodes = self.fake.list_nodes()
+                items = [node_wire(n, rv) for n in nodes]
                 self._send_json(h, 200, {
                     "kind": "NodeList",
                     "metadata": {"resourceVersion": str(rv)},

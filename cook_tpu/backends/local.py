@@ -50,6 +50,9 @@ class LocalCluster(ComputeCluster):
             on_heartbeat=self._on_heartbeat,
             heartbeat_interval_s=heartbeat_interval_s)
         self.file_server = FileServer(sandbox_root, port=file_server_port)
+        # :instance/output-url equivalent: where this host's sandboxes
+        # are served (port is bound at construction)
+        self._output_url = f"http://{self.hostname}:{self.file_server.port}"
 
     # -- protocol ------------------------------------------------------
     def initialize(self) -> None:
@@ -103,7 +106,8 @@ class LocalCluster(ComputeCluster):
         sandbox = info.get("sandbox", "")
         if event == "running":
             self.emit_status(task_id, InstanceStatus.RUNNING, None,
-                             sandbox=sandbox)
+                             sandbox=sandbox,
+                             output_url=self._output_url)
             return
         if event == "fetch_failed":
             with self._lock:
@@ -111,7 +115,8 @@ class LocalCluster(ComputeCluster):
             if self.heartbeats is not None:
                 self.heartbeats.untrack(task_id)
             self.emit_status(task_id, InstanceStatus.FAILED, 99003,
-                             sandbox=sandbox)
+                             sandbox=sandbox,
+                             output_url=self._output_url)
             return
         with self._lock:
             self._specs.pop(task_id, None)
@@ -120,13 +125,16 @@ class LocalCluster(ComputeCluster):
         exit_code = info.get("exit_code")
         if event == "killed":
             self.emit_status(task_id, InstanceStatus.FAILED, 1004,
-                             exit_code=exit_code, sandbox=sandbox)
+                             exit_code=exit_code, sandbox=sandbox,
+                             output_url=self._output_url)
         elif exit_code == 0:
             self.emit_status(task_id, InstanceStatus.SUCCESS, None,
-                             exit_code=0, sandbox=sandbox)
+                             exit_code=0, sandbox=sandbox,
+                             output_url=self._output_url)
         else:
             self.emit_status(task_id, InstanceStatus.FAILED, 1003,
-                             exit_code=exit_code, sandbox=sandbox)
+                             exit_code=exit_code, sandbox=sandbox,
+                             output_url=self._output_url)
 
     def _on_progress(self, task_id: str, sequence: int, percent: int,
                      message: str) -> None:

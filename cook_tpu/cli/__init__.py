@@ -246,11 +246,16 @@ def _sandbox_instance(fed: Federation, uuid: str):
 
 def _file_server_get(inst, path: str, query: dict) -> bytes:
     """Talk to the on-host agent file server (sidecar file_server.py
-    equivalent, cook_tpu/agent/file_server.py)."""
+    equivalent, cook_tpu/agent/file_server.py). Prefers the instance's
+    recorded output_url (dynamic agent ports); falls back to the fixed
+    sidecar port."""
     from urllib.parse import urlencode
-    host = inst.hostname
-    port = int(os.environ.get("COOK_FILE_SERVER_PORT", 12322))
-    url = f"http://{host}:{port}{path}?{urlencode(query)}"
+    base = getattr(inst, "output_url", "") or ""
+    if not base:
+        host = inst.hostname
+        port = int(os.environ.get("COOK_FILE_SERVER_PORT", 12322))
+        base = f"http://{host}:{port}"
+    url = f"{base.rstrip('/')}{path}?{urlencode(query)}"
     with urllib.request.urlopen(url, timeout=30) as r:
         return r.read()
 

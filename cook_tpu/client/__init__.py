@@ -41,6 +41,7 @@ class InstanceInfo:
     progress_message: str = ""
     exit_code: Optional[int] = None
     sandbox_directory: str = ""
+    output_url: str = ""
     reason_code: Optional[int] = None
     reason_string: Optional[str] = None
     preempted: bool = False

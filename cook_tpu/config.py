@@ -24,7 +24,7 @@ class ConfigError(Exception):
 
 @dataclass
 class ClusterSettings:
-    kind: str = "mock"            # mock | local | kube
+    kind: str = "mock"            # mock | local | kube | agent
     name: str = "mock"
     pool: str = "default"
     hosts: int = 4                # mock: number of hosts
@@ -42,9 +42,12 @@ class ClusterSettings:
     kube_token_path: str = ""
     kube_ca_path: str = ""
     kube_insecure: bool = False
+    # agent: network agents register themselves; timeout fails their
+    # tasks host-lost
+    agent_heartbeat_timeout_s: float = 30.0
 
     def validate(self) -> None:
-        if self.kind not in ("mock", "local", "kube"):
+        if self.kind not in ("mock", "local", "kube", "agent"):
             raise ConfigError(f"unknown cluster kind {self.kind!r}")
         if self.hosts < 0 or self.host_mem <= 0 or self.host_cpus <= 0:
             raise ConfigError(f"cluster {self.name}: invalid host shape")
@@ -77,6 +80,9 @@ class AuthSettings:
     imposters: list = field(default_factory=list)
     authorization: str = "configfile-admins-auth"
     cors_origins: list = field(default_factory=list)
+    # shared secret for the /agents machine channel; REQUIRED when the
+    # scheme provides real user auth (basic/header)
+    agent_token: str = ""
 
     def validate(self) -> None:
         if self.scheme not in ("one-user", "basic", "header"):

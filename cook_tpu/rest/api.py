@@ -151,7 +151,20 @@ class CookApi:
         req = Request(method=method, path=path, query=query, body=body,
                       headers=headers)
         try:
-            if path not in ("/info", "/debug"):  # conditional-auth-bypass
+            if path.startswith("/agents"):
+                # machine channel: agents authenticate with the shared
+                # token, not a user principal. With real user auth
+                # configured, a token is REQUIRED — a write-capable
+                # control plane must not be the open back door.
+                if self.auth.agent_token:
+                    if headers.get("x-cook-agent-token", "") \
+                            != self.auth.agent_token:
+                        raise AuthError(401, "bad agent token")
+                elif self.auth.scheme != "one-user":
+                    raise AuthError(
+                        401, "agent channel requires auth.agent_token "
+                             "when user auth is enabled")
+            elif path not in ("/info", "/debug"):  # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)
             return self.router.dispatch(req)
         except AuthError as e:
@@ -196,7 +209,47 @@ class CookApi:
         r.add("GET", "/debug", self.get_debug)
         r.add("GET", "/data-local", self.data_local_status)
         r.add("GET", "/data-local/:uuid", self.data_local_costs)
+        # network-agent control plane (the framework-message channel of
+        # mesos_compute_cluster.clj:94-195, over HTTP)
+        r.add("POST", "/agents/register", self.agent_register)
+        r.add("POST", "/agents/heartbeat", self.agent_heartbeat)
+        r.add("POST", "/agents/status", self.agent_status)
+        r.add("POST", "/agents/progress", self.agent_progress)
+        r.add("GET", "/agents", self.agent_list)
         return r
+
+    # -- network-agent control plane -----------------------------------
+    def _agent_cluster(self):
+        from cook_tpu.backends.agent import AgentCluster
+        coord = self.coord
+        if coord is not None:
+            for cluster in coord.clusters.all():
+                if isinstance(cluster, AgentCluster):
+                    return cluster
+        raise ApiError(404, "no agent backend configured")
+
+    def agent_register(self, req: Request) -> Response:
+        return Response(200, self._agent_cluster().register_agent(
+            req.body or {}))
+
+    def agent_heartbeat(self, req: Request) -> Response:
+        return Response(200, self._agent_cluster().agent_heartbeat(
+            req.body or {}))
+
+    def agent_status(self, req: Request) -> Response:
+        body = req.body or {}
+        if "task_id" not in body:
+            raise ApiError(400, "task_id is required")
+        return Response(200, self._agent_cluster().status_report(body))
+
+    def agent_progress(self, req: Request) -> Response:
+        body = req.body or {}
+        if "task_id" not in body:
+            raise ApiError(400, "task_id is required")
+        return Response(200, self._agent_cluster().progress_report(body))
+
+    def agent_list(self, req: Request) -> Response:
+        return Response(200, self._agent_cluster().describe_agents())
 
     # ------------------------------------------------------------------
     # submission (create-jobs! rest/api.clj:1805; validation :523+)
@@ -824,6 +877,7 @@ def instance_response(inst: Instance, job: Job) -> dict:
         "progress_message": inst.progress_message,
         "exit_code": inst.exit_code,
         "sandbox_directory": inst.sandbox_directory,
+        "output_url": inst.output_url,
         "preempted": inst.preempted,
         "ports": inst.ports,
     }

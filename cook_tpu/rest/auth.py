@@ -41,6 +41,9 @@ class AuthConfig:
     # "open-auth" (everyone may do anything) — authorization.clj:140-233
     authorization: str = "configfile-admins-auth"
     cors_origins: list = field(default_factory=list)
+    # shared secret for the machine channel (/agents/*); empty = open,
+    # like an unauthenticated Mesos driver port
+    agent_token: str = ""
 
 
 def authenticate(cfg: AuthConfig, headers: dict) -> str:

@@ -172,6 +172,13 @@ def build_scheduler(config):
             clusters.register(KubeCluster(
                 kube, name=c.name, max_synthetic_pods=c.max_synthetic_pods,
                 default_checkpoint_config=config.checkpoint or None))
+        elif c.kind == "agent":
+            from cook_tpu.backends.agent import AgentCluster
+            clusters.register(AgentCluster(
+                name=c.name,
+                heartbeat_timeout_s=c.agent_heartbeat_timeout_s,
+                progress_aggregator=progress, heartbeats=heartbeats,
+                agent_token=config.auth.agent_token))
         else:
             hosts = [MockHost(hostname=f"{c.name}-host-{i}",
                               mem=c.host_mem, cpus=c.host_cpus,
@@ -226,7 +233,8 @@ def build_scheduler(config):
                         admins=set(config.auth.admins),
                         imposters=set(config.auth.imposters),
                         authorization=config.auth.authorization,
-                        cors_origins=list(config.auth.cors_origins)),
+                        cors_origins=list(config.auth.cors_origins),
+                        agent_token=config.auth.agent_token),
         task_constraints=TaskConstraints(
             max_mem_mb=config.task_constraints.max_mem_mb,
             max_cpus=config.task_constraints.max_cpus,

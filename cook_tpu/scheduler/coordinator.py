@@ -213,11 +213,12 @@ class Coordinator:
     # ------------------------------------------------------------------
     def _on_status(self, task_id: str, status: InstanceStatus,
                    reason: Optional[int], exit_code: Optional[int] = None,
-                   sandbox: Optional[str] = None) -> None:
+                   sandbox: Optional[str] = None,
+                   output_url: Optional[str] = None) -> None:
         preempted = reason in (2000, 2003)
         job = self.store.update_instance(
             task_id, status, reason_code=reason, preempted=preempted,
-            exit_code=exit_code, sandbox=sandbox)
+            exit_code=exit_code, sandbox=sandbox, output_url=output_url)
         # completion plugin (write-status path, scheduler.clj:305-316)
         if self.plugins is not None and job is not None and \
                 status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):

@@ -140,6 +140,10 @@ class Instance:
     progress_message: str = ""
     exit_code: Optional[int] = None
     sandbox_directory: str = ""
+    # base URL of the file server holding this sandbox (the reference's
+    # :instance/output-url); lets ls/cat/tail reach a remote agent whose
+    # file server sits on a dynamic port
+    output_url: str = ""
     ports: list[int] = field(default_factory=list)
 
     @property

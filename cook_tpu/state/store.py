@@ -170,7 +170,8 @@ class JobStore:
                         reason_code: Optional[int] = None,
                         preempted: bool = False,
                         exit_code: Optional[int] = None,
-                        sandbox: Optional[str] = None) -> Optional[Job]:
+                        sandbox: Optional[str] = None,
+                        output_url: Optional[str] = None) -> Optional[Job]:
         """The heart of the write path (:instance/update-state
         schema.clj:1103 via write-status-to-datomic scheduler.clj:213):
         apply a status update, ignore illegal transitions, recompute the
@@ -195,6 +196,8 @@ class JobStore:
                 inst.exit_code = exit_code
             if sandbox is not None:
                 inst.sandbox_directory = sandbox
+            if output_url is not None:
+                inst.output_url = output_url
             if status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
                 inst.end_time_ms = now_ms()
             was = job.state

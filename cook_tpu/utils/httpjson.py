@@ -1,0 +1,24 @@
+"""Tiny shared JSON-over-HTTP helper (stdlib only).
+
+One place for the POST-a-dict/parse-a-dict pattern used by the agent
+control plane on both sides; keeps timeout and decode behavior from
+drifting between copies.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional
+
+
+def json_request(method: str, url: str, body: Optional[dict] = None,
+                 headers: Optional[dict] = None, timeout: float = 10.0,
+                 context=None) -> dict:
+    h = {"Content-Type": "application/json", **(headers or {})}
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode() if body is not None else None,
+        headers=h, method=method)
+    with urllib.request.urlopen(req, timeout=timeout,
+                                context=context) as resp:
+        raw = resp.read().decode()
+        return json.loads(raw) if raw else {}

@@ -167,6 +167,10 @@ def test_list_served_from_watch_cache(standin, http):
     http.watch_nodes(lambda kind, node: None)
     http.create_pod(Pod(name="c1", mem=10, cpus=1))
     wait_until(lambda: any(p.name == "c1" for p in http.list_pods()))
+    # both watch caches must be live before freezing the counters
+    wait_until(lambda: all(
+        http._cache_ready.get(k) and http._cache_ready[k].is_set()
+        for k in ("pods", "nodes")))
     n_pods, n_nodes = standin.list_counts["pods"], \
         standin.list_counts["nodes"]
     for _ in range(5):
