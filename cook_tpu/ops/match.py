@@ -131,22 +131,12 @@ def _feasible(job_mem, job_cpus, job_gpus, mem_left, cpus_left, gpus_left,
     return ok
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups",))
-def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
-               num_groups: int = 1,
-               bonus: jnp.ndarray | None = None) -> MatchResult:
-    """Exact sequential greedy assignment (Fenzo semantics) as one scan.
-
-    forbidden: (N, H) bool — per-(job, host) hard-constraint exclusions
-    computed by cook_tpu.scheduler.constraints.
-    num_groups: static upper bound on dense group ids in this batch.
-    bonus: optional (N, H) f32 >= 0 additive fitness term (the
-    data-locality fitness blend, data_locality.clj:192).
-    """
+def _scan_assign(jobs: Jobs, hosts: Hosts, forbidden, bonus,
+                 num_groups: int, carry):
+    """Sequential greedy core: one lax.scan step per job over carry
+    (mem_left, cpus_left, gpus_left, slots_left, group_occ). Shared by
+    match_scan and match_rounds' exact head segment."""
     H = hosts.mem.shape[0]
-    group_occ = varying_full(hosts.valid, False, (num_groups, H), bool)
-    if bonus is None:
-        bonus = varying_full(hosts.valid, 0.0, forbidden.shape, jnp.float32)
 
     def step(carry, xs):
         mem_left, cpus_left, gpus_left, slots_left, group_occ = carry
@@ -176,28 +166,57 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         group_occ = group_occ.at[g].set(group_occ[g] | (onehot & j_unique))
         return (mem_left, cpus_left, gpus_left, slots_left, group_occ), host
 
-    carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots, group_occ)
     xs = (jobs.mem, jobs.cpus, jobs.gpus, jobs.valid, jobs.group,
           jobs.unique_group, forbidden, bonus)
-    (mem_left, cpus_left, gpus_left, _, _), job_host = jax.lax.scan(step, carry, xs)
+    return jax.lax.scan(step, carry, xs)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
+               num_groups: int = 1,
+               bonus: jnp.ndarray | None = None) -> MatchResult:
+    """Exact sequential greedy assignment (Fenzo semantics) as one scan.
+
+    forbidden: (N, H) bool — per-(job, host) hard-constraint exclusions
+    computed by cook_tpu.scheduler.constraints.
+    num_groups: static upper bound on dense group ids in this batch.
+    bonus: optional (N, H) f32 >= 0 additive fitness term (the
+    data-locality fitness blend, data_locality.clj:192).
+    """
+    group_occ = varying_full(hosts.valid, False,
+                             (num_groups, hosts.mem.shape[0]), bool)
+    if bonus is None:
+        bonus = varying_full(hosts.valid, 0.0, forbidden.shape, jnp.float32)
+    carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots, group_occ)
+    (mem_left, cpus_left, gpus_left, _, _), job_host = _scan_assign(
+        jobs, hosts, forbidden, bonus, num_groups, carry)
     return MatchResult(job_host, mem_left, cpus_left, gpus_left)
 
 
 @functools.partial(jax.jit, static_argnames=("rounds", "num_groups",
                                              "use_pallas",
                                              "pallas_interpret",
-                                             "dense_rounds", "spread"))
+                                             "dense_rounds", "spread",
+                                             "head_exact"))
 def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                  rounds: int = 4, num_groups: int = 1,
                  bonus: jnp.ndarray | None = None,
                  use_pallas: bool = False,
                  pallas_interpret: bool = False,
                  dense_rounds: int = 6,
-                 spread: float = 0.2) -> MatchResult:
-    """Batched greedy approximation: `rounds` water-fill rounds then
-    `dense_rounds` dense argmax rounds (see module docstring), with hosts
-    accepting the feasible prefix of their bidders in queue order after
-    every round.
+                 spread: float = 0.2,
+                 head_exact: int = 256) -> MatchResult:
+    """Batched greedy approximation with an exact head: the first
+    `head_exact` jobs run through the sequential-greedy scan (Fenzo
+    semantics — the queue head is what fairness protects and what the
+    scaleback feedback reads, scheduler.clj:1002-1036), then `rounds`
+    water-fill rounds and `dense_rounds` dense argmax rounds place the
+    tail (see module docstring), with hosts accepting the feasible
+    prefix of their bidders in queue order after every round. Later
+    rounds only bid within the queue-head window of the remaining jobs,
+    bounding how far any leapfrog can reach; a head job the exact scan
+    refused is provably unservable this cycle (capacity only shrinks)
+    and is excluded from every window.
 
     Group-unique coupling is approximated by letting at most the
     first-ranked member of each (group, host) pair through per round.
@@ -237,9 +256,12 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         spread = 0.0
     gclip = jnp.clip(jobs.group, 0, num_groups - 1)
 
-    def accept_bids(state, choice, bids):
-        """Hosts accept claimants in queue order while they still fit:
-        sort bidders by (choice, rank), segmented cumsum of demands."""
+    def compute_accept(state, choice, bids):
+        """Which bids hosts accept: claimants in queue order while they
+        still fit — sort bidders by (choice, rank), segmented cumsum of
+        demands. Pure; returns the accept mask. Any rank-prefix subset
+        of the result is also valid (dropping later-rank acceptances
+        only frees capacity)."""
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
         sort_host = jnp.where(bids, choice, H)  # non-bidders to the end
         perm = jnp.lexsort((rank, sort_host))
@@ -275,10 +297,13 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                          & (first_of_gh | ~p_unique)
                          & ~(p_unique & occupied))
 
-        accept = jnp.zeros(N, bool).at[perm].set(accept_sorted)
-        new_host = jnp.where(accept, choice, job_host)
+        return jnp.zeros(N, bool).at[perm].set(accept_sorted)
 
-        # Deplete host resources by the accepted demand.
+    def apply_accept(state, choice, accept):
+        """Commit accepted assignments: deplete host resources, record
+        hosts, fold group occupancy."""
+        job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
+        new_host = jnp.where(accept, choice, job_host)
         acc_host = jnp.where(accept, choice, H)
         mem_left = mem_left - jax.ops.segment_sum(
             jnp.where(accept, jobs.mem, 0.0), acc_host, num_segments=H + 1)[:H]
@@ -294,6 +319,10 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         return (new_host, mem_left, cpus_left, gpus_left, slots_left,
                 group_occ)
 
+    def accept_bids(state, choice, bids):
+        return apply_accept(state, choice, compute_accept(state, choice,
+                                                          bids))
+
     def _usable_hosts(mem_left, cpus_left, slots_left):
         # Non-gpu jobs never land on gpu hosts (constraints.clj:102-128),
         # so gpu hosts are unusable for water-fill.
@@ -306,7 +335,7 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         # cpuMemBinPacker argmax walks; cumulative-capacity windows
         # absorb the whole queue in one pass.
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
-        unassigned = plain & (job_host == NO_HOST)
+        unassigned = plain & (job_host == NO_HOST) & ~hopeless0
         usable = _usable_hosts(mem_left, cpus_left, slots_left)
         util = _fitness(0.0, 0.0, mem_left, cpus_left,
                         hosts.cap_mem, hosts.cap_cpus)
@@ -334,26 +363,61 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         # host, alternating the pairing resource so a job big on the
         # other axis doesn't hit the same misfit host forever.
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
-        unassigned = plain & (job_host == NO_HOST)
+        unassigned = plain & (job_host == NO_HOST) & ~hopeless0
         usable = _usable_hosts(mem_left, cpus_left, slots_left)
+        n_usable = jnp.sum(usable.astype(jnp.int32))
+        # fairness window: only the first n_usable unassigned jobs in
+        # QUEUE order may bid this round — size-pairing happens within
+        # the window, so a deep-queue job can't leapfrog the head the
+        # way Fenzo's sequential walk never would
+        # (scheduler.clj:524-569; head-of-line inversion audit below).
+        upos = jnp.cumsum(unassigned.astype(jnp.int32)) - 1
+        window = unassigned & (upos < n_usable)
         jdemand = jnp.where(round_i % 2 == 1, jobs.mem, jobs.cpus)
         hroom = jnp.where(round_i % 2 == 1, mem_left, cpus_left)
-        jrank_perm = jnp.argsort(jnp.where(unassigned, -jdemand, BIG))
+        jrank_perm = jnp.argsort(jnp.where(window, -jdemand, BIG))
         jrank = jnp.zeros(N, jnp.int32).at[jrank_perm].set(
             jnp.arange(N, dtype=jnp.int32))
         hperm = jnp.argsort(jnp.where(usable, -hroom, BIG))
-        n_usable = jnp.sum(usable.astype(jnp.int32))
         choice = hperm[jnp.clip(jrank, 0, H - 1)]
-        bids = unassigned & (jrank < n_usable)
+        # every window member has jrank < n_usable by construction; the
+        # window is the sole bid gate
+        bids = window
         return accept_bids(state, choice, bids), None
 
-    def dense_round(state, _):
+    def dense_round(carry, _):
+        state, hopeless = carry
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
         unassigned = jobs.valid & (job_host == NO_HOST)
+        # candidates: unassigned jobs not already PROVEN infeasible (a
+        # failed dense argmax is a proof — capacity only shrinks).
+        # Fairness window: only the queue head of the candidates bids.
+        # Sized to what the remaining capacity could plausibly absorb
+        # (total headroom over the mean candidate demand, plus one slot
+        # per usable host): under contention the window stays tight so
+        # deep-queue jobs can't leapfrog, while abundant capacity opens
+        # it wide enough to never throttle throughput. Hopeless jobs
+        # drop out so the window always advances.
+        candidates = unassigned & ~hopeless
+        dense_usable = (hosts.valid & (slots_left > 0)
+                        & ((mem_left > 1e-6) | (cpus_left > 1e-6)
+                           | (gpus_left > 1e-6)))
+        K = jnp.sum(dense_usable.astype(jnp.int32))
+        n_cand = jnp.maximum(jnp.sum(candidates.astype(jnp.int32)), 1)
+        mean_mem = jnp.maximum(
+            jnp.sum(jnp.where(candidates, jobs.mem, 0.0)) / n_cand, 1e-6)
+        mean_cpus = jnp.maximum(
+            jnp.sum(jnp.where(candidates, jobs.cpus, 0.0)) / n_cand, 1e-6)
+        absorb = jnp.sum(jnp.where(
+            dense_usable,
+            jnp.minimum(mem_left / mean_mem, cpus_left / mean_cpus), 0.0))
+        W = K + absorb.astype(jnp.int32)
+        upos = jnp.cumsum(candidates.astype(jnp.int32)) - 1
+        window = candidates & (upos < W)
 
         if use_pallas:
             jobs_packed = pallas_match.pack_jobs(
-                jobs.mem, jobs.cpus, jobs.gpus, unassigned,
+                jobs.mem, jobs.cpus, jobs.gpus, candidates,
                 jobs.unique_group)
             hosts_packed = pallas_match.pack_hosts(
                 mem_left, cpus_left, gpus_left, hosts.cap_mem,
@@ -363,7 +427,9 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                 jobs_packed, hosts_packed, forb_u8, bonus,
                 interpret=pallas_interpret, spread=spread)
             choice = jnp.clip(best, 0, H - 1)
-            bids = best_fit > -0.5
+            has_feasible = best_fit > -0.5
+            hopeless = hopeless | (candidates & ~has_feasible)
+            bids = window & has_feasible
         else:
             ok = _feasible(jobs.mem[:, None], jobs.cpus[:, None],
                            jobs.gpus[:, None],
@@ -371,7 +437,7 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                            gpus_left[None, :],
                            hosts.cap_gpus[None, :], hosts.valid[None, :],
                            slots_left[None, :], forbidden)
-            ok &= unassigned[:, None]
+            ok &= candidates[:, None]
             # group-unique vs assignments from previous rounds
             ok &= ~(jobs.unique_group[:, None] & group_occ[gclip])
             fit = _fitness(jobs.mem[:, None], jobs.cpus[:, None],
@@ -394,13 +460,35 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                 / 65536.0 * spread
             fit = jnp.where(ok, fit + noise, -1.0)
             choice = jnp.argmax(fit, axis=1)
-            bids = fit[rank, choice] > -0.5  # job has any feasible host
+            has_feasible = fit[rank, choice] > -0.5
+            hopeless = hopeless | (candidates & ~has_feasible)
+            bids = window & has_feasible
 
-        return accept_bids(state, choice, bids), None
+        return (accept_bids(state, choice, bids), hopeless), None
 
     state = (varying_full(jobs.valid, NO_HOST, (N,), jnp.int32),
              hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots,
              varying_full(hosts.valid, False, (num_groups, H), bool))
+    hopeless0 = varying_full(jobs.valid, False, (N,), bool)
+    S = min(head_exact, N)
+    if S > 0:
+        # exact sequential head (Fenzo's walk): by construction the
+        # first S queue positions cannot suffer a head-of-line inversion
+        head_jobs = Jobs(mem=jobs.mem[:S], cpus=jobs.cpus[:S],
+                         gpus=jobs.gpus[:S], valid=jobs.valid[:S],
+                         group=jobs.group[:S],
+                         unique_group=jobs.unique_group[:S])
+        head_bonus = (bonus[:S] if bonus is not None else
+                      varying_full(hosts.valid, 0.0, (S, H), jnp.float32))
+        carry, head_hosts = _scan_assign(
+            head_jobs, hosts, forbidden[:S], head_bonus, num_groups,
+            state[1:])
+        job_host0 = jnp.concatenate(
+            [head_hosts, varying_full(jobs.valid, NO_HOST, (N - S,),
+                                      jnp.int32)])
+        state = (job_host0, *carry)
+        hopeless0 = hopeless0.at[:S].set(
+            head_jobs.valid & (head_hosts == NO_HOST))
     if rounds > 0:
         state = window_round(state)
     if rounds > 1:
@@ -413,13 +501,85 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         # with only single-axis room left) still deserve the exact
         # argmax before the cycle gives up on them.
         def run_dense(s):
-            s, _ = jax.lax.scan(dense_round, s, None, length=dense_rounds)
+            (s, _), _ = jax.lax.scan(
+                dense_round, (s, hopeless0), None, length=dense_rounds)
             return s
 
-        need_dense = jnp.any(jobs.valid & (state[0] == NO_HOST))
+        need_dense = jnp.any(jobs.valid & (state[0] == NO_HOST)
+                             & ~hopeless0)
         state = jax.lax.cond(need_dense, run_dense, lambda s: s, state)
     job_host, mem_left, cpus_left, gpus_left, _, _ = state
     return MatchResult(job_host, mem_left, cpus_left, gpus_left)
+
+
+def count_inversions_np(jobs: Jobs, hosts: Hosts, forbidden,
+                        job_host) -> int:
+    return len(inversion_positions_np(jobs, hosts, forbidden, job_host))
+
+
+def inversion_positions_np(jobs: Jobs, hosts: Hosts, forbidden,
+                           job_host):
+    """Queue positions of head-of-line inversions in a finished
+    assignment (host-side audit, numpy). An inversion is a valid
+    unmatched job that would fit on some allowed host if only
+    HIGHER-ranked (earlier-queue) matched jobs consumed capacity —
+    i.e. a job that can claim it was starved by lower-priority traffic.
+    Fenzo's sequential walk (scheduler.clj:524-569) produces zero by
+    construction; the batched matcher is audited against the same
+    yardstick. match_rounds' contract (enforced by
+    tests/test_match.py): the first head_exact queue positions run the
+    exact sequential scan and cannot invert; later rounds only bid
+    within the queue-head window, bounding how far any leapfrog
+    reaches.
+
+    O(U x M) for U unmatched, M matched — cheap when the matcher does
+    its job. gpus/slots are included in the feasibility check;
+    unique-group jobs are skipped (their group-occupancy coupling is
+    not modeled here, so they would audit as false positives).
+    """
+    import numpy as np
+
+    mem = np.asarray(jobs.mem)
+    cpus = np.asarray(jobs.cpus)
+    gpus = np.asarray(jobs.gpus)
+    valid = np.asarray(jobs.valid)
+    jh = np.asarray(job_host)
+    forb = np.asarray(forbidden)
+    H = np.asarray(hosts.mem).shape[0]
+    h_mem = np.asarray(hosts.mem)
+    h_cpus = np.asarray(hosts.cpus)
+    h_gpus = np.asarray(hosts.gpus)
+    h_slots = np.asarray(hosts.task_slots).astype(np.int64)
+    h_capg = np.asarray(hosts.cap_gpus)
+    h_valid = np.asarray(hosts.valid)
+
+    matched = valid & (jh >= 0)
+    m_idx = np.flatnonzero(matched)
+    m_host = jh[m_idx]
+    unmatched = np.flatnonzero(valid & (jh < 0)
+                               & ~np.asarray(jobs.unique_group))
+    inversions = []
+    for i in unmatched:
+        before = m_idx < i
+        bh = m_host[before]
+        used_mem = np.bincount(bh, weights=mem[m_idx[before]], minlength=H)
+        used_cpus = np.bincount(bh, weights=cpus[m_idx[before]],
+                                minlength=H)
+        used_gpus = np.bincount(bh, weights=gpus[m_idx[before]],
+                                minlength=H)
+        used_slots = np.bincount(bh, minlength=H)
+        ok = (h_valid
+              & ~forb[i]
+              & (h_mem - used_mem >= mem[i] - 1e-6)
+              & (h_cpus - used_cpus >= cpus[i] - 1e-6)
+              & (h_slots - used_slots > 0))
+        if gpus[i] > 0:
+            ok &= (h_capg > 0) & (h_gpus - used_gpus >= gpus[i] - 1e-6)
+        else:
+            ok &= h_capg <= 0
+        if ok.any():
+            inversions.append(int(i))
+    return np.asarray(inversions, np.int64)
 
 
 def make_jobs(mem, cpus, gpus=None, valid=None, group=None, unique_group=None):
