@@ -19,15 +19,82 @@ per-cycle latency from batch wall time; the single-shot host round-trip
 (which on a tunneled dev chip is ~100 ms of pure RTT regardless of
 payload) is reported separately as sync_rtt_ms.
 
+p99 is measured DIRECTLY: >=100 per-cycle device execution durations
+pulled from a JAX profiler trace (the per-execution `jit_<fn>` events on
+the TPU lane), not arithmetic on batch means. The marginal two-point
+batch estimate is kept as a cross-check field.
+
 Baseline: the reference's design throughput bound — Fenzo considers 1000
 jobs per 1 s match-cycle tick (config.clj:319-324, mesos.clj:102), i.e.
-~1000 decisions/sec. vs_baseline = decisions_per_sec / 1000.
+~1000 decisions/sec. vs_baseline = decisions_per_sec / 1000. This is a
+DESIGN bound, not a measured Fenzo number: the reference's own harness
+(benchmark.clj:36-57) publishes no result and needs a JVM this image
+doesn't have, so the divisor is the cadence its configuration implies.
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+BASELINE_NOTE = ("design bound: 1000 considerable/cycle at 1 s match "
+                 "cadence (config.clj:319-324, mesos.clj:102); not a "
+                 "measured Fenzo number (benchmark.clj has no published "
+                 "result and no JVM exists in this image)")
+
+
+def _profiled_cycle_histogram(fn, args, sync, fn_name, n=120,
+                              sync_every=10):
+    """Per-cycle DEVICE durations (ms) from a profiler trace.
+
+    Runs n pipelined dispatches under jax.profiler.trace and extracts
+    the per-execution `jit_<fn_name>(...)` events on the TPU process
+    lane — each is one real cycle's device time, so the p99 comes from
+    an actual per-cycle histogram instead of batch-mean arithmetic.
+    """
+    import glob
+    import gzip
+    import shutil
+    import tempfile
+
+    import jax
+
+    logdir = tempfile.mkdtemp(prefix="cook_bench_trace_")
+    try:
+        with jax.profiler.trace(logdir):
+            out = None
+            for i in range(n):
+                out = fn(*args)
+                if (i + 1) % sync_every == 0:
+                    sync(out)      # bound the in-flight queue
+            sync(out)
+        try:
+            paths = sorted(glob.glob(
+                os.path.join(logdir, "**", "*.trace.json.gz"),
+                recursive=True))
+            if not paths:
+                return np.asarray([])
+            with gzip.open(paths[-1], "rt") as f:
+                data = json.load(f)
+            events = data.get("traceEvents", [])
+            device_pids = {
+                e["pid"] for e in events
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "TPU" in str((e.get("args") or {}).get("name", ""))}
+            durs = [(e.get("ts", 0), e["dur"] / 1e3) for e in events
+                    if e.get("ph") == "X" and e.get("dur")
+                    and e.get("pid") in device_pids
+                    and e.get("name", "").startswith(f"jit_{fn_name}")]
+            durs.sort()
+            return np.asarray([d for _, d in durs])
+        except Exception:
+            # a torn/unparseable trace must not kill the run after all
+            # measurement work finished; the caller falls back to the
+            # marginal estimate
+            return np.asarray([])
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
 
 
 def _cycle_setup(R, P, H, U, seed=0):
@@ -121,21 +188,40 @@ def bench_cycle(R=10_000, P=100_000, H=10_000, U=500, C=8_192,
     for _ in range(1):
         out = fn(*args)
     job_host = sync(out)
-
     matched = int((job_host >= 0).sum())
-    mean_ms = float(np.mean(per_cycle_ms))
+    marginal_mean_ms = float(np.mean(per_cycle_ms))
+
+    # direct per-cycle device histogram (>=100 real executions)
+    hist = _profiled_cycle_histogram(fn, args, sync, "rank_and_match",
+                                     n=120)
+    hist = hist[-110:]
+    if len(hist) >= 100:
+        mean_ms = float(np.mean(hist))
+        p99 = float(np.percentile(hist, 99))
+        p99_method = (f"p99 of {len(hist)} per-cycle device executions "
+                      "(profiler trace)")
+    else:   # profiler unavailable: fall back to the marginal estimate
+        mean_ms = marginal_mean_ms
+        p99 = float(np.percentile(per_cycle_ms, 99))
+        p99_method = (f"p99 over {NPAIR} marginal samples "
+                      f"(batch{B2} - batch{B1})/{B2 - B1}, pipelined "
+                      "(profiler trace unavailable)")
     dps = matched / (mean_ms / 1e3)
-    p99 = float(np.percentile(per_cycle_ms, 99))
 
     print(json.dumps({
         "metric": f"sched decisions/sec @ {label}",
         "value": round(dps, 1),
         "unit": "decisions/sec",
         "vs_baseline": round(dps / 1000.0, 2),
+        "baseline_note": BASELINE_NOTE,
         "p99_cycle_ms": round(p99, 2),
-        "p99_method": (f"p99 over {NPAIR} marginal samples "
-                       f"(batch{B2} - batch{B1})/{B2 - B1}, pipelined"),
+        "p99_method": p99_method,
         "mean_cycle_ms": round(mean_ms, 2),
+        "p50_cycle_ms": round(float(np.percentile(hist, 50)), 2)
+        if len(hist) >= 100 else None,
+        "max_cycle_ms": round(float(hist.max()), 2)
+        if len(hist) >= 100 else None,
+        "marginal_mean_cycle_ms": round(marginal_mean_ms, 2),
         "matched_per_cycle": matched,
         "sync_rtt_ms": round(sync_rtt_ms, 2),
         "compile_s": round(compile_s, 1),

@@ -411,7 +411,10 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         absorb = jnp.sum(jnp.where(
             dense_usable,
             jnp.minimum(mem_left / mean_mem, cpus_left / mean_cpus), 0.0))
-        W = K + absorb.astype(jnp.int32)
+        # clamp before the s32 cast: near-zero mean demand (gpu-only
+        # candidates) can push absorb past 2^31 and an overflowing cast
+        # would wrap W negative, silencing every dense bid
+        W = K + jnp.minimum(absorb, jnp.float32(N)).astype(jnp.int32)
         upos = jnp.cumsum(candidates.astype(jnp.int32)) - 1
         window = candidates & (upos < W)
 
@@ -512,11 +515,6 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     return MatchResult(job_host, mem_left, cpus_left, gpus_left)
 
 
-def count_inversions_np(jobs: Jobs, hosts: Hosts, forbidden,
-                        job_host) -> int:
-    return len(inversion_positions_np(jobs, hosts, forbidden, job_host))
-
-
 def inversion_positions_np(jobs: Jobs, hosts: Hosts, forbidden,
                            job_host):
     """Queue positions of head-of-line inversions in a finished
@@ -568,13 +566,17 @@ def inversion_positions_np(jobs: Jobs, hosts: Hosts, forbidden,
         used_gpus = np.bincount(bh, weights=gpus[m_idx[before]],
                                 minlength=H)
         used_slots = np.bincount(bh, minlength=H)
+        # tolerance matches f32 accumulation in the kernel (the audit
+        # recomputes consumption in f64): a job the kernel's f32 state
+        # legitimately refused must not audit as an inversion
+        tol = 1e-2
         ok = (h_valid
               & ~forb[i]
-              & (h_mem - used_mem >= mem[i] - 1e-6)
-              & (h_cpus - used_cpus >= cpus[i] - 1e-6)
+              & (h_mem - used_mem >= mem[i] + tol)
+              & (h_cpus - used_cpus >= cpus[i] + tol)
               & (h_slots - used_slots > 0))
         if gpus[i] > 0:
-            ok &= (h_capg > 0) & (h_gpus - used_gpus >= gpus[i] - 1e-6)
+            ok &= (h_capg > 0) & (h_gpus - used_gpus >= gpus[i] + tol)
         else:
             ok &= h_capg <= 0
         if ok.any():
