@@ -86,6 +86,9 @@ class ApiServerStandIn:
         self._oldest_rv = 0
         self._streams: list[tuple[str, queue.Queue]] = []
         self._events: list[dict] = []      # CoreV1Event objects
+        # coordination.k8s.io/v1 Lease objects (leader election); writes
+        # are resourceVersion compare-and-swap like a real apiserver
+        self._leases: dict[str, dict] = {}
         self.list_counts = {"pods": 0, "nodes": 0}   # test observability
         self.fake.watch_pods(self._on_pod)
         self.fake.watch_nodes(self._on_node)
@@ -102,6 +105,9 @@ class ApiServerStandIn:
 
             def do_POST(self):
                 standin._handle(self, "POST")
+
+            def do_PUT(self):
+                standin._handle(self, "PUT")
 
             def do_DELETE(self):
                 standin._handle(self, "DELETE")
@@ -199,6 +205,11 @@ class ApiServerStandIn:
     def _route(self, h, method: str, parts: list[str], qs: dict) -> None:
         ns_pods = ["api", "v1", "namespaces", self.namespace, "pods"]
         ns_events = ["api", "v1", "namespaces", self.namespace, "events"]
+        ns_leases = ["apis", "coordination.k8s.io", "v1", "namespaces",
+                     self.namespace, "leases"]
+        if parts[:6] == ns_leases:
+            self._route_lease(h, method, parts[6:])
+            return
         if method == "GET" and parts == ns_pods:
             if qs.get("watch", ["false"])[0] == "true":
                 self._serve_watch(h, "pods", qs)
@@ -267,6 +278,65 @@ class ApiServerStandIn:
         else:
             self._send_json(h, 404, {"kind": "Status", "code": 404,
                                      "message": f"no route {parts}"})
+
+    def _route_lease(self, h, method: str, tail: list[str]) -> None:
+        """coordination.k8s.io Lease CRUD with resourceVersion CAS —
+        the mutual-exclusion primitive LeaseElector's takeover races
+        ride on (a stale resourceVersion loses with 409)."""
+        def read_body():
+            length = int(h.headers.get("Content-Length", 0))
+            return json.loads(h.rfile.read(length).decode() or "{}")
+
+        if method == "GET" and len(tail) == 1:
+            with self._lock:
+                lease = self._leases.get(tail[0])
+            if lease is None:
+                self._send_json(h, 404, {"kind": "Status", "code": 404,
+                                         "reason": "NotFound"})
+            else:
+                self._send_json(h, 200, lease)
+        elif method == "POST" and not tail:
+            body = read_body()
+            name = body.get("metadata", {}).get("name", "")
+            with self._lock:
+                if name in self._leases:
+                    self._send_json(h, 409, {"kind": "Status", "code": 409,
+                                             "reason": "AlreadyExists"})
+                    return
+                self._rv += 1
+                body.setdefault("metadata", {})["resourceVersion"] = \
+                    str(self._rv)
+                self._leases[name] = body
+            self._send_json(h, 201, body)
+        elif method == "PUT" and len(tail) == 1:
+            body = read_body()
+            name = tail[0]
+            want_rv = body.get("metadata", {}).get("resourceVersion")
+            with self._lock:
+                cur = self._leases.get(name)
+                if cur is None:
+                    self._send_json(h, 404, {"kind": "Status", "code": 404,
+                                             "reason": "NotFound"})
+                    return
+                cur_rv = cur.get("metadata", {}).get("resourceVersion")
+                if want_rv != cur_rv:
+                    self._send_json(h, 409, {"kind": "Status", "code": 409,
+                                             "reason": "Conflict"})
+                    return
+                self._rv += 1
+                body.setdefault("metadata", {})["resourceVersion"] = \
+                    str(self._rv)
+                self._leases[name] = body
+            self._send_json(h, 200, body)
+        elif method == "DELETE" and len(tail) == 1:
+            with self._lock:
+                gone = self._leases.pop(tail[0], None)
+            self._send_json(h, 200 if gone else 404,
+                            {"kind": "Status",
+                             "status": "Success" if gone else "Failure"})
+        else:
+            self._send_json(h, 404, {"kind": "Status", "code": 404,
+                                     "message": "no lease route"})
 
     def _serve_watch(self, h, resource: str, qs: dict) -> None:
         rv = int(qs.get("resourceVersion", ["0"])[0] or 0)

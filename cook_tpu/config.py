@@ -132,6 +132,14 @@ class Settings:
     log_path: Optional[str] = None
     snapshot_path: Optional[str] = None
     leader_lock_path: Optional[str] = None   # None = standalone leader
+    # distributed HA via Kubernetes Lease objects (no shared FS): point
+    # at an apiserver and every candidate races for the named lease
+    leader_lease_url: str = ""
+    leader_lease_name: str = "cook-leader"
+    leader_lease_namespace: str = "cook"
+    leader_lease_duration_s: float = 10.0
+    leader_lease_token: str = ""
+    leader_lease_token_path: str = ""   # e.g. the in-cluster SA token
     url: str = ""                             # published leader URL
     metrics_jsonl: Optional[str] = None
     metrics_interval_s: float = 60.0

@@ -302,6 +302,19 @@ def main(argv=None) -> None:
 
     if args.no_cycles:
         elector = StandaloneElector(settings.url)
+    elif settings.leader_lease_url:
+        from cook_tpu.scheduler.leader import LeaseElector
+        token = settings.leader_lease_token
+        if not token and settings.leader_lease_token_path:
+            with open(settings.leader_lease_token_path) as f:
+                token = f.read().strip()
+        elector = LeaseElector(
+            settings.leader_lease_url, settings.url,
+            name=settings.leader_lease_name,
+            namespace=settings.leader_lease_namespace,
+            lease_duration_s=settings.leader_lease_duration_s,
+            token=token or None)
+        elector.start(on_leadership)
     elif settings.leader_lock_path:
         elector = FileLeaderElector(settings.leader_lock_path, settings.url)
         elector.start(on_leadership)

@@ -10,20 +10,32 @@ Curator LeaderSelector on ZooKeeper):
     in-memory state is never trusted;
   - non-leaders can serve the read API only (components.clj:101-105).
 
-The elector protocol is pluggable like the reference's curator layer;
-FileLeaderElector implements it with an fcntl file lock + a lease file
-naming the current leader (single-host / shared-filesystem HA).  A
-ZK/etcd/k8s-Lease elector drops into the same interface.
+The elector protocol is pluggable like the reference's curator layer.
+Implementations:
+  StandaloneElector   no-HA single instance
+  FileLeaderElector   fcntl file lock (single host / shared filesystem)
+  LeaseElector        Kubernetes coordination.k8s.io/v1 Lease objects
+                      over plain HTTP — distributed HA with no shared
+                      filesystem, the modern stand-in for the
+                      reference's Curator-on-ZooKeeper
+                      (mesos.clj:111-270). Mutual exclusion rides the
+                      apiserver's resourceVersion compare-and-swap
+                      (409 Conflict on a lost race), exactly like
+                      client-go's leaderelection package.
 """
 from __future__ import annotations
 
+import datetime
 import fcntl
 import json
 import logging
 import os
+import socket
 import threading
 import time
 from typing import Callable, Optional
+
+from cook_tpu.utils.httpjson import json_request
 
 log = logging.getLogger(__name__)
 
@@ -153,3 +165,260 @@ class FileLeaderElector(LeaderElector):
         if self._thread:
             self._thread.join(timeout=3)
         self._release()
+
+
+def _rfc3339(t: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        t, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse_rfc3339(s: str) -> float:
+    return datetime.datetime.strptime(
+        s, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+        tzinfo=datetime.timezone.utc).timestamp()
+
+
+class LeaseElector(LeaderElector):
+    """Distributed elector on a Kubernetes Lease object.
+
+    Campaign: read the Lease; if absent, create it naming us; if held
+    but expired (renewTime + leaseDurationSeconds < now), take it over
+    with a resourceVersion-preconditioned update — a concurrent
+    takeover loses with 409 and goes back to waiting. While leader,
+    renew every duration/3; losing the renewal race or failing to renew
+    for a full lease duration triggers on_loss (suicide by default,
+    mesos.clj:247-261). holderIdentity doubles as the published leader
+    URL."""
+
+    def __init__(self, apiserver_url: str, url: str,
+                 name: str = "cook-leader", namespace: str = "cook",
+                 lease_duration_s: float = 10.0,
+                 retry_interval_s: Optional[float] = None,
+                 token: Optional[str] = None,
+                 on_loss: Optional[Callable[[], None]] = None,
+                 identity: Optional[str] = None):
+        self.base = apiserver_url.rstrip("/")
+        self.url = url
+        self.name = name
+        self.namespace = namespace
+        self.duration_s = lease_duration_s
+        self.retry_interval_s = retry_interval_s or lease_duration_s / 3.0
+        self.token = token
+        self.on_loss = on_loss or FileLeaderElector._suicide
+        # identity must be REPLICA-unique, never the (shared) service
+        # URL: replicas sharing an identity would all pass the
+        # holder==self check and run concurrently (client-go defaults
+        # to the pod-unique hostname for the same reason)
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (holder_url, observed_at) cache fed by the campaign/renew
+        # loop so current_leader() doesn't GET the apiserver per call
+        self._observed: tuple[Optional[str], float] = (None, 0.0)
+
+    # -- wire ----------------------------------------------------------
+    def _path(self) -> str:
+        return (f"/apis/coordination.k8s.io/v1/namespaces/"
+                f"{self.namespace}/leases/{self.name}")
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.token}"} if self.token \
+            else {}
+
+    def _get(self) -> Optional[dict]:
+        import urllib.error
+        try:
+            lease = json_request("GET", self.base + self._path(),
+                                 headers=self._headers(), timeout=5.0)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                self._observed = (None, time.time())
+                return None
+            raise
+        self._observed = (self._holder_url_of(lease), time.time())
+        return lease
+
+    def _holder_url_of(self, lease: Optional[dict]) -> Optional[str]:
+        if lease is None:
+            return None
+        spec = lease.get("spec", {})
+        renew = spec.get("renewTime", "")
+        duration = float(spec.get("leaseDurationSeconds",
+                                  self.duration_s))
+        try:
+            if renew and _parse_rfc3339(renew) + duration < time.time():
+                return None
+        except ValueError:
+            pass
+        return spec.get("holderUrl") or spec.get("holderIdentity")
+
+    def _lease_body(self, transitions: int, rv: Optional[str]) -> dict:
+        now = _rfc3339(time.time())
+        meta: dict = {"name": self.name, "namespace": self.namespace}
+        if rv is not None:
+            meta["resourceVersion"] = rv
+        return {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": meta,
+            "spec": {"holderIdentity": self.identity,
+                     "holderUrl": self.url,
+                     "leaseDurationSeconds": int(self.duration_s),
+                     "renewTime": now,
+                     "leaseTransitions": transitions},
+        }
+
+    def _try_acquire(self) -> bool:
+        import urllib.error
+        try:
+            lease = self._get()
+            if lease is None:
+                json_request(
+                    "POST",
+                    self.base + self._path().rsplit("/", 1)[0],
+                    self._lease_body(0, None),
+                    headers=self._headers(), timeout=5.0)
+                self._observed = (self.url, time.time())
+                return True
+            spec = lease.get("spec", {})
+            holder = spec.get("holderIdentity", "")
+            renew = spec.get("renewTime", "")
+            # judge expiry by the lease's RECORDED duration, not our
+            # configured one — a candidate with a shorter setting must
+            # not steal a live lease during a rolling config change
+            duration = float(spec.get("leaseDurationSeconds",
+                                      self.duration_s))
+            expired = not holder        # a cleanly released lease
+            if renew and holder:
+                try:
+                    expired = _parse_rfc3339(renew) + duration \
+                        < time.time()
+                except ValueError:
+                    # refuse to steal what we can't evaluate
+                    expired = False
+            if holder != self.identity and not expired:
+                return False
+            transitions = int(spec.get("leaseTransitions", 0)) + \
+                (1 if holder != self.identity else 0)
+            json_request(
+                "PUT", self.base + self._path(),
+                self._lease_body(
+                    transitions,
+                    lease.get("metadata", {}).get("resourceVersion")),
+                headers=self._headers(), timeout=5.0)
+            self._observed = (self.url, time.time())
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:      # lost the race
+                return False
+            raise
+
+    def _renew(self) -> bool:
+        """One renewal attempt; False when the lease is gone or held by
+        someone else (we lost)."""
+        import urllib.error
+        try:
+            lease = self._get()
+            if lease is None or \
+                    lease.get("spec", {}).get("holderIdentity") \
+                    != self.identity:
+                return False
+            json_request(
+                "PUT", self.base + self._path(),
+                self._lease_body(
+                    int(lease["spec"].get("leaseTransitions", 0)),
+                    lease.get("metadata", {}).get("resourceVersion")),
+                headers=self._headers(), timeout=5.0)
+            self._observed = (self.url, time.time())
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 409):
+                return False
+            raise
+
+    # -- protocol ------------------------------------------------------
+    def start(self, on_leadership: Callable[[], None]) -> None:
+        def campaign():
+            while not self._stop.is_set():
+                try:
+                    acquired = self._try_acquire()
+                except Exception as e:
+                    log.warning("lease campaign error: %s", e)
+                    acquired = False
+                if not acquired:
+                    self._stop.wait(self.retry_interval_s)
+                    continue
+                self._leader = True
+                log.info("acquired leadership lease %s as %s",
+                         self.name, self.identity)
+                try:
+                    on_leadership()
+                except Exception:
+                    log.exception("on_leadership failed")
+                    self._leader = False
+                    self.on_loss()
+                    return
+                last_renewed = time.time()
+                while not self._stop.wait(self.duration_s / 3.0):
+                    try:
+                        if self._renew():
+                            last_renewed = time.time()
+                        else:
+                            self._leader = False
+                            self.on_loss()
+                            return
+                    except Exception as e:
+                        log.warning("lease renewal error: %s", e)
+                        if time.time() - last_renewed > self.duration_s:
+                            # can't prove we still hold it: step down
+                            self._leader = False
+                            self.on_loss()
+                            return
+                self._leader = False
+                return
+        self._thread = threading.Thread(target=campaign, daemon=True)
+        self._thread.start()
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def current_leader(self) -> Optional[str]:
+        # serve from the campaign/renew loop's observation when fresh
+        # (/info calls this per request; a blocking apiserver GET per
+        # request would hammer the apiserver and stall during outages)
+        holder, seen = self._observed
+        if time.time() - seen <= self.duration_s / 3.0:
+            return holder
+        try:
+            return self._holder_url_of(self._get())
+        except Exception:
+            return None
+
+    def _release_lease(self) -> None:
+        """Clear the holder on clean shutdown so the successor doesn't
+        wait out the TTL (client-go's ReleaseOnCancel)."""
+        import urllib.error
+        try:
+            lease = self._get()
+            if lease is None or \
+                    lease.get("spec", {}).get("holderIdentity") \
+                    != self.identity:
+                return
+            body = self._lease_body(
+                int(lease["spec"].get("leaseTransitions", 0)),
+                lease.get("metadata", {}).get("resourceVersion"))
+            body["spec"]["holderIdentity"] = ""
+            body["spec"]["holderUrl"] = ""
+            json_request("PUT", self.base + self._path(), body,
+                         headers=self._headers(), timeout=5.0)
+        except (urllib.error.HTTPError, OSError):
+            pass                     # successor falls back to the TTL
+
+    def stop(self) -> None:
+        was_leader = self._leader
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+        self._leader = False
+        if was_leader:
+            self._release_lease()

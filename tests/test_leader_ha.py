@@ -1,0 +1,206 @@
+"""Distributed HA leader election on Kubernetes Lease objects.
+
+The reference tier: Curator LeaderSelector on ZooKeeper + the
+integration suite's master/slave test (mesos.clj:111-270,
+integration/tests/cook/test_master_slave.py): two schedulers, kill the
+leader, the standby takes over within the lease TTL, and no work is
+ever performed twice.
+"""
+import threading
+import time
+
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.kube.standin import ApiServerStandIn
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.scheduler.leader import LeaseElector
+from cook_tpu.state.model import Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+
+
+def wait_until(fn, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"condition not met within {timeout}s")
+
+
+@pytest.fixture
+def apiserver():
+    s = ApiServerStandIn()
+    yield s
+    s.close()
+
+
+def make_elector(apiserver, ident, duration=1.0, on_loss=None):
+    return LeaseElector(apiserver.url, url=f"http://{ident}",
+                        identity=ident, lease_duration_s=duration,
+                        retry_interval_s=0.1,
+                        on_loss=on_loss or (lambda: None))
+
+
+def test_single_candidate_acquires_and_renews(apiserver):
+    got = threading.Event()
+    e = make_elector(apiserver, "n1")
+    e.start(lambda: got.set())
+    wait_until(got.is_set)
+    assert e.is_leader()
+    assert e.current_leader() == "http://n1"
+    # lease survives several renewal periods
+    time.sleep(1.5)
+    assert e.is_leader() and e.current_leader() == "http://n1"
+    e.stop()
+
+
+def test_failover_within_ttl_no_double_leadership(apiserver):
+    """Kill the leader (stop renewing without releasing): the standby
+    takes over within the lease TTL; at no point do both believe they
+    lead."""
+    lead_a, lead_b = threading.Event(), threading.Event()
+    lost_a = threading.Event()
+    a = make_elector(apiserver, "a", on_loss=lost_a.set)
+    b = make_elector(apiserver, "b")
+    a.start(lambda: lead_a.set())
+    wait_until(lead_a.is_set)
+    b.start(lambda: lead_b.set())
+    # standby stays standby while the leader renews
+    time.sleep(1.0)
+    assert not b.is_leader()
+    overlap = []
+
+    def watch():
+        while not lead_b.is_set():
+            if a.is_leader() and b.is_leader():
+                overlap.append(time.time())
+            time.sleep(0.005)
+
+    w = threading.Thread(target=watch, daemon=True)
+    w.start()
+    # "SIGKILL": the leader's renew loop dies without cleanup
+    t_kill = time.time()
+    a._stop.set()
+    a._thread.join(timeout=3)
+    a._leader = False
+    wait_until(lead_b.is_set, timeout=10)
+    takeover_s = time.time() - t_kill
+    w.join(timeout=3)
+    assert overlap == []
+    # within TTL + one retry interval of slack
+    assert takeover_s < a.duration_s + 1.0
+    assert b.current_leader() == "http://b"
+    b.stop()
+
+
+def test_graceful_stop_releases_lease(apiserver):
+    """A clean shutdown clears the holder so the successor doesn't wait
+    out the TTL (client-go ReleaseOnCancel)."""
+    lead_a, lead_b = threading.Event(), threading.Event()
+    a = make_elector(apiserver, "a", duration=30.0)   # long TTL
+    a.start(lambda: lead_a.set())
+    wait_until(lead_a.is_set)
+    b = make_elector(apiserver, "b", duration=30.0)
+    b.start(lambda: lead_b.set())
+    t0 = time.time()
+    a.stop()                                          # graceful release
+    wait_until(lead_b.is_set, timeout=5)
+    # takeover far inside the 30s TTL: the release, not expiry, did it
+    assert time.time() - t0 < 3.0
+    b.stop()
+
+
+def test_loser_of_takeover_race_steps_back(apiserver):
+    """Two standbys race an expired lease: resourceVersion CAS lets
+    exactly one through; the loser keeps waiting."""
+    import urllib.error
+
+    # seed an expired lease held by a dead node
+    dead = make_elector(apiserver, "dead", duration=0.5)
+    got = threading.Event()
+    dead.start(lambda: got.set())
+    wait_until(got.is_set)
+    dead._stop.set()
+    dead._thread.join(timeout=3)
+    time.sleep(0.8)                      # let it expire
+
+    la, lb = threading.Event(), threading.Event()
+    a = make_elector(apiserver, "a")
+    b = make_elector(apiserver, "b")
+    a.start(lambda: la.set())
+    b.start(lambda: lb.set())
+    wait_until(lambda: la.is_set() or lb.is_set())
+    time.sleep(0.5)
+    assert la.is_set() != lb.is_set()    # exactly one won
+    winner = "http://a" if la.is_set() else "http://b"
+    assert a.current_leader() == winner
+    a.stop()
+    b.stop()
+
+
+def test_leadership_loss_triggers_on_loss(apiserver):
+    """An external takeover (lease stolen) must trigger the suicide
+    hook on the old leader (mesos.clj:247-261 semantics)."""
+    lost = threading.Event()
+    got = threading.Event()
+    a = make_elector(apiserver, "a", on_loss=lost.set)
+    a.start(lambda: got.set())
+    wait_until(got.is_set)
+    # steal the lease out from under it
+    with apiserver._lock:
+        lease = apiserver._leases["cook-leader"]
+        lease["spec"]["holderIdentity"] = "thief"
+        apiserver._rv += 1
+        lease["metadata"]["resourceVersion"] = str(apiserver._rv)
+    wait_until(lost.is_set, timeout=5)
+    assert not a.is_leader()
+    a.stop()
+
+
+def test_failover_no_double_launch(apiserver):
+    """Two coordinator nodes over one durable store: only the leader
+    runs match cycles; after the leader dies the standby takes over and
+    the pending job launches exactly once (test_master_slave.py tier)."""
+    store = JobStore()
+
+    def make_node(ident, on_loss=None):
+        cluster = MockCluster([MockHost(f"{ident}-h0", mem=1000, cpus=16)])
+        reg = ClusterRegistry()
+        reg.register(cluster)
+        coord = Coordinator(store, reg)
+        lead = threading.Event()
+        e = make_elector(apiserver, ident, on_loss=on_loss)
+        e.start(lambda: lead.set())
+        return coord, e, lead
+
+    coord_a, ea, lead_a = make_node("a")
+    wait_until(lead_a.is_set)
+    coord_b, eb, lead_b = make_node("b")
+
+    job = Job(uuid=new_uuid(), user="u", command="true", mem=100, cpus=1,
+              max_retries=1)
+    store.create_jobs([job])
+    # both nodes tick; only the leader matches
+    for coord, e in ((coord_a, ea), (coord_b, eb)):
+        if e.is_leader():
+            coord.match_cycle()
+    assert len(job.instances) == 1
+    assert job.instances[0].hostname == "a-h0"
+
+    job2 = Job(uuid=new_uuid(), user="u", command="true", mem=100, cpus=1,
+               max_retries=1)
+    store.create_jobs([job2])
+    # leader dies before handling job2
+    ea._stop.set()
+    ea._thread.join(timeout=3)
+    ea._leader = False
+    wait_until(lead_b.is_set, timeout=10)
+    for coord, e in ((coord_a, ea), (coord_b, eb)):
+        if e.is_leader():
+            coord.match_cycle()
+    assert len(job2.instances) == 1      # exactly once, on the new leader
+    assert job2.instances[0].hostname == "b-h0"
+    eb.stop()
