@@ -101,6 +101,9 @@ class SchedulerSettings:
     rebalancer_max_preemption: int = 64
     sequential_match_threshold: int = 2048
     use_pallas: bool = False            # fused TPU kernel for dense rounds
+    # hash-sharded in-order status executors (scheduler.clj:1524-1546);
+    # 0 = inline on the backend callback thread
+    status_shards: int = 19
 
     def validate(self) -> None:
         if self.max_jobs_considered < 1:

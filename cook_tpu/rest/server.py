@@ -223,7 +223,8 @@ def build_scheduler(config):
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,
         plugins=plugins, data_locality=data_locality,
-        checkpoint_defaults=config.checkpoint or None)
+        checkpoint_defaults=config.checkpoint or None,
+        status_shards=s.status_shards)
 
     monitor = StatsMonitor(store, coord.shares, metrics_mod.registry)
     api = CookApi(

@@ -114,7 +114,8 @@ class Coordinator:
                  user_launch_rate_limiter: Optional[RateLimiter] = None,
                  progress_aggregator=None, heartbeats=None,
                  plugins=None, data_locality=None,
-                 checkpoint_defaults: Optional[dict] = None):
+                 checkpoint_defaults: Optional[dict] = None,
+                 status_shards: int = 0):
         self.store = store
         self.clusters = clusters
         self.shares = shares or ShareStore()
@@ -169,8 +170,32 @@ class Coordinator:
             self.forbidden_builder = NativeForbiddenBuilder.create()
         except Exception:
             self.forbidden_builder = None
+        # hash-sharded in-order status executors
+        # (async-in-order-processing scheduler.clj:1524-1546): backend
+        # callbacks enqueue and return instead of running the store
+        # write inline on the backend's thread. 0 = inline (unit tests
+        # rely on synchronous effects; the server enables shards).
+        self.status_shards = None
+        if status_shards > 0:
+            from cook_tpu.scheduler.shards import InOrderShards
+            self.status_shards = InOrderShards(status_shards,
+                                               self._on_status)
+        # per-cluster launch futures (launch-matched-tasks!
+        # scheduler.clj:791-805): a slow backend must not serialize the
+        # other clusters' launches
+        from concurrent.futures import ThreadPoolExecutor
+        self._launch_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="launch")
         for cluster in clusters.all():
-            cluster.set_status_callback(self._on_status)
+            cluster.set_status_callback(self._status_entry)
+
+    def _status_entry(self, task_id: str, status, reason=None,
+                      **extra) -> None:
+        if self.status_shards is not None:
+            self.status_shards.submit(task_id, task_id, status, reason,
+                                      **extra)
+        else:
+            self._on_status(task_id, status, reason, **extra)
 
     # ------------------------------------------------------------------
     def _build_forbidden(self, jobs, host_names, host_attrs, reservations,
@@ -414,8 +439,28 @@ class Coordinator:
             self.launch_rl.spend("global")
             if job.uuid in self.reservations:
                 self.reservations.pop(job.uuid, None)
-        for cname, specs in by_cluster.items():
-            self.clusters.get(cname).launch_tasks(pool, specs)
+        # per-cluster launch futures (scheduler.clj:791-805): launches
+        # to independent backends proceed concurrently; the cycle still
+        # waits for all so stats and scaleback see the true outcome
+        if len(by_cluster) <= 1:
+            for cname, specs in by_cluster.items():
+                self.clusters.get(cname).launch_tasks(pool, specs)
+        else:
+            futures = {
+                cname: self._launch_pool.submit(
+                    self.clusters.get(cname).launch_tasks, pool, specs)
+                for cname, specs in by_cluster.items()}
+            # retrieve EVERY outcome before surfacing any error — a
+            # second cluster's failure must not vanish unretrieved
+            errors = []
+            for cname, f in futures.items():
+                try:
+                    f.result()
+                except Exception as e:
+                    log.exception("launch to cluster %s failed", cname)
+                    errors.append(e)
+            if errors:
+                raise errors[0]
         stats.matched = launched
 
         # placement-failure bookkeeping for /unscheduled_jobs
@@ -907,6 +952,12 @@ class Coordinator:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+        # drain queued status updates before the workers die: a dropped
+        # terminal transition would replay as RUNNING-forever after
+        # restart (the event log only has what reached the store)
+        if self.status_shards is not None:
+            self.status_shards.stop()
+        self._launch_pool.shutdown(wait=True)
 
 
 def _failure_reason_names(job: Job) -> list[str]:
