@@ -13,10 +13,10 @@ Per scan step (one job):
   4. the winning device subtracts the job's resources from its shard.
 
 Semantically identical to ops/match.match_scan for group-free batches —
-the equivalence test runs both on an 8-device CPU mesh. LIMITATION: this
-path does not yet enforce same-cycle group coupling (jobs.group /
-jobs.unique_group are ignored); callers must route batches containing
-unique-host groups through match_scan / match_rounds, which do.
+the equivalence test runs both on an 8-device CPU mesh. LIMITATION
+(enforced): this path does not model same-cycle group coupling, so the
+wrapper REFUSES batches containing unique-host groups (ValueError);
+route those through match_scan / match_rounds, which enforce it.
 """
 from __future__ import annotations
 
@@ -90,4 +90,26 @@ def sharded_match_scan(mesh: Mesh):
         _, job_host = jax.lax.scan(step, carry, xs)
         return job_host
 
-    return jax.jit(run)
+    jitted = jax.jit(run)
+
+    def guarded(jobs: match_ops.Jobs, hosts: match_ops.Hosts, forbidden):
+        # ENFORCED limitation (not just documented): same-cycle group
+        # coupling is not modeled on the sharded path — a grouped batch
+        # slipping through would silently violate unique host-placement,
+        # so refuse and let the caller route it through
+        # match_scan/match_rounds, which enforce it. Tracers can't be
+        # inspected, so composition under an outer jit skips the guard;
+        # concrete inputs (how callers hand batches over) are checked —
+        # the N-bool readback is negligible for host-built batches and
+        # accepted for device-resident ones (correctness over one RTT).
+        import numpy as _np
+        ug = jobs.unique_group
+        if not isinstance(ug, jax.core.Tracer) and \
+                bool(_np.asarray(ug).any()):
+            raise ValueError(
+                "sharded_match_scan does not support unique-host group "
+                "coupling; route grouped batches through "
+                "ops.match.match_scan / match_rounds")
+        return jitted(jobs, hosts, forbidden)
+
+    return guarded

@@ -165,4 +165,8 @@ def registry_from_config(cfg: dict) -> PluginRegistry:
         kw["pool_selector"] = resolve_plugin(cfg["pool_selector"])
     if "adjuster" in cfg:
         kw["adjuster"] = resolve_plugin(cfg["adjuster"])
+    elif "pool_mover" in cfg:
+        # plugins/pool_mover.clj: config-driven pool migration adjuster
+        from cook_tpu.plugins.pool_mover import PoolMoverAdjuster
+        kw["adjuster"] = PoolMoverAdjuster(cfg["pool_mover"])
     return PluginRegistry(**kw)

@@ -27,6 +27,7 @@ store's create_jobs/commit_jobs reproduce make-commit-latch
 from __future__ import annotations
 
 import json
+import logging
 import re
 import time
 from dataclasses import dataclass, field
@@ -43,6 +44,8 @@ from cook_tpu.state.model import (Group, Instance, InstanceStatus, Job,
                                   REASON_BY_CODE as _REASON_BY_CODE,
                                   new_uuid, now_ms)
 from cook_tpu.state.store import TransactionError
+
+log = logging.getLogger(__name__)
 
 _UUID_RE = re.compile(
     r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$", re.I)
@@ -164,7 +167,8 @@ class CookApi:
                     raise AuthError(
                         401, "agent channel requires auth.agent_token "
                              "when user auth is enabled")
-            elif path not in ("/info", "/debug"):  # conditional-auth-bypass
+            elif path not in ("/info", "/debug",
+                              "/metrics"):  # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)
             return self.router.dispatch(req)
         except AuthError as e:
@@ -209,6 +213,9 @@ class CookApi:
         r.add("GET", "/debug", self.get_debug)
         r.add("GET", "/data-local", self.data_local_status)
         r.add("GET", "/data-local/:uuid", self.data_local_costs)
+        r.add("GET", "/metrics", self.get_metrics)
+        r.add("GET", "/rebalancer", self.get_rebalancer_params)
+        r.add("POST", "/rebalancer", self.set_rebalancer_params)
         # network-agent control plane (the framework-message channel of
         # mesos_compute_cluster.clj:94-195, over HTTP)
         r.add("POST", "/agents/register", self.agent_register)
@@ -217,6 +224,53 @@ class CookApi:
         r.add("POST", "/agents/progress", self.agent_progress)
         r.add("GET", "/agents", self.agent_list)
         return r
+
+    def get_metrics(self, req: Request) -> Response:
+        """Prometheus text exposition of the metric registry (the
+        modern stand-in for the reference's Graphite/JMX reporters,
+        reporter.clj:32-82)."""
+        from cook_tpu.utils.metrics import registry, render_prometheus
+        return Response(200, render_prometheus(registry.snapshot()),
+                        headers={"Content-Type":
+                                 "text/plain; version=0.0.4"})
+
+    # -- runtime-tunable rebalancer params (rebalancer.clj:520-542:
+    # the reference stores these in Datomic, adjustable live) ----------
+    def get_rebalancer_params(self, req: Request) -> Response:
+        if self.coord is None:
+            raise ApiError(404, "no scheduler attached")
+        p = self.coord.live_rebalancer_params()
+        return Response(200, {"safe-dru-threshold": p.safe_dru_threshold,
+                              "min-dru-diff": p.min_dru_diff,
+                              "max-preemption": p.max_preemption})
+
+    def set_rebalancer_params(self, req: Request) -> Response:
+        if self.coord is None:
+            raise ApiError(404, "no scheduler attached")
+        require_authorized(self.auth, req.user, "update", None)
+        body = req.body or {}
+        import math
+
+        allowed = {"safe-dru-threshold": float, "min-dru-diff": float,
+                   "max-preemption": int}
+        updates = {}
+        for key, value in body.items():
+            conv = allowed.get(key)
+            if conv is None:
+                raise ApiError(400, f"unknown rebalancer param {key!r}")
+            try:
+                v = conv(value)
+            except (TypeError, ValueError):
+                raise ApiError(400, f"{key} must be a number")
+            # NaN would silently disable every DRU comparison; negative
+            # values make no sense for any of these knobs
+            if not math.isfinite(v) or v < 0:
+                raise ApiError(400, f"{key} must be finite and >= 0")
+            updates[key] = v
+        if not updates:
+            raise ApiError(400, "no rebalancer params given")
+        self.store.set_rebalancer_config(updates, merge=True)
+        return self.get_rebalancer_params(req)
 
     # -- network-agent control plane -----------------------------------
     def _agent_cluster(self):
@@ -292,6 +346,24 @@ class CookApi:
         group_uuids = {g.uuid for g in groups} | set(self.store.groups)
         jobs = [self._parse_job(j, req.user, pool_name, group_uuids)
                 for j in body["jobs"]]
+        # job-adjuster plugin at submission (adjust-job; the reference
+        # rewrites the job txn — pool_mover migrates pools here). An
+        # adjusted pool must still be a REAL pool: a typo'd destination
+        # would blackhole the job (no cycle ever serves it), so revert
+        # bad migrations instead of committing them.
+        if self.plugins is not None:
+            for j in jobs:
+                before = j.pool
+                j = self.plugins.adjuster.adjust_job(j)
+                if j.pool != before and self.pools is not None:
+                    ok = (self.pools.get(j.pool).name == j.pool
+                          and self.pools.accepts_submissions(j.pool))
+                    if not ok:
+                        log.warning(
+                            "adjuster moved job %s to unknown/closed "
+                            "pool %r; reverting to %r", j.uuid, j.pool,
+                            before)
+                        j.pool = before
 
         dupes = [j.uuid for j in jobs if j.uuid in self.store.jobs]
         if dupes:

@@ -57,11 +57,15 @@ def make_handler(api: CookApi):
                      (time.perf_counter() - t0) * 1e3)
 
         def _reply(self, resp: Response) -> None:
+            # a handler-supplied Content-Type means the body is already
+            # a rendered string (e.g. the Prometheus text exposition)
+            ctype = resp.headers.pop("Content-Type", None)
             payload = b""
             if resp.body is not None:
-                payload = json.dumps(resp.body).encode()
+                payload = resp.body.encode() if ctype else \
+                    json.dumps(resp.body).encode()
             self.send_response(resp.status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype or "application/json")
             self.send_header("Content-Length", str(len(payload)))
             for k, v in resp.headers.items():
                 self.send_header(k, v)
@@ -200,6 +204,15 @@ def build_scheduler(config):
         from cook_tpu.plugins import resolve_plugin
         data_locality = DataLocalityCosts(
             fetcher=resolve_plugin(config.data_locality["fetcher"]),
+            weight=float(config.data_locality.get("weight", 0.25)),
+            batch_size=int(config.data_locality.get("batch_size", 500)))
+    elif config.data_locality.get("cost_endpoint"):
+        # the reference's batched HTTP cost client
+        # (fetch-data-local-costs data_locality.clj:141)
+        from cook_tpu.scheduler.data_locality import http_cost_fetcher
+        data_locality = DataLocalityCosts(
+            fetcher=http_cost_fetcher(
+                config.data_locality["cost_endpoint"]),
             weight=float(config.data_locality.get("weight", 0.25)),
             batch_size=int(config.data_locality.get("batch_size", 500)))
 

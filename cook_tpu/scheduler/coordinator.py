@@ -303,6 +303,9 @@ class Coordinator:
         if self.plugins is not None and pending:
             pending = [j for j in pending if self.plugins.launch.check(j)]
             pending = [self.plugins.adjuster.adjust_job(j) for j in pending]
+            # an adjuster may have migrated a job out of this pool
+            # (pool_mover): it belongs to the destination pool's cycle
+            pending = [j for j in pending if j.pool == pool]
         if not pending:
             stats.cycle_ms = (time.perf_counter() - t0) * 1e3
             return stats
@@ -697,12 +700,28 @@ class Coordinator:
         order = np.argsort(rank, kind="stable")
         return [pending[i] for i in order if i < len(pending)][:P]
 
+    def live_rebalancer_params(self) -> RebalancerParams:
+        """Boot config overlaid with the store's runtime-tunable knobs
+        (the Datomic-stored, live-adjustable params of
+        rebalancer.clj:520-542; settable via POST /rebalancer)."""
+        base = self.config.rebalancer
+        cfg = getattr(self.store, "rebalancer_config", None) or {}
+        if not cfg:
+            return base
+        return RebalancerParams(
+            safe_dru_threshold=float(
+                cfg.get("safe-dru-threshold", base.safe_dru_threshold)),
+            min_dru_diff=float(
+                cfg.get("min-dru-diff", base.min_dru_diff)),
+            max_preemption=int(
+                cfg.get("max-preemption", base.max_preemption)))
+
     # ------------------------------------------------------------------
     # rebalancer cycle (rebalancer.clj:428-518)
     def rebalance_cycle(self, pool: Optional[str] = None) -> dict:
         t_reb0 = time.perf_counter()
         pool = pool or self.pools.default_pool
-        params = self.config.rebalancer
+        params = self.live_rebalancer_params()
         self._purge_reservations()
         pending = self.store.pending_jobs(pool)
         if not pending:

@@ -26,6 +26,51 @@ import numpy as np
 CostFetcher = Callable[[list], dict]
 
 
+def http_cost_fetcher(endpoint: str, timeout_s: float = 30.0,
+                      headers: Optional[dict] = None,
+                      datasets_fn: Optional[Callable[[str], list]] = None
+                      ) -> CostFetcher:
+    """Batched HTTP cost client (fetch-data-local-costs
+    data_locality.clj:141-165): POST {batch, tasks: [{task_id,
+    datasets}]} to the cost service, expect {"costs": [{"task_id": ...,
+    "costs": [{"node": ..., "cost": ..., "suitable": ...}]}]}.
+    Unsuitable nodes map to cost 1.0 (farthest). datasets_fn resolves a
+    job uuid to its datasets when the service wants them."""
+    import uuid as uuid_mod
+
+    from cook_tpu.utils.httpjson import json_request
+
+    def fetch(job_uuids: list) -> dict:
+        tasks = []
+        for u in job_uuids:
+            task = {"task_id": u}
+            if datasets_fn is not None:
+                task["datasets"] = datasets_fn(u)
+            tasks.append(task)
+        resp = json_request(
+            "POST", endpoint,
+            {"batch": str(uuid_mod.uuid4()), "tasks": tasks},
+            headers=headers, timeout=timeout_s)
+        out: dict = {}
+        for entry in resp.get("costs", []):
+            tid = entry.get("task_id")
+            if tid is None:
+                continue
+            host_costs = {}
+            for c in entry.get("costs", []):
+                node = c.get("node")
+                if node is None:
+                    continue
+                cost = float(c.get("cost", 1.0))
+                if not c.get("suitable", True):
+                    cost = 1.0
+                host_costs[node] = cost
+            out[tid] = host_costs
+        return out
+
+    return fetch
+
+
 class DataLocalityCosts:
     def __init__(self, fetcher: Optional[CostFetcher] = None,
                  weight: float = 0.25, batch_size: int = 500,

@@ -44,6 +44,9 @@ class JobStore:
         self.groups: dict[str, Group] = {}
         self.task_to_job: dict[str, str] = {}
         self._listeners: list[Callable[[str, dict], None]] = []
+        # runtime-tunable rebalancer params (the reference stores these
+        # in Datomic, adjustable live — rebalancer.clj:520-542)
+        self.rebalancer_config: dict = {}
         self._log_path = log_path
         self._log = log_writer
         if log_path and log_writer is None:
@@ -123,6 +126,18 @@ class JobStore:
                 if not job.committed:
                     job.committed = True
                     self._append("commit", {"job": u})
+            self._barrier()
+
+    def set_rebalancer_config(self, cfg: dict, merge: bool = False) -> None:
+        """Durably update the live rebalancer params (the Datomic-stored
+        knobs of rebalancer.clj:520-542). merge=True folds cfg into the
+        current config under the store lock, so concurrent partial
+        updates can't lose each other's keys."""
+        with self._lock:
+            merged = {**self.rebalancer_config, **cfg} if merge \
+                else dict(cfg)
+            self.rebalancer_config = merged
+            self._append("rebalancer_config", {"cfg": dict(merged)})
             self._barrier()
 
     def gc_uncommitted(self, older_than_ms: int) -> list[str]:
@@ -329,6 +344,7 @@ class JobStore:
                 "log_lines": self._log.lines() if self._log else 0,
                 "jobs": {u: _job_dict(j) for u, j in self.jobs.items()},
                 "groups": {u: asdict(g) for u, g in self.groups.items()},
+                "rebalancer_config": self.rebalancer_config,
             }
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
@@ -354,6 +370,8 @@ class JobStore:
                     store.task_to_job[inst.task_id] = u
             for u, gd in data["groups"].items():
                 store.groups[u] = Group(**gd)
+            store.rebalancer_config = dict(
+                data.get("rebalancer_config", {}))
         if log_path and os.path.exists(log_path):
             _trim_torn_tail(log_path)
             store._replay(log_path, offset)
@@ -396,6 +414,8 @@ class JobStore:
                 job.committed = True
         elif k == "gc":
             self.jobs.pop(ev["job"], None)
+        elif k == "rebalancer_config":
+            self.rebalancer_config = dict(ev.get("cfg", {}))
         elif k == "inst":
             job = self.jobs.get(ev["job"])
             if job and not any(i.task_id == ev["task"] for i in job.instances):

@@ -159,6 +159,47 @@ class MetricRegistry:
         return out
 
 
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    base = "".join(out)
+    if base and base[0].isdigit():
+        base = "_" + base
+    return f"cook_{base}"
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """Text exposition format (the modern equivalent of the reference's
+    Graphite/JMX reporters, reporter.clj:32-82): counters/meters as
+    counters, histogram/timer percentiles as labeled gauges."""
+    lines = []
+    for name, data in sorted(snapshot.items()):
+        pn = _prom_name(name)
+        kind = data.get("type")
+        if kind == "counter":
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {data['value']}")
+        elif kind == "meter":
+            lines.append(f"# TYPE {pn}_total counter")
+            lines.append(f"{pn}_total {data['count']}")
+            lines.append(f"# TYPE {pn}_rate gauge")
+            lines.append(f"{pn}_rate {data['rate']:.6g}")
+        elif kind in ("histogram", "timer"):
+            lines.append(f"# TYPE {pn} summary")
+            for q_key, q_label in (("p50", "0.5"), ("p95", "0.95"),
+                                   ("p99", "0.99")):
+                if q_key in data:
+                    lines.append(
+                        f'{pn}{{quantile="{q_label}"}} '
+                        f"{data[q_key]:.6g}")
+            if "count" in data:
+                lines.append(f"{pn}_count {data['count']}")
+            if "mean" in data:
+                lines.append(f"{pn}_mean {data['mean']:.6g}")
+    return "\n".join(lines) + "\n"
+
+
 # process-wide default registry (the codahale default-registry role)
 registry = MetricRegistry()
 

@@ -254,3 +254,96 @@ def test_fetcher_failure_keeps_stale_costs():
     assert dl.get_costs(job.uuid) == {"h0": 0.1}
     dl.update([job])  # fails; stale data kept
     assert dl.get_costs(job.uuid) == {"h0": 0.1}
+
+
+# -- pool mover (plugins/pool_mover.clj) ------------------------------------
+def test_pool_mover_migrates_configured_portion():
+    from cook_tpu.plugins.pool_mover import PoolMoverAdjuster, _uuid_percent
+    from cook_tpu.state.model import Job, new_uuid
+
+    mover = PoolMoverAdjuster({
+        "default": {"destination_pool": "spot",
+                    "users": {"alice": {"portion": 0.5}}}})
+    jobs = [Job(uuid=new_uuid(), user="alice", command="true", mem=1,
+                cpus=1, max_retries=1) for _ in range(400)]
+    moved = sum(1 for j in jobs
+                if mover.adjust_job(j).pool == "spot")
+    # ~50% migrate; the hash is deterministic per uuid
+    assert 120 < moved < 280
+    j = jobs[0]
+    expected = "spot" if _uuid_percent(j.uuid) < 50 else "default"
+    assert mover.adjust_job(j).pool == expected      # idempotent
+    # unconfigured users and pools never move
+    bob = Job(uuid=new_uuid(), user="bob", command="true", mem=1, cpus=1,
+              max_retries=1)
+    assert mover.adjust_job(bob).pool == "default"
+
+
+def test_pool_mover_from_registry_config():
+    from cook_tpu.plugins import registry_from_config
+    from cook_tpu.plugins.pool_mover import PoolMoverAdjuster
+
+    reg = registry_from_config({"pool_mover": {
+        "default": {"destination_pool": "spot",
+                    "users": {"alice": {"portion": 1.0}}}}})
+    assert isinstance(reg.adjuster, PoolMoverAdjuster)
+
+
+# -- batched HTTP cost fetcher (data_locality.clj:141) ----------------------
+def test_http_cost_fetcher_wire_shape():
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from cook_tpu.scheduler.data_locality import http_cost_fetcher
+
+    seen = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = _json.loads(self.rfile.read(
+                int(self.headers["Content-Length"])))
+            seen.update(body)
+            resp = _json.dumps({"costs": [
+                {"task_id": t["task_id"],
+                 "costs": [{"node": "h0", "cost": 0.2},
+                           {"node": "h1", "cost": 0.1,
+                            "suitable": False}]}
+                for t in body["tasks"]]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        fetch = http_cost_fetcher(
+            f"http://127.0.0.1:{srv.server_address[1]}/costs",
+            datasets_fn=lambda u: [{"dataset": {"bucket": u}}])
+        out = fetch(["u1", "u2"])
+        assert seen["tasks"][0]["datasets"] == [{"dataset": {"bucket": "u1"}}]
+        assert out["u1"]["h0"] == 0.2
+        assert out["u1"]["h1"] == 1.0        # unsuitable -> farthest
+        assert set(out) == {"u1", "u2"}
+    finally:
+        srv.shutdown()
+
+
+def test_sharded_match_refuses_unique_groups():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from cook_tpu.ops import match as match_ops
+    from cook_tpu.parallel import sharded_match
+
+    mesh = sharded_match.make_host_mesh(2)
+    fn = sharded_match.sharded_match_scan(mesh)
+    jobs = match_ops.make_jobs(mem=[1.0, 1.0], cpus=[1.0, 1.0],
+                               group=[0, 0], unique_group=[True, True])
+    hosts = match_ops.make_hosts(mem=[10.0] * 4, cpus=[10.0] * 4)
+    with _pytest.raises(ValueError, match="group"):
+        fn(jobs, hosts, jnp.zeros((2, 4), bool))

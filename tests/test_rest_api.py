@@ -381,3 +381,74 @@ def test_end_to_end_over_http(stack):
         assert status == 404
     finally:
         server.stop()
+
+
+def test_metrics_prometheus_endpoint(stack):
+    store, _, coord, api = stack
+    from cook_tpu.utils.metrics import registry
+    registry.counter("test.prom.counter").inc(3)
+    registry.timer("test.prom.timer").update(12.5)
+    resp = call(api, "GET", "/metrics")
+    assert resp.status == 200
+    text = resp.body
+    assert "cook_test_prom_counter 3" in text
+    assert 'cook_test_prom_timer{quantile="0.5"} 12.5' in text
+    # served without user auth (scrape endpoint, like /info)
+    headerless = api.handle("GET", "/metrics", {}, None, {})
+    assert headerless.status == 200
+
+
+def test_rebalancer_params_live_and_durable(stack, tmp_path):
+    store, _, coord, api = stack
+    resp = call(api, "GET", "/rebalancer")
+    assert resp.status == 200
+    default_threshold = resp.body["safe-dru-threshold"]
+    # non-admin write refused
+    resp = call(api, "POST", "/rebalancer", user="alice",
+                body={"min-dru-diff": 0.25})
+    assert resp.status == 403
+    # admin write takes effect immediately
+    resp = call(api, "POST", "/rebalancer", user="admin",
+                body={"min-dru-diff": 0.25, "max-preemption": 7})
+    assert resp.status == 200
+    p = coord.live_rebalancer_params()
+    assert p.min_dru_diff == 0.25 and p.max_preemption == 7
+    assert p.safe_dru_threshold == default_threshold   # untouched
+    resp = call(api, "POST", "/rebalancer", user="admin",
+                body={"bogus": 1})
+    assert resp.status == 400
+
+
+def test_rebalancer_params_survive_restart(tmp_path):
+    from cook_tpu.state.store import JobStore
+
+    log = str(tmp_path / "log.jsonl")
+    s = JobStore(log_path=log)
+    s.set_rebalancer_config({"min-dru-diff": 0.125})
+    s2 = JobStore.restore(log_path=log)
+    assert s2.rebalancer_config == {"min-dru-diff": 0.125}
+
+
+def test_rebalancer_params_reject_nan_and_negative(stack):
+    store, _, coord, api = stack
+    for bad in ({"safe-dru-threshold": "nan"},
+                {"min-dru-diff": float("inf")},
+                {"max-preemption": -1}):
+        resp = call(api, "POST", "/rebalancer", user="admin", body=bad)
+        assert resp.status == 400, bad
+
+
+def test_pool_mover_bad_destination_reverted(stack):
+    """A typo'd destination pool must not blackhole jobs: the adjusted
+    pool is validated and reverted."""
+    from cook_tpu.plugins import PluginRegistry
+    from cook_tpu.plugins.pool_mover import PoolMoverAdjuster
+    from cook_tpu.state.pools import PoolRegistry
+
+    store, _, coord, api = stack
+    api.pools = PoolRegistry()
+    api.plugins = PluginRegistry(adjuster=PoolMoverAdjuster({
+        "default": {"destination_pool": "spoot",
+                    "users": {"alice": {"portion": 1.0}}}}))
+    (uuid,) = submit(api)
+    assert store.get_job(uuid).pool == "default"
