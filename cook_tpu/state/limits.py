@@ -154,7 +154,12 @@ class RateLimiter:
         if self.enforce:
             self._bucket(key).spend(n)
 
-    def would_allow(self, key: str = "global") -> bool:
+    def would_allow(self, key: str = "global", n: float = 1.0) -> bool:
+        """True iff a spend of `n` would be within the budget right now.
+        Requires a WHOLE token: the bucket earns continuously, so a
+        `> 0` check would flip back to "allowed" microseconds after
+        exhaustion (the reference's TBF earns integer tokens,
+        token_bucket_filter.clj:58-80, so its > 0 check means >= 1)."""
         if not self.enforce:
             return True
-        return self._bucket(key).available() > 0
+        return self._bucket(key).available() >= n

@@ -1,0 +1,341 @@
+"""Multi-user integration tier against a live HTTP server.
+
+Mirrors the reference's integration/tests/cook/test_multi_user.py
+(fairness, quotas, rate limits, preemption between users) — but driven
+entirely over REST against the embedded server + mock virtual-clock
+backend, the way zz_simulator stands in for a cluster. Everything here
+goes through the wire: limits are set with the admin /share//quota
+endpoints, jobs flow through JobClient, and assertions read job state
+back over HTTP.
+"""
+import math
+
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.rest.api import CookApi
+from cook_tpu.rest.auth import AuthConfig
+from cook_tpu.rest.server import ApiServer
+from cook_tpu.scheduler.coordinator import (
+    Coordinator, RebalancerParams, SchedulerConfig)
+from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
+from cook_tpu.state.pools import Pool, PoolRegistry
+from cook_tpu.state.model import JobState
+from cook_tpu.state.store import JobStore
+
+
+class Stack:
+    """One live server + coordinator + mock cluster, REST-addressable."""
+
+    def __init__(self, hosts, config=None, pools=None,
+                 submission_rate=None, user_launch_rate=None):
+        self.store = JobStore()
+        self.cluster = MockCluster(hosts)
+        reg = ClusterRegistry()
+        reg.register(self.cluster)
+        self.shares = ShareStore()
+        self.quotas = QuotaStore()
+        kw = {}
+        if user_launch_rate is not None:
+            kw["user_launch_rate_limiter"] = RateLimiter(
+                tokens_per_sec=user_launch_rate[0],
+                max_tokens=user_launch_rate[1])
+        self.coord = Coordinator(
+            self.store, reg, shares=self.shares, quotas=self.quotas,
+            pools=pools, config=config or SchedulerConfig(), **kw)
+        sub_rl = None
+        if submission_rate is not None:
+            sub_rl = RateLimiter(tokens_per_sec=submission_rate[0],
+                                 max_tokens=submission_rate[1])
+        self.api = CookApi(
+            self.store, coordinator=self.coord,
+            auth=AuthConfig(scheme="header", admins={"admin"}),
+            submission_rate_limiter=sub_rl)
+        self.server = ApiServer(self.api).start()
+        self.admin = JobClient(self.server.url, user="admin")
+
+    def client(self, user):
+        return JobClient(self.server.url, user=user)
+
+    def set_share(self, user, **share):
+        self.admin._request("POST", "/share",
+                            body={"user": user, "share": share})
+
+    def set_quota(self, user, **quota):
+        self.admin._request("POST", "/quota",
+                            body={"user": user, "quota": quota})
+
+    def stop(self):
+        self.server.stop()
+
+
+@pytest.fixture
+def stack():
+    made = []
+
+    def make(*a, **kw):
+        s = Stack(*a, **kw)
+        made.append(s)
+        return s
+
+    yield make
+    for s in made:
+        s.stop()
+
+
+def _running_by_user(store, jobs_by_user):
+    out = {}
+    for user, uuids in jobs_by_user.items():
+        out[user] = sum(1 for u in uuids
+                        if store.get_job(u).state == JobState.RUNNING)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fairness: shares drive the DRU order end to end (test_multi_user.py
+# test_fair_share semantics; share.clj:104 -> dru.clj:55)
+# ---------------------------------------------------------------------------
+
+def test_shares_drive_placement_order(stack):
+    # room for exactly 4 of the 8 submitted jobs
+    s = stack([MockHost("h0", mem=256, cpus=4)])
+    s.set_share("alice", mem=1000, cpus=1000)
+    s.set_share("bob", mem=10, cpus=10)
+    alice, bob = s.client("alice"), s.client("bob")
+    a_jobs = [alice.submit(command="t", mem=64, cpus=1) for _ in range(4)]
+    b_jobs = [bob.submit(command="t", mem=64, cpus=1) for _ in range(4)]
+    s.coord.match_cycle()
+    running = _running_by_user(s.store, {"alice": a_jobs, "bob": b_jobs})
+    # alice's cumulative DRU (usage/1000) stays below bob's first job
+    # (64/10), so the whole head of the queue is hers
+    assert running == {"alice": 4, "bob": 0}
+    # /queue (admin) exposes the same order: all alice before all bob
+    q = s.admin._request("GET", "/queue")["default"]
+    users = [j["user"] for j in q]
+    assert users == ["bob"] * 4  # alice's jobs all left the queue
+
+
+def test_equal_shares_interleave_users(stack):
+    s = stack([MockHost("h0", mem=192, cpus=32)])  # fits 3 of 6
+    s.set_share("alice", mem=100, cpus=100)
+    s.set_share("bob", mem=100, cpus=100)
+    alice, bob = s.client("alice"), s.client("bob")
+    a_jobs = [alice.submit(command="t", mem=64, cpus=1) for _ in range(3)]
+    b_jobs = [bob.submit(command="t", mem=64, cpus=1) for _ in range(3)]
+    s.coord.match_cycle()
+    running = _running_by_user(s.store, {"alice": a_jobs, "bob": b_jobs})
+    # equal shares -> DRU interleaves users; nobody gets the whole host
+    assert running["alice"] >= 1 and running["bob"] >= 1
+    assert running["alice"] + running["bob"] == 3
+
+
+# ---------------------------------------------------------------------------
+# quota: hard caps on running usage incl. job count (quota.clj:47-64,
+# test_multi_user.py quota tests)
+# ---------------------------------------------------------------------------
+
+def test_job_count_quota_caps_concurrency_then_releases(stack):
+    s = stack([MockHost("h0", mem=1024, cpus=32)])
+    s.set_quota("alice", count=2)
+    alice = s.client("alice")
+    jobs = [alice.submit(command="t", mem=64, cpus=1) for _ in range(4)]
+    s.coord.match_cycle()
+    assert _running_by_user(s.store, {"a": jobs})["a"] == 2
+    # completing the running pair frees quota for the rest
+    s.cluster.advance(120)
+    s.coord.match_cycle()
+    states = [s.store.get_job(u).state for u in jobs]
+    assert states.count(JobState.RUNNING) == 2
+    assert sum(1 for u in jobs
+               if s.store.get_job(u).success) == 2
+    # and the explainer names the quota while jobs wait
+    s.set_quota("alice", count=1)
+    extra = [alice.submit(command="t", mem=64, cpus=1) for _ in range(2)]
+    s.cluster.advance(120)
+    s.coord.match_cycle()
+    waiting = [u for u in extra
+               if s.store.get_job(u).state == JobState.WAITING]
+    assert waiting
+    reasons = alice.unscheduled_reasons(waiting[0])
+    assert any("quota" in r["reason"] for r in reasons)
+
+
+def test_mem_quota_enforced_across_cycles(stack):
+    s = stack([MockHost("h0", mem=1024, cpus=32)])
+    s.set_quota("bob", mem=128)
+    bob = s.client("bob")
+    jobs = [bob.submit(command="t", mem=64, cpus=1) for _ in range(5)]
+    s.coord.match_cycle()
+    s.coord.match_cycle()
+    assert _running_by_user(s.store, {"b": jobs})["b"] == 2  # 128/64
+
+
+def test_quota_is_per_user_not_global(stack):
+    s = stack([MockHost("h0", mem=1024, cpus=32)])
+    s.set_quota("alice", count=1)
+    alice, bob = s.client("alice"), s.client("bob")
+    a = [alice.submit(command="t", mem=64, cpus=1) for _ in range(3)]
+    b = [bob.submit(command="t", mem=64, cpus=1) for _ in range(3)]
+    s.coord.match_cycle()
+    running = _running_by_user(s.store, {"alice": a, "bob": b})
+    assert running == {"alice": 1, "bob": 3}
+
+
+# ---------------------------------------------------------------------------
+# submission rate limit -> 429 over the wire (rate_limit.clj:28,
+# run_integration_ratelimit.sh tier)
+# ---------------------------------------------------------------------------
+
+def test_submission_rate_limit_429(stack):
+    s = stack([MockHost("h0", mem=1024, cpus=32)],
+              submission_rate=(0.001, 2))
+    alice = s.client("alice")
+    assert alice.submit(command="t", mem=64, cpus=1)
+    assert alice.submit(command="t", mem=64, cpus=1)
+    with pytest.raises(JobClientError) as ei:
+        alice.submit(command="t", mem=64, cpus=1)
+    assert ei.value.status == 429
+    # per-user buckets: bob is unaffected by alice's exhaustion
+    assert s.client("bob").submit(command="t", mem=64, cpus=1)
+
+
+def test_user_launch_rate_limit_throttles_matching(stack):
+    s = stack([MockHost("h0", mem=1024, cpus=32)],
+              user_launch_rate=(0.001, 2))
+    alice = s.client("alice")
+    jobs = [alice.submit(command="t", mem=64, cpus=1) for _ in range(5)]
+    s.coord.match_cycle()
+    assert _running_by_user(s.store, {"a": jobs})["a"] == 2
+    reasons = {r["reason"]
+               for u in jobs if s.store.get_job(u).state == JobState.WAITING
+               for r in alice.unscheduled_reasons(u)}
+    assert any("rate" in r for r in reasons)
+
+
+# ---------------------------------------------------------------------------
+# preemption between users, end to end over REST
+# (test_multi_user.py::test_preemption semantics; rebalancer.clj:428)
+# ---------------------------------------------------------------------------
+
+def test_low_share_user_preempted_for_high_share_user(stack):
+    cfg = SchedulerConfig(
+        rebalancer=RebalancerParams(
+            safe_dru_threshold=0.0, min_dru_diff=0.01, max_preemption=8))
+    s = stack([MockHost("h0", mem=256, cpus=8)], config=cfg)
+    s.set_share("greedy", mem=10, cpus=10)
+    s.set_share("vip", mem=1000, cpus=1000)
+    greedy, vip = s.client("greedy"), s.client("vip")
+    g_jobs = [greedy.submit(command="t", mem=64, cpus=1, max_retries=5)
+              for _ in range(4)]
+    s.coord.match_cycle()
+    assert _running_by_user(s.store, {"g": g_jobs})["g"] == 4
+    # vip arrives; host is full; rebalancer must evict greedy's tasks
+    v = vip.submit(command="t", mem=128, cpus=2)
+    s.coord.match_cycle()
+    assert s.store.get_job(v).state == JobState.WAITING
+    res = s.coord.rebalance_cycle()
+    assert res["preempted"] >= 1
+    s.coord.match_cycle()
+    vip_job = vip.query(v)
+    assert vip_job.status == "running"
+    # the victim went back to waiting WITHOUT burning a retry
+    # (mea-culpa, schema.clj:1018-1062)
+    preempted = [u for u in g_jobs
+                 if any(i.status == "failed" for i in
+                        greedy.query(u).instances)]
+    assert preempted
+    for u in preempted:
+        j = greedy.query(u)
+        assert j.status in ("waiting", "running")
+        inst = [i for i in j.instances if i.status == "failed"][0]
+        assert inst.preempted or "preempt" in (inst.reason_string or "").lower()
+
+
+def test_rebalancer_params_settable_over_rest(stack):
+    s = stack([MockHost("h0", mem=256, cpus=8)])
+    got = s.admin._request("GET", "/rebalancer")
+    assert "min-dru-diff" in got
+    s.admin._request("POST", "/rebalancer",
+                     body={"safe-dru-threshold": 0.0,
+                           "min-dru-diff": 0.5,
+                           "max-preemption": 3})
+    live = s.coord.live_rebalancer_params()
+    assert live.min_dru_diff == 0.5 and live.max_preemption == 3
+
+
+# ---------------------------------------------------------------------------
+# pools: isolated scheduling + per-pool limits (pool.clj, test_pools.py)
+# ---------------------------------------------------------------------------
+
+def test_pools_isolate_hosts_and_limits(stack):
+    pools = PoolRegistry()
+    pools.add(Pool(name="gpu", purpose="gpu pool"))
+    s = stack([MockHost("cpu0", mem=256, cpus=8),
+               MockHost("gpu0", mem=256, cpus=8, gpus=4, pool="gpu")],
+              pools=pools)
+    s.set_quota("alice", count=100)     # default pool
+    s.admin._request("POST", "/quota",
+                     body={"user": "alice", "pool": "gpu",
+                           "quota": {"count": 1}})
+    alice = s.client("alice")
+    d_jobs = [alice.submit(command="t", mem=64, cpus=1) for _ in range(2)]
+    g_jobs = [alice.submit(command="t", mem=64, cpus=1, gpus=1, pool="gpu")
+              for _ in range(2)]
+    for p in ("default", "gpu"):
+        s.coord.match_cycle(pool=p)
+    assert _running_by_user(s.store, {"d": d_jobs})["d"] == 2
+    # gpu-pool quota of 1 caps the second gpu job
+    assert _running_by_user(s.store, {"g": g_jobs})["g"] == 1
+    # gpu job never lands on the cpu host
+    for u in g_jobs:
+        for i in s.store.get_job(u).instances:
+            assert i.hostname != "cpu0"
+    names = {p["name"] for p in alice._request("GET", "/pools")}
+    assert {"default", "gpu"} <= names
+
+
+# ---------------------------------------------------------------------------
+# /usage and /share surfaces reflect live state per user
+# ---------------------------------------------------------------------------
+
+def test_usage_endpoint_tracks_running_usage(stack):
+    s = stack([MockHost("h0", mem=1024, cpus=32)])
+    alice = s.client("alice")
+    jobs = [alice.submit(command="t", mem=100, cpus=2) for _ in range(3)]
+    s.coord.match_cycle()
+    u = alice.usage()
+    assert u["total_usage"]["mem"] == 300.0
+    assert u["total_usage"]["cpus"] == 6.0
+    assert u["total_usage"]["jobs"] == 3
+    s.cluster.advance(120)
+    assert alice.usage()["total_usage"]["jobs"] == 0
+    del jobs
+
+
+def test_share_get_falls_back_to_default_user(stack):
+    s = stack([MockHost("h0", mem=64, cpus=2)])
+    s.set_share("default", mem=50, cpus=50)
+    got = s.client("alice")._request("GET", "/share",
+                                     query={"user": "alice"})
+    assert got["mem"] == 50.0
+    # explicit share overrides the default fallback
+    s.set_share("alice", mem=10, cpus=10)
+    got = s.client("alice")._request("GET", "/share",
+                                     query={"user": "alice"})
+    assert got["mem"] == 10.0
+    # unset quota reads as unlimited over the wire (JSON-safe encoding)
+    q = s.client("alice")._request("GET", "/quota",
+                                   query={"user": "alice"})
+    assert q["count"] in ("unlimited", None) or \
+        (isinstance(q["count"], float) and math.isinf(q["count"]))
+
+
+def test_non_admin_cannot_set_limits(stack):
+    s = stack([MockHost("h0", mem=64, cpus=2)])
+    with pytest.raises(JobClientError) as ei:
+        s.client("mallory")._request(
+            "POST", "/share",
+            body={"user": "mallory", "share": {"mem": 1e9}})
+    assert ei.value.status == 403
