@@ -162,4 +162,6 @@ class RateLimiter:
         token_bucket_filter.clj:58-80, so its > 0 check means >= 1)."""
         if not self.enforce:
             return True
-        return self._bucket(key).available() >= n
+        # clamp to the bucket capacity so a burst-sub-1 limiter
+        # (max_tokens < 1) can still ever say yes at a full bucket
+        return self._bucket(key).available() >= min(n, self.max)

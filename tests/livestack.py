@@ -1,0 +1,60 @@
+"""Shared live-server harness for integration-tier tests.
+
+One embedded HTTP server + coordinator + mock virtual-clock cluster,
+REST-addressable — the testutil.clj run-test-server-in-thread role for
+suites that drive the stack over the wire.
+"""
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster
+from cook_tpu.client import JobClient
+from cook_tpu.rest.api import CookApi
+from cook_tpu.rest.auth import AuthConfig
+from cook_tpu.rest.server import ApiServer
+from cook_tpu.scheduler.coordinator import Coordinator, SchedulerConfig
+from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
+from cook_tpu.state.store import JobStore
+
+
+class Stack:
+    """One live server + coordinator + mock cluster, REST-addressable."""
+
+    def __init__(self, hosts, config=None, pools=None,
+                 submission_rate=None, user_launch_rate=None):
+        self.store = JobStore()
+        self.cluster = MockCluster(hosts)
+        reg = ClusterRegistry()
+        reg.register(self.cluster)
+        self.shares = ShareStore()
+        self.quotas = QuotaStore()
+        kw = {}
+        if user_launch_rate is not None:
+            kw["user_launch_rate_limiter"] = RateLimiter(
+                tokens_per_sec=user_launch_rate[0],
+                max_tokens=user_launch_rate[1])
+        self.coord = Coordinator(
+            self.store, reg, shares=self.shares, quotas=self.quotas,
+            pools=pools, config=config or SchedulerConfig(), **kw)
+        sub_rl = None
+        if submission_rate is not None:
+            sub_rl = RateLimiter(tokens_per_sec=submission_rate[0],
+                                 max_tokens=submission_rate[1])
+        self.api = CookApi(
+            self.store, coordinator=self.coord,
+            auth=AuthConfig(scheme="header", admins={"admin"}),
+            submission_rate_limiter=sub_rl)
+        self.server = ApiServer(self.api).start()
+        self.admin = JobClient(self.server.url, user="admin")
+
+    def client(self, user):
+        return JobClient(self.server.url, user=user)
+
+    def set_share(self, user, **share):
+        self.admin._request("POST", "/share",
+                            body={"user": user, "share": share})
+
+    def set_quota(self, user, **quota):
+        self.admin._request("POST", "/quota",
+                            body={"user": user, "quota": quota})
+
+    def stop(self):
+        self.server.stop()

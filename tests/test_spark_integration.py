@@ -1,0 +1,138 @@
+"""Spark-on-Cook provisioning against the live stack.
+
+Exercises the CoarseCookSchedulerBackend state machine (the reference's
+spark/0001-Add-cook-support-for-spark-v1.6.1.patch) end to end: core
+chunking, executor jobs reaching running, failure-budgeted replacement,
+dynamic allocation caps, and abort bookkeeping.
+"""
+import pytest
+
+from cook_tpu.integrations.spark_cook import (
+    CookSparkBackend, SparkConf, core_chunks, executor_command)
+from cook_tpu.backends.mock import MockHost
+from cook_tpu.state.model import JobState
+
+from tests.livestack import Stack
+
+
+@pytest.fixture
+def stack():
+    s = Stack([MockHost("h0", mem=65536, cpus=64)])
+    yield s
+    s.stop()
+
+
+def _conf(**kw):
+    kw.setdefault("driver_url",
+                  "spark://CoarseGrainedScheduler@10.0.0.1:7077")
+    kw.setdefault("max_cores", 10)
+    kw.setdefault("cores_per_job", 4)
+    return SparkConf(**kw)
+
+
+def test_core_chunks_full_then_remainder():
+    assert core_chunks(11, 5) == [5, 5, 1]
+    assert core_chunks(4, 5) == [4]
+    assert core_chunks(0, 5) == []
+    with pytest.raises(ValueError):
+        core_chunks(3, 0)
+
+
+def test_executor_command_shape():
+    conf = _conf(executor_env={"PYSPARK_PYTHON": "python3"},
+                 app_id="app-7")
+    cmd = executor_command(conf, executor_id="cook-0", cores=4)
+    assert "CoarseGrainedExecutorBackend" in cmd
+    assert "--driver-url spark://CoarseGrainedScheduler@10.0.0.1:7077" in cmd
+    assert "--cores 4" in cmd and "--app-id app-7" in cmd
+    assert "--hostname $(hostname)" in cmd
+    assert "export PYSPARK_PYTHON=python3" in cmd
+    assert "export SPARK_LOCAL_DIRS=spark-temp" in cmd
+    assert "rm -rf $SPARK_LOCAL_DIRS" in cmd        # cleanup trailer
+    assert executor_command(_conf(keep_local_dirs=True), "e", 1).count(
+        "rm -rf") == 0
+
+
+def test_executors_provision_and_run(stack):
+    be = CookSparkBackend(stack.client("sparky"), _conf())
+    uuids = be.start()
+    assert len(uuids) == 3                          # 4 + 4 + 2 cores
+    assert be.total_cores_requested == 10
+    assert be.current_cores_limit() == 0
+    stack.coord.match_cycle()
+    states = [stack.store.get_job(u).state for u in uuids]
+    assert states == [JobState.RUNNING] * 3
+    # memory request includes the overhead floor
+    job = stack.store.get_job(uuids[0])
+    assert job.mem == pytest.approx(1024.0 + 384.0)
+    assert job.priority == 75
+
+
+def test_failed_executor_is_replaced_within_budget(stack):
+    be = CookSparkBackend(stack.client("sparky"), _conf())
+    lost = []
+    be.on_executor_lost = lost.append
+    uuids = be.start()
+    stack.coord.match_cycle()
+    victim_task = stack.store.get_job(uuids[0]).instances[0].task_id
+    stack.cluster.fail_task(victim_task)
+    be.poll()
+    assert lost == [uuids[0]]
+    assert be.total_failures == 1
+    # the dead job's cores were re-requested as a fresh job
+    assert be.total_cores_requested == 10
+    assert len(be.jobs) == 3
+    assert uuids[0] not in be.jobs
+
+
+def test_failure_budget_stops_relaunch(stack):
+    be = CookSparkBackend(stack.client("sparky"),
+                          _conf(max_cores=4, max_failures=1))
+    uuids = be.start()
+    stack.coord.match_cycle()
+    stack.cluster.fail_task(stack.store.get_job(uuids[0]).instances[0].task_id)
+    be.poll()
+    assert be.total_failures == 1
+    assert be.jobs == {}                            # nothing relaunched
+    assert be.request_remaining_cores() == []
+
+
+def test_dynamic_allocation_caps_and_raises(stack):
+    be = CookSparkBackend(stack.client("sparky"), _conf(max_cores=0))
+    assert be.start() == []                         # cores.max unset -> none
+    be.request_total_executors(2)                   # 2 jobs x 4 cores
+    assert be.total_cores_requested == 8
+    be.request_total_executors(3)
+    assert be.total_cores_requested == 12
+    # lowering the cap doesn't kill running executors (same as the
+    # patch: the limit only bounds future requests)
+    be.request_total_executors(1)
+    assert be.total_cores_requested == 12
+
+
+def test_kill_executors_aborts_without_failure_charge(stack):
+    be = CookSparkBackend(stack.client("sparky"), _conf())
+    uuids = be.start()
+    stack.coord.match_cycle()
+    assert be.kill_executors([uuids[1]])
+    be.poll()
+    assert be.total_failures == 0                   # clean abort
+    assert be.total_cores_requested == 6
+    assert uuids[1] not in be.jobs
+    assert not be.kill_executors(["no-such-uuid"])
+
+
+def test_stop_kills_all_live_executors(stack):
+    be = CookSparkBackend(stack.client("sparky"), _conf())
+    uuids = be.start()
+    stack.coord.match_cycle()
+    be.stop()
+    states = [stack.store.get_job(u).state for u in uuids]
+    assert all(s == JobState.COMPLETED for s in states)
+
+
+def test_sufficient_resources_ready_gate(stack):
+    be = CookSparkBackend(stack.client("sparky"), _conf())
+    be.start()
+    assert not be.sufficient_resources_registered(4)
+    assert be.sufficient_resources_registered(8)    # >= 80% of 10

@@ -362,6 +362,26 @@ def test_rate_limiter_per_key():
         assert nolimit.try_acquire("x")
 
 
+def test_would_allow_whole_token_and_sub_one_burst():
+    t = [0.0]
+    rl = RateLimiter(tokens_per_sec=1, max_tokens=2, clock=lambda: t[0])
+    assert rl.would_allow("alice")
+    rl.spend("alice", 2)
+    # a fractional re-earn must NOT flip back to allowed
+    t[0] += 0.01
+    assert not rl.would_allow("alice")
+    t[0] += 1.0
+    assert rl.would_allow("alice")
+    # burst-sub-1 limiter: full bucket still says yes (clamped to max)
+    slow = RateLimiter(tokens_per_sec=0.25, max_tokens=0.5,
+                       clock=lambda: t[0])
+    assert slow.would_allow("bob")
+    slow.spend("bob", 0.5)
+    assert not slow.would_allow("bob")
+    t[0] += 4.0      # earn caps at max_tokens
+    assert slow.would_allow("bob")
+
+
 def test_pool_registry():
     pr = PoolRegistry()
     pr.add(Pool(name="gpu-pool", dru_mode=DruMode.GPU))
