@@ -75,8 +75,7 @@ def executor_command(conf: SparkConf, executor_id: str, cores: int) -> str:
         f" --cores {cores}"
         f" --app-id {conf.app_id}"
     )
-    cleanup = ("if [ -z $KEEP_SPARK_LOCAL_DIRS ]; then rm -rf "
-               "$SPARK_LOCAL_DIRS; echo deleted $SPARK_LOCAL_DIRS; fi")
+    cleanup = "rm -rf $SPARK_LOCAL_DIRS; echo deleted $SPARK_LOCAL_DIRS"
     cmds = exports + [run] + ([] if conf.keep_local_dirs else [cleanup])
     return "; ".join(cmds)
 
@@ -98,6 +97,7 @@ def core_chunks(total: int, per_job: int) -> list[int]:
 @dataclass
 class _ExecutorJob:
     uuid: str
+    executor_id: str   # the --executor-id the process registered with
     cores: int
     aborted: bool = False
 
@@ -142,33 +142,44 @@ class CookSparkBackend:
 
     def request_remaining_cores(self) -> list[str]:
         """Submit executor jobs until the core budget is met
-        (requestRemainingCores). Returns new job uuids."""
+        (requestRemainingCores), in ONE batched submission. Returns new
+        job uuids."""
         with self._lock:
             if self.total_failures >= self.conf.max_failures:
                 log.error("exceeded %d executor failures; not relaunching",
                           self.conf.max_failures)
                 return []
-            new = []
-            for cores in core_chunks(self.current_cores_limit(),
-                                     self.conf.cores_per_job):
-                extra = {"uris": self.conf.uris} if self.conf.uris else {}
+            chunks = core_chunks(self.current_cores_limit(),
+                                 self.conf.cores_per_job)
+            if self.job_limit is not None:
+                # the dynamic-allocation cap is an executor COUNT: never
+                # exceed it even when remainder-sized live jobs leave
+                # leftover core budget
+                chunks = chunks[:max(0, self.job_limit - len(self.jobs))]
+            if not chunks:
+                return []
+            specs, exec_ids = [], []
+            for cores in chunks:
                 self._executor_seq += 1
-                uuid = self.client.submit(
-                    command=executor_command(
-                        self.conf, executor_id=f"cook-{self._executor_seq}",
-                        cores=cores),
-                    mem=self.conf.total_memory_mb, cpus=float(cores),
-                    priority=self.conf.priority,
-                    name=f"{self.conf.app_id}-executor",
-                    env=dict(self.conf.executor_env),
-                    pool=self.conf.pool,
-                    max_retries=1, **extra)
-                self.jobs[uuid] = _ExecutorJob(uuid, cores)
+                exec_id = f"cook-{self._executor_seq}"
+                exec_ids.append(exec_id)
+                spec = {
+                    "command": executor_command(self.conf, exec_id, cores),
+                    "mem": self.conf.total_memory_mb, "cpus": float(cores),
+                    "priority": self.conf.priority,
+                    "name": f"{self.conf.app_id}-executor",
+                    "env": dict(self.conf.executor_env),
+                    "max_retries": 1,
+                }
+                if self.conf.uris:
+                    spec["uris"] = self.conf.uris
+                specs.append(spec)
+            new = self.client.submit_jobs(specs, pool=self.conf.pool)
+            for uuid, exec_id, cores in zip(new, exec_ids, chunks):
+                self.jobs[uuid] = _ExecutorJob(uuid, exec_id, cores)
                 self.total_cores_requested += cores
-                new.append(uuid)
-            if new:
-                log.info("requested %d executor jobs (%d cores total)",
-                         len(new), sum(self.jobs[u].cores for u in new))
+            log.info("requested %d executor jobs (%d cores total)",
+                     len(new), sum(chunks))
             return new
 
     # -- status (CJobListener.onStatusUpdate) --------------------------
@@ -193,12 +204,15 @@ class CookSparkBackend:
                     continue
                 self.total_failures += 1
                 failures = self.total_failures
-            lost.append(info.uuid)
-            log.warning("executor job %s died (failure %d/%d)", info.uuid,
-                        failures, self.conf.max_failures)
-        for uuid in lost:
+            lost.append(job.executor_id)
+            log.warning("executor %s (job %s) died (failure %d/%d)",
+                        job.executor_id, info.uuid, failures,
+                        self.conf.max_failures)
+        for exec_id in lost:
             if self.on_executor_lost:
-                self.on_executor_lost(uuid)
+                # reported by Spark executor id so a driver shim can call
+                # removeExecutor() with it
+                self.on_executor_lost(exec_id)
         if lost:
             self.request_remaining_cores()
 
@@ -222,11 +236,14 @@ class CookSparkBackend:
         self.request_remaining_cores()
         return True
 
-    def kill_executors(self, uuids: list[str]) -> bool:
-        """doKillExecutors: abort this executor's job; its cores are
-        released when the completed status arrives (abortJobs)."""
+    def kill_executors(self, ids: list[str]) -> bool:
+        """doKillExecutors: abort the executor's job; its cores are
+        released when the completed status arrives (abortJobs). Accepts
+        Cook job uuids or Spark executor ids (cook-N)."""
         with self._lock:
-            known = [u for u in uuids if u in self.jobs]
+            by_exec = {j.executor_id: u for u, j in self.jobs.items()}
+            known = [by_exec.get(i, i) for i in ids
+                     if i in by_exec or i in self.jobs]
             for u in known:
                 self.jobs[u].aborted = True
         if known:

@@ -77,7 +77,9 @@ def test_failed_executor_is_replaced_within_budget(stack):
     victim_task = stack.store.get_job(uuids[0]).instances[0].task_id
     stack.cluster.fail_task(victim_task)
     be.poll()
-    assert lost == [uuids[0]]
+    # loss is reported by Spark executor id (cook-N), the handle a
+    # driver shim passes to removeExecutor()
+    assert lost == ["cook-1"]
     assert be.total_failures == 1
     # the dead job's cores were re-requested as a fresh job
     assert be.total_cores_requested == 10
@@ -108,6 +110,26 @@ def test_dynamic_allocation_caps_and_raises(stack):
     # patch: the limit only bounds future requests)
     be.request_total_executors(1)
     assert be.total_cores_requested == 12
+
+
+def test_dynamic_allocation_caps_executor_count_not_just_cores(stack):
+    # 10 cores as 4+4+2: the 2-core remainder leaves core budget under a
+    # 3-job cap, but the cap is an executor COUNT and must hold
+    be = CookSparkBackend(stack.client("sparky"), _conf())
+    assert len(be.start()) == 3
+    be.request_total_executors(3)
+    assert len(be.jobs) == 3
+    assert be.total_cores_requested == 10
+
+
+def test_kill_executors_accepts_spark_executor_ids(stack):
+    be = CookSparkBackend(stack.client("sparky"), _conf())
+    be.start()
+    stack.coord.match_cycle()
+    assert be.kill_executors(["cook-2"])
+    be.poll()
+    assert be.total_failures == 0
+    assert "cook-2" not in {j.executor_id for j in be.jobs.values()}
 
 
 def test_kill_executors_aborts_without_failure_charge(stack):
