@@ -1,0 +1,111 @@
+#!/usr/bin/env bash
+# Local dev cluster: one coordinator + N network agents on this machine.
+#
+# The reference's dev-env role (scheduler/bin/run-local-kubernetes.sh,
+# Vagrantfile quickstart): everything real — REST server, scheduling
+# cycles, HTTP agent control plane, process executors with sandboxes —
+# no container runtime needed.
+#
+#   bin/run-local.sh            start (idempotent; restarts if running)
+#   bin/run-local.sh status     liveness + agent count
+#   bin/run-local.sh demo       submit a demo job and wait for success
+#   bin/stop-local.sh           stop everything
+#
+# Env knobs: COOK_PORT (12321), COOK_AGENTS (2), COOK_LOCAL_DIR
+# (/tmp/cook_tpu_local).
+set -euo pipefail
+
+PORT="${COOK_PORT:-12321}"
+AGENTS="${COOK_AGENTS:-2}"
+DIR="${COOK_LOCAL_DIR:-/tmp/cook_tpu_local}"
+URL="http://127.0.0.1:${PORT}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="${REPO}${PYTHONPATH:+:$PYTHONPATH}"
+
+cmd="${1:-start}"
+
+status() {
+    if curl -fsS "${URL}/info" >/dev/null 2>&1; then
+        echo "coordinator: up (${URL})"
+        curl -fsS "${URL}/debug" 2>/dev/null | head -c 400; echo
+        echo "agents: $(ls "${DIR}"/agent-*.pid 2>/dev/null | wc -l) pid files"
+    else
+        echo "coordinator: down"
+        return 1
+    fi
+}
+
+demo() {
+    uuid=$(python -m cook_tpu.cli --url "${URL}" submit \
+        echo "hello from the local cluster")
+    echo "submitted ${uuid}; waiting..."
+    python -m cook_tpu.cli --url "${URL}" wait "${uuid}"
+    python -m cook_tpu.cli --url "${URL}" show "${uuid}"
+}
+
+case "${cmd}" in
+  status) status; exit $?;;
+  demo)   demo;   exit $?;;
+  start)  ;;
+  *) echo "usage: $0 [start|status|demo]" >&2; exit 2;;
+esac
+
+"${REPO}/bin/stop-local.sh" >/dev/null 2>&1 || true
+mkdir -p "${DIR}"
+
+cat > "${DIR}/config.json" <<EOF
+{
+  "port": ${PORT},
+  "url": "${URL}",
+  "clusters": [
+    {"kind": "agent", "name": "local-agents",
+     "agent_heartbeat_timeout_s": 10.0}
+  ],
+  "log_path": "${DIR}/eventlog",
+  "snapshot_path": "${DIR}/snapshot.json",
+  "metrics_jsonl": "${DIR}/metrics.jsonl"
+}
+EOF
+
+echo "starting coordinator on ${URL} ..."
+python -m cook_tpu.rest.server --config "${DIR}/config.json" \
+    > "${DIR}/server.log" 2>&1 &
+echo $! > "${DIR}/server.pid"
+
+for i in $(seq 1 50); do
+    curl -fsS "${URL}/info" >/dev/null 2>&1 && break
+    if ! kill -0 "$(cat "${DIR}/server.pid")" 2>/dev/null; then
+        echo "coordinator died; see ${DIR}/server.log" >&2; exit 1
+    fi
+    sleep 0.2
+done
+curl -fsS "${URL}/info" >/dev/null
+
+for i in $(seq 1 "${AGENTS}"); do
+    host="agent${i}"
+    python -m cook_tpu.agent \
+        --coordinator "${URL}" --hostname "${host}" \
+        --mem 4096 --cpus 4 \
+        --sandbox-root "${DIR}/sandboxes/${host}" \
+        --heartbeat-interval 2 \
+        > "${DIR}/${host}.log" 2>&1 &
+    echo $! > "${DIR}/agent-${i}.pid"
+done
+
+echo "waiting for ${AGENTS} agents to register..."
+for i in $(seq 1 50); do
+    n=$(curl -fsS "${URL}/debug" 2>/dev/null \
+        | python -c "import json,sys; d=json.load(sys.stdin); \
+print(sum(c.get('hosts', 0) if isinstance(c, dict) else 0 \
+for c in d.get('clusters', {}).values()))" 2>/dev/null || echo 0)
+    [ "${n}" -ge "${AGENTS}" ] && break
+    sleep 0.2
+done
+
+echo "local cluster up: ${URL} (${AGENTS} agents)"
+echo "  submit:  python -m cook_tpu.cli --url ${URL} submit echo hi"
+echo "  demo:    $0 demo"
+echo "  logs:    ${DIR}/*.log"
+echo "  stop:    ${REPO}/bin/stop-local.sh"

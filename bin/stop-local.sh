@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Stop the local dev cluster started by bin/run-local.sh.
+set -uo pipefail
+
+DIR="${COOK_LOCAL_DIR:-/tmp/cook_tpu_local}"
+stopped=0
+
+for pidfile in "${DIR}"/agent-*.pid "${DIR}/server.pid"; do
+    [ -f "${pidfile}" ] || continue
+    pid=$(cat "${pidfile}")
+    if kill -0 "${pid}" 2>/dev/null; then
+        kill "${pid}" 2>/dev/null
+        for i in $(seq 1 20); do
+            kill -0 "${pid}" 2>/dev/null || break
+            sleep 0.1
+        done
+        kill -9 "${pid}" 2>/dev/null
+        stopped=$((stopped + 1))
+    fi
+    rm -f "${pidfile}"
+done
+
+echo "stopped ${stopped} processes"

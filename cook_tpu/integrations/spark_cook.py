@@ -75,7 +75,11 @@ def executor_command(conf: SparkConf, executor_id: str, cores: int) -> str:
         f" --cores {cores}"
         f" --app-id {conf.app_id}"
     )
-    cleanup = "rm -rf $SPARK_LOCAL_DIRS; echo deleted $SPARK_LOCAL_DIRS"
+    # runtime opt-out via env (the reference patch honors
+    # KEEP_SPARK_LOCAL_DIRS at executor exit; settable per run through
+    # executor_env), on top of the submit-time keep_local_dirs switch
+    cleanup = ('if [ -z "$KEEP_SPARK_LOCAL_DIRS" ]; then rm -rf '
+               '$SPARK_LOCAL_DIRS; echo deleted $SPARK_LOCAL_DIRS; fi')
     cmds = exports + [run] + ([] if conf.keep_local_dirs else [cleanup])
     return "; ".join(cmds)
 
@@ -106,9 +110,9 @@ class CookSparkBackend:
     """Driver-side executor provisioner (CoarseCookSchedulerBackend).
 
     `client` is any object with the JobClient surface used here:
-    submit(command=..., mem=..., cpus=..., priority=..., env=...,
-    group=..., pool=...) -> uuid, query_jobs(uuids) -> [JobInfo],
-    kill(*uuids). Call `poll()` periodically (or `start_polling()`)
+    submit_jobs(specs, pool=...) -> [uuid], query_jobs(uuids) ->
+    [JobInfo], kill(*uuids). Call `poll()` periodically (or
+    `start_polling()`)
     to drive completion/replacement — the role of the reference
     JobClient's 1 s status-update listener thread.
     """

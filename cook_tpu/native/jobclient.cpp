@@ -195,6 +195,19 @@ class JsonParser {
     if (pos_ == start) fail("bad number");
     return Json::number(std::stod(s_.substr(start, pos_ - start)));
   }
+  unsigned hex4() {
+    if (pos_ + 4 > s_.size()) fail("bad \\u escape");
+    unsigned cp = 0;
+    for (int i = 0; i < 4; ++i) {
+      char h = s_[pos_++];
+      cp <<= 4;
+      if (h >= '0' && h <= '9') cp |= h - '0';
+      else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+      else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+      else fail("bad hex digit");
+    }
+    return cp;
+  }
   std::string string_lit() {
     if (peek() != '"') fail("expected string");
     ++pos_;
@@ -216,24 +229,39 @@ class JsonParser {
           case 'r': out.push_back('\r'); break;
           case 't': out.push_back('\t'); break;
           case 'u': {
-            if (pos_ + 4 > s_.size()) fail("bad \\u escape");
-            unsigned cp = 0;
-            for (int i = 0; i < 4; ++i) {
-              char h = s_[pos_++];
-              cp <<= 4;
-              if (h >= '0' && h <= '9') cp |= h - '0';
-              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
-              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
-              else fail("bad hex digit");
+            unsigned cp = hex4();
+            // combine UTF-16 surrogate pairs (json.dumps with
+            // ensure_ascii emits astral chars as \uD8xx\uDCxx pairs);
+            // a lone/mismatched surrogate folds to U+FFFD
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              if (pos_ + 6 <= s_.size() && s_[pos_] == '\\' &&
+                  s_[pos_ + 1] == 'u') {
+                pos_ += 2;
+                unsigned lo = hex4();
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                } else {
+                  out += "\xEF\xBF\xBD";  // U+FFFD for the high half
+                  cp = (lo >= 0xD800 && lo <= 0xDFFF) ? 0xFFFD : lo;
+                }
+              } else {
+                cp = 0xFFFD;
+              }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              cp = 0xFFFD;  // stray low surrogate
             }
-            // encode UTF-8 (surrogate pairs folded to replacement char)
             if (cp < 0x80) {
               out.push_back(static_cast<char>(cp));
             } else if (cp < 0x800) {
               out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
               out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
-            } else {
+            } else if (cp < 0x10000) {
               out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+              out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else {
+              out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+              out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
               out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
               out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
             }
@@ -711,13 +739,23 @@ int cook_retry(void* handle, const char* uuid, int retries) {
 }
 
 // Blocks until completion; returns final job JSON (malloc'd) or NULL.
+// One GET per poll — the JSON that showed status=completed is exactly
+// what is returned (no re-read race with a concurrent /retry).
 char* cook_wait_for_job(void* handle, const char* uuid, int timeout_ms,
                         int poll_ms) {
   auto* h = static_cast<CookHandle*>(handle);
   try {
-    cook::Job job = h->client->wait_for_job(uuid, timeout_ms, poll_ms);
-    cook::Json j = h->client->call("GET", std::string("/jobs/") + uuid, "");
-    return dup_str(j.dump());
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (true) {
+      cook::Json j =
+          h->client->call("GET", std::string("/jobs/") + uuid, "");
+      if (j.get_str("status") == "completed") return dup_str(j.dump());
+      if (std::chrono::steady_clock::now() >= deadline)
+        throw std::runtime_error(std::string("timeout waiting for ") +
+                                 uuid);
+      std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
+    }
   } catch (const std::exception& e) {
     h->last_error = e.what();
     return nullptr;

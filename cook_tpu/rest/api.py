@@ -863,7 +863,24 @@ class CookApi:
         })
 
     def get_debug(self, req: Request) -> Response:
-        return Response(200, {"healthy": True, "version": VERSION})
+        """Health + live backend summary (components.clj:140-151 health
+        handler role): per-cluster host and tracked-task counts."""
+        clusters = {}
+        if self.coord is not None:
+            for cluster in self.coord.clusters.all():
+                try:
+                    hosts = len(cluster.host_attributes())
+                except Exception:
+                    hosts = 0
+                try:
+                    tasks = len(cluster.known_task_ids())
+                except Exception:
+                    tasks = 0
+                clusters[cluster.name] = {
+                    "kind": type(cluster).__name__,
+                    "hosts": hosts, "tasks": tasks}
+        return Response(200, {"healthy": True, "version": VERSION,
+                              "clusters": clusters})
 
     # -- data-locality debug endpoints (data_locality.clj debug REST,
     # rest/api.clj data-local routes) ----------------------------------

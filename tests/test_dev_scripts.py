@@ -1,0 +1,38 @@
+"""bin/run-local.sh lifecycle smoke (the reference's dev-env tier:
+run-local-kubernetes.sh / Vagrantfile quickstart)."""
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.timeout(120)
+def test_run_local_cluster_lifecycle(tmp_path):
+    env = {**os.environ, "COOK_PORT": "12395", "COOK_AGENTS": "1",
+           "COOK_LOCAL_DIR": str(tmp_path / "local")}
+
+    def sh(*args, timeout=60):
+        return subprocess.run(
+            ["bash", *args], env=env, cwd=REPO, timeout=timeout,
+            capture_output=True, text=True)
+
+    try:
+        up = sh("bin/run-local.sh")
+        assert up.returncode == 0, up.stdout + up.stderr
+        assert "local cluster up" in up.stdout
+
+        st = sh("bin/run-local.sh", "status")
+        assert st.returncode == 0
+        assert '"hosts": 1' in st.stdout
+
+        demo = sh("bin/run-local.sh", "demo", timeout=90)
+        assert demo.returncode == 0, demo.stdout + demo.stderr
+        assert "success" in demo.stdout
+    finally:
+        down = sh("bin/stop-local.sh")
+        assert down.returncode == 0
+
+    st = sh("bin/run-local.sh", "status")
+    assert st.returncode != 0          # coordinator really gone

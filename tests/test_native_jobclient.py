@@ -57,6 +57,20 @@ def test_raw_spec_submit_with_env_and_labels(stack):
         assert job["labels"] == {"team": "tpu"}
 
 
+def test_astral_unicode_round_trips(stack):
+    # the server emits ensure_ascii JSON, so astral chars arrive as
+    # \\ud83d\\ude00-style surrogate pairs — the C++ parser must
+    # recombine them
+    with _client(stack) as c:
+        uuid = c.submit_spec({"command": "t", "mem": 32, "cpus": 0.5,
+                              "name": "emoji",
+                              "env": {"GREETING": "hi \U0001F600 there",
+                                      "ACCENT": "café"}})
+        job = c.query(uuid)
+        assert job["env"]["GREETING"] == "hi \U0001F600 there"
+        assert job["env"]["ACCENT"] == "café"
+
+
 def test_wait_for_job_sees_completion(stack):
     with _client(stack) as c:
         uuid = c.submit(command="t", mem=64, cpus=1)
