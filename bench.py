@@ -244,8 +244,16 @@ def bench_pools(n_pools=8, R=1_250, P=12_500, H=1_250, U=100, C=1_024):
     parts = [_cycle_setup(R, P, H, U, seed=s)[0] for s in range(n_pools)]
     args = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
     mesh = pool_par.make_pool_mesh(1)
+    # Single-device pools are vmapped, where lax.cond lowers to select:
+    # the dense mop-up rounds can't be runtime-skipped the way the
+    # single-pool headline skips them (match.py need_dense cond), so cap
+    # them explicitly — 2 rounds keep the straggler mop-up while
+    # dropping ~9 ms/cycle of always-on dense sweeps. On a multi-chip
+    # mesh (1 pool/device, no vmap) the cond skip works and the default
+    # applies.
     runner = pool_par.pool_sharded_cycle(mesh, num_considerable=C,
-                                         sequential=False)
+                                         sequential=False,
+                                         match_kw={"dense_rounds": 2})
 
     t0 = time.perf_counter()
     out = runner(args)
@@ -388,11 +396,12 @@ def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
     cycles = 0
     t0 = time.perf_counter()
     while placed_total < total_jobs:
-        # pipeline 8 cycles per sync
-        for _ in range(8):
+        # pipeline 32 cycles per sync: the tunnel's ~100 ms readback RTT
+        # otherwise dominates (at 8/sync it was ~25% of wall time)
+        for _ in range(32):
             out = fn(*args)
             cycles += 1
-        placed_total += int((np.asarray(out.job_host) >= 0).sum()) * 8
+        placed_total += int((np.asarray(out.job_host) >= 0).sum()) * 32
     wall = time.perf_counter() - t0
     jps = placed_total / wall
 

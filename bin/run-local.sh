@@ -74,14 +74,18 @@ python -m cook_tpu.rest.server --config "${DIR}/config.json" \
     > "${DIR}/server.log" 2>&1 &
 echo $! > "${DIR}/server.pid"
 
-for i in $(seq 1 50); do
+for i in $(seq 1 100); do
     curl -fsS "${URL}/info" >/dev/null 2>&1 && break
     if ! kill -0 "$(cat "${DIR}/server.pid")" 2>/dev/null; then
         echo "coordinator died; see ${DIR}/server.log" >&2; exit 1
     fi
     sleep 0.2
 done
-curl -fsS "${URL}/info" >/dev/null
+if ! curl -fsS "${URL}/info" >/dev/null 2>&1; then
+    echo "coordinator not serving after 20s; see ${DIR}/server.log" >&2
+    "${REPO}/bin/stop-local.sh" >/dev/null 2>&1 || true
+    exit 1
+fi
 
 for i in $(seq 1 "${AGENTS}"); do
     host="agent${i}"
@@ -95,7 +99,8 @@ for i in $(seq 1 "${AGENTS}"); do
 done
 
 echo "waiting for ${AGENTS} agents to register..."
-for i in $(seq 1 50); do
+n=0
+for i in $(seq 1 100); do
     n=$(curl -fsS "${URL}/debug" 2>/dev/null \
         | python -c "import json,sys; d=json.load(sys.stdin); \
 print(sum(c.get('hosts', 0) if isinstance(c, dict) else 0 \
@@ -103,6 +108,12 @@ for c in d.get('clusters', {}).values()))" 2>/dev/null || echo 0)
     [ "${n}" -ge "${AGENTS}" ] && break
     sleep 0.2
 done
+if [ "${n}" -lt "${AGENTS}" ]; then
+    echo "only ${n}/${AGENTS} agents registered after 20s; see" \
+         "${DIR}/agent*.log" >&2
+    "${REPO}/bin/stop-local.sh" >/dev/null 2>&1 || true
+    exit 1
+fi
 
 echo "local cluster up: ${URL} (${AGENTS} agents)"
 echo "  submit:  python -m cook_tpu.cli --url ${URL} submit echo hi"

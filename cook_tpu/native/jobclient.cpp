@@ -232,8 +232,11 @@ class JsonParser {
             unsigned cp = hex4();
             // combine UTF-16 surrogate pairs (json.dumps with
             // ensure_ascii emits astral chars as \uD8xx\uDCxx pairs);
-            // a lone/mismatched surrogate folds to U+FFFD
-            if (cp >= 0xD800 && cp <= 0xDBFF) {
+            // a lone/mismatched surrogate folds to U+FFFD. A high
+            // surrogate followed by another high surrogate emits FFFD
+            // and re-tries pairing with the second one, so a stray
+            // \uD800 before a valid pair keeps the pair intact.
+            while (cp >= 0xD800 && cp <= 0xDBFF) {
               if (pos_ + 6 <= s_.size() && s_[pos_] == '\\' &&
                   s_[pos_ + 1] == 'u') {
                 pos_ += 2;
@@ -241,13 +244,14 @@ class JsonParser {
                 if (lo >= 0xDC00 && lo <= 0xDFFF) {
                   cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
                 } else {
-                  out += "\xEF\xBF\xBD";  // U+FFFD for the high half
-                  cp = (lo >= 0xD800 && lo <= 0xDFFF) ? 0xFFFD : lo;
+                  out += "\xEF\xBF\xBD";  // U+FFFD for the lone high half
+                  cp = lo;  // may itself be a high surrogate: loop
                 }
               } else {
                 cp = 0xFFFD;
               }
-            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+            }
+            if (cp >= 0xDC00 && cp <= 0xDFFF) {
               cp = 0xFFFD;  // stray low surrogate
             }
             if (cp < 0x80) {
@@ -583,17 +587,24 @@ class JobClient {
   }
 
   // Listener-polling equivalent (JobClient.java status-update loop).
-  Job wait_for_job(const std::string& uuid, int timeout_ms,
-                   int poll_ms = 1000) {
+  // Returns the exact JSON of the poll that showed completion (no
+  // re-read race with a concurrent retry).
+  Json wait_for_job_json(const std::string& uuid, int timeout_ms,
+                         int poll_ms = 1000) {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
     while (true) {
-      Job job = query(uuid);
-      if (job.completed()) return job;
+      Json j = call("GET", "/jobs/" + uuid, "");
+      if (j.get_str("status") == "completed") return j;
       if (std::chrono::steady_clock::now() >= deadline)
         throw std::runtime_error("timeout waiting for " + uuid);
       std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
     }
+  }
+
+  Job wait_for_job(const std::string& uuid, int timeout_ms,
+                   int poll_ms = 1000) {
+    return Job::from_json(wait_for_job_json(uuid, timeout_ms, poll_ms));
   }
 
   Json call(const std::string& method, const std::string& path,
@@ -739,23 +750,12 @@ int cook_retry(void* handle, const char* uuid, int retries) {
 }
 
 // Blocks until completion; returns final job JSON (malloc'd) or NULL.
-// One GET per poll — the JSON that showed status=completed is exactly
-// what is returned (no re-read race with a concurrent /retry).
 char* cook_wait_for_job(void* handle, const char* uuid, int timeout_ms,
                         int poll_ms) {
   auto* h = static_cast<CookHandle*>(handle);
   try {
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms);
-    while (true) {
-      cook::Json j =
-          h->client->call("GET", std::string("/jobs/") + uuid, "");
-      if (j.get_str("status") == "completed") return dup_str(j.dump());
-      if (std::chrono::steady_clock::now() >= deadline)
-        throw std::runtime_error(std::string("timeout waiting for ") +
-                                 uuid);
-      std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
-    }
+    return dup_str(
+        h->client->wait_for_job_json(uuid, timeout_ms, poll_ms).dump());
   } catch (const std::exception& e) {
     h->last_error = e.what();
     return nullptr;

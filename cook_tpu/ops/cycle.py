@@ -45,7 +45,7 @@ class CycleResult(NamedTuple):
 
 @functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
                                              "sequential", "use_pallas",
-                                             "dru_mode"))
+                                             "dru_mode", "match_kw"))
 def rank_and_match(
     # running tasks (R slots)
     run_user, run_mem, run_cpus, run_prio, run_start, run_valid,
@@ -70,6 +70,11 @@ def rank_and_match(
     run_gpus=None,             # (R,) — required in gpu mode
     run_gpu_share=None,        # (R,) — required in gpu mode
     pend_gpu_share=None,       # (P,) — required in gpu mode
+    match_kw=None,             # extra match_rounds knobs (head_exact,
+                               # dense_rounds, rounds...) for per-config
+                               # tuning; ignored on the sequential path.
+                               # STATIC under jit: pass a hashable
+                               # (tuple of (name, value) pairs)
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -174,9 +179,10 @@ def rank_and_match(
         res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups,
                                    bonus=bonusc)
     else:
-        res = match_ops.match_rounds(jobs, hosts, forb, rounds=4,
+        kw = {"rounds": 4, **dict(match_kw or ())}
+        res = match_ops.match_rounds(jobs, hosts, forb,
                                      num_groups=num_groups, bonus=bonusc,
-                                     use_pallas=use_pallas)
+                                     use_pallas=use_pallas, **kw)
     # scatter back: compact -> original pending order in one scatter
     # (empty compact slots get index P and are dropped)
     scatter_idx = jnp.where(in_use, pend_idx, P)

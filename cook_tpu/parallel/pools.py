@@ -47,17 +47,20 @@ class PoolCycleOut(NamedTuple):
 
 
 def pool_sharded_cycle(mesh: Mesh, num_considerable: int = 1024,
-                       num_groups: int = 1, sequential: bool = True):
+                       num_groups: int = 1, sequential: bool = True,
+                       match_kw=None):
     """Build the jitted pool-sharded cycle fn for `mesh`.
 
     Returns fn(run..., pend..., hosts, forbidden, quotas) where every
     array has a leading pools axis divisible by the mesh size.
     """
 
+    if isinstance(match_kw, dict):   # jit-static: needs a hashable form
+        match_kw = tuple(sorted(match_kw.items()))
     kernel = functools.partial(
         cycle_ops.rank_and_match,
         num_considerable=num_considerable, num_groups=num_groups,
-        sequential=sequential)
+        sequential=sequential, match_kw=match_kw)
 
     def per_pool(args):
         (run_user, run_mem, run_cpus, run_prio, run_start, run_valid,

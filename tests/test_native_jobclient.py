@@ -71,6 +71,16 @@ def test_astral_unicode_round_trips(stack):
         assert job["env"]["ACCENT"] == "café"
 
 
+def test_lone_surrogate_before_pair_keeps_pair(stack):
+    # a stray high surrogate folds to U+FFFD but must not consume the
+    # valid pair that follows it
+    with _client(stack) as c:
+        uuid = c.submit_spec({"command": "t", "mem": 32, "cpus": 0.5,
+                              "env": {"WEIRD": "\ud800\U0001F600"}})
+        job = c.query(uuid)
+        assert job["env"]["WEIRD"] == "�\U0001F600"
+
+
 def test_wait_for_job_sees_completion(stack):
     with _client(stack) as c:
         uuid = c.submit(command="t", mem=64, cpus=1)
