@@ -349,12 +349,19 @@ def bench_rebalance(T0=50_000, P=64, H=2_000, U=500):
         _ = np.asarray(r.job_placed[:1])
         return (time.perf_counter() - t0) / n * 1e3, r
 
-    sweep_ms, res = sweep(5)
+    def robust_sweep(**kw):
+        # host-wall measurement through the tunnel: a transient stall
+        # can inflate one pass 5x, so report the median of 3 passes
+        runs = [sweep(5, **kw) for _ in range(3)]
+        runs.sort(key=lambda t: t[0])
+        return runs[1]
+
+    sweep_ms, res = robust_sweep()
     # top-k candidate compression (valid decisions, exact up to 8192
     # candidates — see ops.rebalance.rebalance candidate_cap)
     reb.rebalance(tasks, pending, spare_mem, spare_cpus, forb,
                   qm, qc, qn, 0.5, 0.1, candidate_cap=8192)
-    capped_ms, res_c = sweep(5, candidate_cap=8192)
+    capped_ms, res_c = robust_sweep(candidate_cap=8192)
 
     print(json.dumps({
         "metric": f"rebalancer sweep ms @ {T0 // 1000}k running, "

@@ -99,6 +99,7 @@ class SchedulerSettings:
     rebalancer_safe_dru_threshold: float = 1.0
     rebalancer_min_dru_diff: float = 0.5
     rebalancer_max_preemption: int = 64
+    rebalancer_candidate_cap: int = 0   # 0 = exact; >0 = top-K victims
     sequential_match_threshold: int = 2048
     use_pallas: bool = False            # fused TPU kernel for dense rounds
     # hash-sharded in-order status executors (scheduler.clj:1524-1546);

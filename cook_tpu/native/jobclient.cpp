@@ -99,7 +99,9 @@ struct Json {
       case NUL: *out += "null"; break;
       case BOOL: *out += b ? "true" : "false"; break;
       case NUM: {
-        if (num == static_cast<long long>(num) &&
+        if (num == 0 && std::signbit(num)) {
+          *out += "-0.0";  // the integer fast path would drop the sign
+        } else if (num == static_cast<long long>(num) &&
             std::fabs(num) < 9.0e15) {
           char buf[32];
           std::snprintf(buf, sizeof buf, "%lld",
@@ -640,6 +642,17 @@ static char* dup_str(const std::string& s) {
   char* out = static_cast<char*>(std::malloc(s.size() + 1));
   std::memcpy(out, s.c_str(), s.size() + 1);
   return out;
+}
+
+// Parse + re-dump a JSON document (test/fuzz surface for the parser);
+// returns malloc'd JSON or NULL on parse error.
+char* cook_json_roundtrip(const char* in) {
+  if (!in) return nullptr;
+  try {
+    return dup_str(cook::JsonParser(in).parse().dump());
+  } catch (const std::exception&) {
+    return nullptr;
+  }
 }
 
 void* cook_client_new(const char* host, int port, const char* user,

@@ -242,7 +242,8 @@ class CookApi:
         p = self.coord.live_rebalancer_params()
         return Response(200, {"safe-dru-threshold": p.safe_dru_threshold,
                               "min-dru-diff": p.min_dru_diff,
-                              "max-preemption": p.max_preemption})
+                              "max-preemption": p.max_preemption,
+                              "candidate-cap": p.candidate_cap})
 
     def set_rebalancer_params(self, req: Request) -> Response:
         if self.coord is None:
@@ -252,7 +253,7 @@ class CookApi:
         import math
 
         allowed = {"safe-dru-threshold": float, "min-dru-diff": float,
-                   "max-preemption": int}
+                   "max-preemption": int, "candidate-cap": int}
         updates = {}
         for key, value in body.items():
             conv = allowed.get(key)

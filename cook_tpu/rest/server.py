@@ -229,7 +229,8 @@ def build_scheduler(config):
             rebalancer=RebalancerParams(
                 safe_dru_threshold=s.rebalancer_safe_dru_threshold,
                 min_dru_diff=s.rebalancer_min_dru_diff,
-                max_preemption=s.rebalancer_max_preemption),
+                max_preemption=s.rebalancer_max_preemption,
+                candidate_cap=s.rebalancer_candidate_cap),
             sequential_match_threshold=s.sequential_match_threshold,
             use_pallas=s.use_pallas),
         launch_rate_limiter=make_rl("global_launch"),

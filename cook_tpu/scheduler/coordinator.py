@@ -57,6 +57,11 @@ class RebalancerParams:
     safe_dru_threshold: float = 1.0
     min_dru_diff: float = 0.5
     max_preemption: int = 64
+    # 0 = exact sweep over all tasks; >0 compresses each decision's
+    # prefix search to the top-K candidate victims by DRU (~1.5x faster
+    # at 50k running; conservative — can only miss preemptions, never
+    # produce an invalid one). See ops/rebalance.py candidate_cap.
+    candidate_cap: int = 0
 
 
 @dataclass
@@ -714,7 +719,9 @@ class Coordinator:
             min_dru_diff=float(
                 cfg.get("min-dru-diff", base.min_dru_diff)),
             max_preemption=int(
-                cfg.get("max-preemption", base.max_preemption)))
+                cfg.get("max-preemption", base.max_preemption)),
+            candidate_cap=int(
+                cfg.get("candidate-cap", base.candidate_cap)))
 
     # ------------------------------------------------------------------
     # rebalancer cycle (rebalancer.clj:428-518)
@@ -810,10 +817,17 @@ class Coordinator:
                 cpus_share=jb.cpus_share)
             spare_a, spare_b = spare_mem, spare_cpus
             spare_x = None
+        # candidate_cap is jit-static: bucket to the next power of two
+        # so an operator sweeping values live doesn't force a fresh XLA
+        # compile (multi-second at 50k tasks) for every distinct number
+        cap = params.candidate_cap
+        if cap > 0:
+            cap = 1 << (int(cap) - 1).bit_length()
         res = rb_ops.rebalance(
             tasks, pend, spare_a, spare_b, host_forb,
             qm, qc, qn.astype(np.int32) if qn.dtype != np.int32 else qn,
             params.safe_dru_threshold, params.min_dru_diff,
+            candidate_cap=cap or None,
             spare_extra=spare_x)
 
         preempted_rows = np.flatnonzero(np.asarray(res.preempted)[:tb.n])

@@ -1,0 +1,128 @@
+"""Property test: the C++ JSON parser/writer agrees with Python json.
+
+Random JSON trees (nested objects/arrays; strings with escapes,
+control chars, BMP and astral unicode; ints, floats, bools, nulls) are
+dumped by Python (both ensure_ascii modes), round-tripped through
+cook_json_roundtrip (parse + dump in C++), and reloaded with
+json.loads — semantics must match exactly. Lone surrogates are covered
+separately (test_native_jobclient.py) because the C++ parser folds
+them to U+FFFD by design, which Python preserves.
+"""
+import ctypes
+import json
+import math
+import random
+import string
+
+import pytest
+
+from cook_tpu import native as _native
+from cook_tpu.native import jobclient as njc
+
+pytestmark = pytest.mark.skipif(not njc.available(),
+                                reason="native toolchain unavailable")
+
+
+_lib = None
+
+
+def _roundtrip(doc: str):
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(_native.build("jobclient"))
+        _lib.cook_json_roundtrip.argtypes = [ctypes.c_char_p]
+        _lib.cook_json_roundtrip.restype = ctypes.c_void_p
+        _lib.cook_free_str.argtypes = [ctypes.c_void_p]
+    raw = _lib.cook_json_roundtrip(doc.encode())
+    if not raw:
+        return None
+    try:
+        return ctypes.string_at(raw).decode()
+    finally:
+        _lib.cook_free_str(raw)
+
+
+_CHARS = (string.ascii_letters + string.digits + " \"\\/\b\f\n\r\t{}[],:"
+          + "éüñ中文😀𝔘   \x00\x1f\x7f")
+
+
+def _rand_string(rng):
+    return "".join(rng.choice(_CHARS) for _ in range(rng.randrange(0, 20)))
+
+
+def _rand_value(rng, depth=0):
+    kinds = ["str", "int", "float", "bool", "null"]
+    if depth < 4:
+        kinds += ["obj", "arr", "obj", "arr"]
+    k = rng.choice(kinds)
+    if k == "str":
+        return _rand_string(rng)
+    if k == "int":
+        # stay within the writer's exact-integer window (|x| < 9e15)
+        return rng.randrange(-(2 ** 53) + 1, 2 ** 53 - 1)
+    if k == "float":
+        f = rng.choice([rng.uniform(-1e6, 1e6), rng.uniform(-1e-6, 1e-6),
+                        rng.uniform(-1e300, 1e300), 0.0, -0.0, 1e15 + 0.5])
+        return f
+    if k == "bool":
+        return rng.random() < 0.5
+    if k == "null":
+        return None
+    if k == "arr":
+        return [_rand_value(rng, depth + 1)
+                for _ in range(rng.randrange(0, 5))]
+    return {_rand_string(rng): _rand_value(rng, depth + 1)
+            for _ in range(rng.randrange(0, 5))}
+
+
+def _norm(v):
+    """Fold int/float equivalence: the C++ Json holds every number as a
+    double, so 5 and 5.0 are the same value (JSON has one number type)."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in v.items()}
+    return v
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_cpp_json_matches_python(seed):
+    rng = random.Random(seed)
+    for _ in range(40):
+        value = _rand_value(rng)
+        for ensure_ascii in (True, False):
+            doc = json.dumps(value, ensure_ascii=ensure_ascii)
+            out = _roundtrip(doc)
+            assert out is not None, f"parse failed: {doc[:200]!r}"
+            got = json.loads(out)
+            assert _norm(got) == _norm(value), (
+                f"mismatch for {doc[:200]!r} -> {out[:200]!r}")
+
+
+def test_malformed_documents_rejected():
+    for doc in ('{', '[1,', '"\\x"', '{"a" 1}', '[01x]', 'tru', '"\\u12"',
+                '{"a":1,}', '', '[1]]', 'nan', '{"a"}'):
+        assert _roundtrip(doc) is None, f"accepted malformed: {doc!r}"
+
+
+def test_number_edge_cases():
+    for doc, want in [("1e308", 1e308),
+                      ("9007199254740992", 9007199254740992.0),
+                      ("2.2250738585072014e-308", 2.2250738585072014e-308),
+                      ("1E+2", 100.0), ("-1.5e-3", -0.0015)]:
+        out = _roundtrip(doc)
+        assert out is not None
+        assert json.loads(out) == want
+    # -0.0 keeps its sign (== can't see it: 0.0 == -0.0 in Python)
+    neg_zero = json.loads(_roundtrip("-0.0"))
+    assert math.copysign(1.0, neg_zero) == -1.0
+
+
+def test_deep_nesting_survives():
+    doc = "[" * 200 + "1" + "]" * 200
+    out = _roundtrip(doc)
+    assert out is not None and json.loads(out) == json.loads(doc)

@@ -206,13 +206,40 @@ def test_low_share_user_preempted_for_high_share_user(stack):
 def test_rebalancer_params_settable_over_rest(stack):
     s = stack([MockHost("h0", mem=256, cpus=8)])
     got = s.admin._request("GET", "/rebalancer")
-    assert "min-dru-diff" in got
+    assert "min-dru-diff" in got and "candidate-cap" in got
     s.admin._request("POST", "/rebalancer",
                      body={"safe-dru-threshold": 0.0,
                            "min-dru-diff": 0.5,
-                           "max-preemption": 3})
+                           "max-preemption": 3,
+                           "candidate-cap": 4096})
     live = s.coord.live_rebalancer_params()
     assert live.min_dru_diff == 0.5 and live.max_preemption == 3
+    assert live.candidate_cap == 4096
+
+
+def test_preemption_equal_with_candidate_cap(stack):
+    # candidate_cap=2 < T engages the top-K compression branch for real
+    # (kernel-level capped-vs-exact equality lives in
+    # tests/test_rebalance.py::test_candidate_cap_matches_exact_when_k_covers);
+    # the top-2 victims by DRU free 128 mem / 2 cpus, so the vip job
+    # still lands
+    cfg = SchedulerConfig(
+        rebalancer=RebalancerParams(
+            safe_dru_threshold=0.0, min_dru_diff=0.01, max_preemption=8,
+            candidate_cap=2))
+    s = stack([MockHost("h0", mem=256, cpus=8)], config=cfg)
+    s.set_share("greedy", mem=10, cpus=10)
+    s.set_share("vip", mem=1000, cpus=1000)
+    greedy, vip = s.client("greedy"), s.client("vip")
+    for _ in range(4):
+        greedy.submit(command="t", mem=64, cpus=1, max_retries=5)
+    s.coord.match_cycle()
+    v = vip.submit(command="t", mem=128, cpus=2)
+    s.coord.match_cycle()
+    res = s.coord.rebalance_cycle()
+    assert res["preempted"] >= 1
+    s.coord.match_cycle()
+    assert vip.query(v).status == "running"
 
 
 # ---------------------------------------------------------------------------
