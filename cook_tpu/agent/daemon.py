@@ -30,6 +30,7 @@ import logging
 import socket
 import threading
 import time
+import urllib.error
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -41,6 +42,14 @@ logger = logging.getLogger(__name__)
 
 
 class AgentDaemon:
+    """`coordinator_url` may be a comma-separated list of candidate
+    coordinator URLs (an HA deployment's members): the daemon posts to
+    one, rotates on connection failure, and follows the `leader` hint a
+    non-leader standby returns with 503 — the agent-side half of leader
+    failover. After any switch, the new leader's heartbeat response
+    carries `reregister` (it doesn't know us) and the existing
+    re-registration path restores capacity + the live task list."""
+
     def __init__(self, coordinator_url: str,
                  hostname: Optional[str] = None,
                  mem: float = 8192.0, cpus: float = 8.0, gpus: float = 0.0,
@@ -52,7 +61,12 @@ class AgentDaemon:
                  advertise_host: str = "127.0.0.1",
                  agent_token: str = "",
                  bind_host: str = "127.0.0.1"):
-        self.coordinator_url = coordinator_url.rstrip("/")
+        self._urls = [u.strip().rstrip("/")
+                      for u in coordinator_url.split(",") if u.strip()]
+        if not self._urls:
+            raise ValueError("coordinator_url is empty")
+        self._url_idx = 0
+        self._hint_url: Optional[str] = None  # at most ONE learned URL
         self.hostname = hostname or socket.gethostname()
         self.mem, self.cpus, self.gpus = mem, cpus, gpus
         self.pool = pool
@@ -199,12 +213,56 @@ class AgentDaemon:
             "task_id": task_id, "sequence": sequence,
             "percent": percent, "message": message}, attempts=1)
 
+    @property
+    def coordinator_url(self) -> str:
+        return self._urls[self._url_idx]
+
+    def _switch_to(self, url: str) -> None:
+        url = url.rstrip("/")
+        if url not in self._urls:
+            # keep at most one hint-learned URL beyond the configured
+            # candidates: dead ex-leader addresses must not accumulate
+            # (each dead entry costs a full connect timeout per rotation)
+            if self._hint_url is not None and self._hint_url in self._urls:
+                self._urls.remove(self._hint_url)
+            self._hint_url = url
+            self._urls.append(url)
+            self._url_idx %= len(self._urls)
+        if self._urls[self._url_idx] != url:
+            logger.info("coordinator failover: %s -> %s",
+                        self.coordinator_url, url)
+            self._url_idx = self._urls.index(url)
+
     def _post(self, path: str, payload: dict) -> dict:
+        """POST to the current coordinator; on connection failure rotate
+        through the candidate list, on a 503 not-leader answer follow
+        its leader hint. Raises after one full cycle of candidates."""
         headers = {}
         if self.agent_token:
             headers["X-Cook-Agent-Token"] = self.agent_token
-        return json_request("POST", self.coordinator_url + path, payload,
-                            headers=headers)
+        last_exc: Exception = RuntimeError("no coordinator candidates")
+        for _ in range(len(self._urls) + 1):
+            url = self.coordinator_url
+            try:
+                return json_request("POST", url + path, payload,
+                                    headers=headers)
+            except urllib.error.HTTPError as e:
+                if e.code != 503:
+                    raise
+                try:
+                    hint = json.loads(e.read() or b"{}").get("leader")
+                except Exception:
+                    hint = None
+                last_exc = e
+                if hint and hint.rstrip("/") != url:
+                    self._switch_to(hint)
+                else:
+                    # standby with no leader yet: try the next candidate
+                    self._url_idx = (self._url_idx + 1) % len(self._urls)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last_exc = e
+                self._url_idx = (self._url_idx + 1) % len(self._urls)
+        raise last_exc
 
     def _post_retry(self, path: str, payload: dict,
                     attempts: int = 3) -> None:
@@ -259,7 +317,9 @@ def main(argv=None) -> None:
         prog="cook_tpu.agent",
         description="cook_tpu network agent (remote task execution)")
     ap.add_argument("--coordinator", required=True,
-                    help="coordinator base URL, e.g. http://head:12321")
+                    help="coordinator base URL(s), comma-separated for "
+                         "an HA deployment, e.g. "
+                         "http://head1:12321,http://head2:12321")
     ap.add_argument("--hostname", default=None)
     ap.add_argument("--mem", type=float, default=8192.0)
     ap.add_argument("--cpus", type=float, default=8.0)

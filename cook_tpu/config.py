@@ -111,6 +111,9 @@ class SchedulerSettings:
             raise ConfigError("max_jobs_considered must be >= 1")
         if not 0 < self.scaleback <= 1:
             raise ConfigError("scaleback must be in (0, 1]")
+        if self.rebalancer_candidate_cap < 0:
+            raise ConfigError("rebalancer_candidate_cap must be >= 0 "
+                              "(0 = exact sweep)")
 
 
 @dataclass

@@ -167,6 +167,16 @@ class CookApi:
                     raise AuthError(
                         401, "agent channel requires auth.agent_token "
                              "when user auth is enabled")
+                # an API-only standby must not absorb agent writes into
+                # its non-authoritative cluster state: refuse with the
+                # leader's address so the daemon can fail over (the
+                # Mesos-master-HA role of the reference's transport)
+                elector = getattr(self, "leader_elector", None)
+                if elector is not None and not elector.is_leader():
+                    return Response(503, {
+                        "error": "not leader",
+                        "leader": elector.current_leader()
+                        or self.leader_url})
             elif path not in ("/info", "/debug",
                               "/metrics"):  # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)

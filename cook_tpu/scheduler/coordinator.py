@@ -827,7 +827,7 @@ class Coordinator:
             tasks, pend, spare_a, spare_b, host_forb,
             qm, qc, qn.astype(np.int32) if qn.dtype != np.int32 else qn,
             params.safe_dru_threshold, params.min_dru_diff,
-            candidate_cap=cap or None,
+            candidate_cap=cap if cap > 0 else None,
             spare_extra=spare_x)
 
         preempted_rows = np.flatnonzero(np.asarray(res.preempted)[:tb.n])

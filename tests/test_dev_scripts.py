@@ -8,7 +8,6 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.timeout(120)
 def test_run_local_cluster_lifecycle(tmp_path):
     env = {**os.environ, "COOK_PORT": "12395", "COOK_AGENTS": "1",
            "COOK_LOCAL_DIR": str(tmp_path / "local")}

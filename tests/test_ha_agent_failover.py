@@ -1,0 +1,155 @@
+"""Agent failover across a leader change, multi-process.
+
+The test_master_slave.py tier, end to end over real processes: two
+coordinator processes race for a Lease on the apiserver stand-in, one
+agent daemon process carries both URLs. The leader is SIGKILLed; the
+standby must take the lease, the agent must rotate to it (guided by the
+standby's earlier 503 not-leader answers), and a job submitted to the
+NEW leader must run to success on the agent.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cook_tpu.backends.kube.standin import ApiServerStandIn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def req(url, method="GET", body=None, timeout=5):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        p = resp.read()
+        return json.loads(p) if p else None
+
+
+def wait_until(fn, timeout=30.0, interval=0.2, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            v = fn()
+        except Exception:
+            v = None
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"{msg} not met within {timeout}s")
+
+
+def spawn_server(tmp_path, port, lease_url):
+    cfg = {
+        "port": port,
+        "url": f"http://127.0.0.1:{port}",
+        "clusters": [{"kind": "agent", "name": "agents",
+                      "agent_heartbeat_timeout_s": 5.0}],
+        "leader_lease_url": lease_url,
+        "leader_lease_duration_s": 2.0,
+    }
+    cfg_path = tmp_path / f"server{port}.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    return subprocess.Popen(
+        [sys.executable, "-m", "cook_tpu.rest.server",
+         "--config", str(cfg_path)],
+        env=env, cwd=REPO,
+        stdout=open(tmp_path / f"server{port}.log", "wb"),
+        stderr=subprocess.STDOUT)
+
+
+def spawn_agent(tmp_path, urls):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    return subprocess.Popen(
+        [sys.executable, "-m", "cook_tpu.agent",
+         "--coordinator", ",".join(urls), "--hostname", "ha-agent",
+         "--mem", "1024", "--cpus", "4",
+         "--sandbox-root", str(tmp_path / "sandboxes"),
+         "--heartbeat-interval", "0.5"],
+        env=env, cwd=REPO,
+        stdout=open(tmp_path / "agent.log", "wb"),
+        stderr=subprocess.STDOUT)
+
+
+def leader_of(urls):
+    for u in urls:
+        info = req(u + "/info")
+        if info and info.get("is-leader"):
+            return u
+    return None
+
+
+def agent_count(url):
+    d = req(url + "/debug")
+    return sum(c.get("hosts", 0) for c in d.get("clusters", {}).values())
+
+
+def test_leader_kill_agent_fails_over_and_runs_jobs(tmp_path):
+    apiserver = ApiServerStandIn()
+    procs = []
+    try:
+        s1 = spawn_server(tmp_path, 12391, apiserver.url)
+        procs.append(s1)
+        # let the first server win the lease deterministically
+        wait_until(lambda: leader_of(["http://127.0.0.1:12391"]),
+                   msg="first leader")
+        s2 = spawn_server(tmp_path, 12392, apiserver.url)
+        procs.append(s2)
+        urls = ["http://127.0.0.1:12391", "http://127.0.0.1:12392"]
+        wait_until(lambda: req(urls[1] + "/info"), msg="standby up")
+
+        agent = spawn_agent(tmp_path, urls)
+        procs.append(agent)
+        leader = leader_of(urls)
+        assert leader == urls[0]
+        wait_until(lambda: agent_count(leader) >= 1,
+                   msg="agent registered with leader")
+
+        # a job runs end to end under the first leader
+        out = req(leader + "/jobs", method="POST",
+                  body={"jobs": [{"command": "echo one", "mem": 64,
+                                  "cpus": 1}]})
+        uuid1 = out["jobs"][0]
+        wait_until(lambda: req(f"{leader}/jobs/{uuid1}")["state"]
+                   == "success", msg="job 1 success")
+
+        # the standby's /agents channel refuses with a leader hint
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req(urls[1] + "/agents/heartbeat", method="POST",
+                body={"hostname": "probe", "tasks": []})
+        assert ei.value.code == 503
+        hint = json.loads(ei.value.read())
+        assert hint["leader"] == urls[0]
+
+        # kill the leader; the standby takes the lease within the TTL
+        s1.send_signal(signal.SIGKILL)
+        wait_until(lambda: leader_of([urls[1]]) == urls[1], timeout=30,
+                   msg="standby takes over")
+
+        # the agent rotates to the new leader and re-registers
+        wait_until(lambda: agent_count(urls[1]) >= 1, timeout=30,
+                   msg="agent re-registered with new leader")
+
+        # a job submitted to the NEW leader runs on the same agent
+        out = req(urls[1] + "/jobs", method="POST",
+                  body={"jobs": [{"command": "echo two", "mem": 64,
+                                  "cpus": 1}]})
+        uuid2 = out["jobs"][0]
+        wait_until(lambda: req(f"{urls[1]}/jobs/{uuid2}")["state"]
+                   == "success", timeout=60, msg="job 2 success")
+        job2 = req(f"{urls[1]}/jobs/{uuid2}")
+        assert job2["instances"][0]["hostname"] == "ha-agent"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+        apiserver.close()
