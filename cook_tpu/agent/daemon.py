@@ -67,6 +67,9 @@ class AgentDaemon:
             raise ValueError("coordinator_url is empty")
         self._url_idx = 0
         self._hint_url: Optional[str] = None  # at most ONE learned URL
+        # _post runs from heartbeat, executor-callback, and HTTP handler
+        # threads concurrently: all failover-state mutation is locked
+        self._url_lock = threading.Lock()
         self.hostname = hostname or socket.gethostname()
         self.mem, self.cpus, self.gpus = mem, cpus, gpus
         self.pool = pool
@@ -215,23 +218,34 @@ class AgentDaemon:
 
     @property
     def coordinator_url(self) -> str:
-        return self._urls[self._url_idx]
+        with self._url_lock:
+            return self._urls[self._url_idx]
 
     def _switch_to(self, url: str) -> None:
         url = url.rstrip("/")
-        if url not in self._urls:
-            # keep at most one hint-learned URL beyond the configured
-            # candidates: dead ex-leader addresses must not accumulate
-            # (each dead entry costs a full connect timeout per rotation)
-            if self._hint_url is not None and self._hint_url in self._urls:
-                self._urls.remove(self._hint_url)
-            self._hint_url = url
-            self._urls.append(url)
-            self._url_idx %= len(self._urls)
-        if self._urls[self._url_idx] != url:
-            logger.info("coordinator failover: %s -> %s",
-                        self.coordinator_url, url)
-            self._url_idx = self._urls.index(url)
+        with self._url_lock:
+            if url not in self._urls:
+                # keep at most one hint-learned URL beyond the configured
+                # candidates: dead ex-leader addresses must not
+                # accumulate (each dead entry costs a full connect
+                # timeout per rotation)
+                if self._hint_url is not None \
+                        and self._hint_url in self._urls:
+                    self._urls.remove(self._hint_url)
+                self._hint_url = url
+                self._urls.append(url)
+                self._url_idx %= len(self._urls)
+            if self._urls[self._url_idx] != url:
+                logger.info("coordinator failover: %s -> %s",
+                            self._urls[self._url_idx], url)
+                self._url_idx = self._urls.index(url)
+
+    def _rotate_from(self, url: str) -> None:
+        """Advance past `url` — only if another thread hasn't already
+        moved the pointer elsewhere."""
+        with self._url_lock:
+            if self._urls[self._url_idx] == url:
+                self._url_idx = (self._url_idx + 1) % len(self._urls)
 
     def _post(self, path: str, payload: dict) -> dict:
         """POST to the current coordinator; on connection failure rotate
@@ -241,7 +255,9 @@ class AgentDaemon:
         if self.agent_token:
             headers["X-Cook-Agent-Token"] = self.agent_token
         last_exc: Exception = RuntimeError("no coordinator candidates")
-        for _ in range(len(self._urls) + 1):
+        with self._url_lock:
+            attempts = len(self._urls) + 1
+        for _ in range(attempts):
             url = self.coordinator_url
             try:
                 return json_request("POST", url + path, payload,
@@ -258,10 +274,10 @@ class AgentDaemon:
                     self._switch_to(hint)
                 else:
                     # standby with no leader yet: try the next candidate
-                    self._url_idx = (self._url_idx + 1) % len(self._urls)
+                    self._rotate_from(url)
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 last_exc = e
-                self._url_idx = (self._url_idx + 1) % len(self._urls)
+                self._rotate_from(url)
         raise last_exc
 
     def _post_retry(self, path: str, payload: dict,

@@ -151,6 +151,9 @@ class Settings:
     metrics_jsonl: Optional[str] = None
     metrics_interval_s: float = 60.0
     plugins: dict = field(default_factory=dict)
+    # {"optimizer": "pkg.mod:factory" | "capacity-planning",
+    #  "host_feed": "pkg.mod:factory", "interval_s": 30}
+    optimizer: dict = field(default_factory=dict)
     data_locality: dict = field(default_factory=dict)
     # {fetcher: "pkg.mod:factory", weight: 0.25, batch_size: 500}
     # cluster-wide default-checkpoint-config (config/kubernetes

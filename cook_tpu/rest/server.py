@@ -240,6 +240,26 @@ def build_scheduler(config):
         checkpoint_defaults=config.checkpoint or None,
         status_shards=s.status_shards)
 
+    # optimizer cycle (start-optimizer-cycles! mesos.clj:216,
+    # optimizer.clj:115): config {"optimizer": {"optimizer": "pkg:fn",
+    # "host_feed": "pkg:fn", "interval_s": 30}} — or the built-in
+    # capacity planner with "optimizer": "capacity-planning"
+    coord.optimizer_cycle = None
+    opt_cfg = getattr(config, "optimizer", None) or {}
+    if opt_cfg.get("optimizer"):
+        from cook_tpu.plugins import resolve_plugin
+        from cook_tpu.scheduler.optimizer import (
+            CapacityPlanningOptimizer, HostFeed, OptimizerCycle)
+        spec = opt_cfg["optimizer"]
+        opt = CapacityPlanningOptimizer() if spec == "capacity-planning" \
+            else resolve_plugin(spec)
+        feed = resolve_plugin(opt_cfg["host_feed"]) \
+            if opt_cfg.get("host_feed") else HostFeed()
+        coord.optimizer_cycle = OptimizerCycle(
+            store=store, clusters=coord.clusters, optimizer=opt,
+            host_feed=feed,
+            interval_s=float(opt_cfg.get("interval_s", 30.0)))
+
     monitor = StatsMonitor(store, coord.shares, metrics_mod.registry)
     api = CookApi(
         store, coordinator=coord,

@@ -975,6 +975,9 @@ class Coordinator:
         loop(self.config.match_interval_s, self.match_cycle)
         loop(self.config.rebalancer_interval_s, self.rebalance_cycle)
         loop(60.0, self.watchdog_cycle, per_pool=False)
+        opt = getattr(self, "optimizer_cycle", None)
+        if opt is not None:   # start-optimizer-cycles! (optimizer.clj:115)
+            loop(opt.interval_s, opt.cycle)
         if self.progress_aggregator is not None:
             loop(1.0, self.progress_aggregator.publish, per_pool=False)
         if self.heartbeats is not None:

@@ -70,6 +70,23 @@ def test_build_scheduler_from_settings():
     assert api.plugins is not None
 
 
+def test_build_scheduler_wires_optimizer():
+    from cook_tpu.rest.server import build_scheduler
+    from cook_tpu.scheduler.optimizer import CapacityPlanningOptimizer
+    store, coord, api = build_scheduler({
+        "clusters": [{"kind": "mock", "hosts": 1}],
+        "optimizer": {"optimizer": "capacity-planning",
+                      "interval_s": 5.0}})
+    cyc = coord.optimizer_cycle
+    assert cyc is not None and cyc.interval_s == 5.0
+    assert isinstance(cyc.optimizer, CapacityPlanningOptimizer)
+    schedule = cyc.cycle()
+    assert 0 in schedule
+    # absent config -> no cycle
+    _, coord2, _ = build_scheduler({"clusters": [{"kind": "mock"}]})
+    assert coord2.optimizer_cycle is None
+
+
 # -- leader election ---------------------------------------------------
 def test_standalone_elector():
     calls = []

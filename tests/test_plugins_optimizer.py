@@ -203,7 +203,7 @@ def test_optimizer_failure_keeps_last_schedule():
     store, cluster, coord = build()
     cyc = OptimizerCycle(store=store, clusters=coord.clusters,
                          optimizer=Boom())
-    cyc.last_schedule = {0: {"suggested-matches": {"x": []}}}
+    cyc.last_schedules["default"] = {0: {"suggested-matches": {"x": []}}}
     assert cyc.cycle() == {0: {"suggested-matches": {"x": []}}}
 
 
@@ -347,3 +347,50 @@ def test_sharded_match_refuses_unique_groups():
     hosts = match_ops.make_hosts(mem=[10.0] * 4, cpus=[10.0] * 4)
     with _pytest.raises(ValueError, match="group"):
         fn(jobs, hosts, jnp.zeros((2, 4), bool))
+
+
+def test_capacity_planning_optimizer_covers_unmet_demand():
+    from cook_tpu.scheduler.optimizer import (CapacityPlanningOptimizer,
+                                              StaticHostFeed)
+
+    class J:
+        def __init__(self, mem, cpus, gpus=0.0):
+            self.mem, self.cpus, self.gpus = mem, cpus, gpus
+
+    class O:
+        def __init__(self, mem, cpus, gpus=0.0):
+            self.mem, self.cpus, self.gpus = mem, cpus, gpus
+
+    catalog = [HostType("cpu-big", mem=8192, cpus=32, count=10),
+               HostType("cpu-small", mem=1024, cpus=4, count=10),
+               HostType("gpu-node", mem=4096, cpus=16, gpus=4, count=2)]
+    opt = CapacityPlanningOptimizer()
+
+    # queue demand exceeds offers: purchases must cover the gap
+    queue = [J(4096, 8) for _ in range(4)] + [J(1024, 2, gpus=2)]
+    offers = [O(2048, 8)]
+    sched = opt.produce_schedule(queue, [], offers, catalog)
+    buys = sched[0]["suggested-purchases"]
+    assert buys.get("gpu-node", 0) >= 1          # gpu demand -> gpu host
+    bought_mem = sum(t.mem * buys.get(t.name, 0) for t in catalog)
+    assert bought_mem >= (4 * 4096 + 1024) - 2048
+    # catalog count limits respected
+    for t in catalog:
+        assert buys.get(t.name, 0) <= t.count
+
+    # offers already cover demand: buy nothing
+    sched = opt.produce_schedule([J(512, 1)], [], [O(8192, 32)], catalog)
+    assert sched[0]["suggested-purchases"] == {}
+
+    # empty queue: buy nothing
+    sched = opt.produce_schedule([], [], [], catalog)
+    assert sched[0]["suggested-purchases"] == {}
+
+    # feed plumbing works through the cycle
+    store, cluster, coord = build()
+    store.create_jobs([mkjob() for _ in range(50)])
+    cyc = OptimizerCycle(store=store, clusters=coord.clusters,
+                         optimizer=CapacityPlanningOptimizer(),
+                         host_feed=StaticHostFeed(hosts=catalog))
+    schedule = cyc.cycle()
+    assert isinstance(schedule[0]["suggested-purchases"], dict)
