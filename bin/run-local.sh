@@ -11,7 +11,9 @@
 #   bin/run-local.sh demo       submit a demo job and wait for success
 #   bin/stop-local.sh           stop everything
 #
-# Env knobs: COOK_PORT (12321), COOK_AGENTS (2), COOK_LOCAL_DIR
+# Env knobs: COOK_PORT (12321), COOK_AGENTS (2), COOK_KUBE=1 (use the
+# kube backend against an apiserver stand-in + kubelet sim instead of
+# agent daemons), COOK_LOCAL_DIR
 # (/tmp/cook_tpu_local).
 set -euo pipefail
 
@@ -55,13 +57,39 @@ esac
 "${REPO}/bin/stop-local.sh" >/dev/null 2>&1 || true
 mkdir -p "${DIR}"
 
+if [ "${COOK_KUBE:-0}" = "1" ]; then
+    # kube mode (the run-local-kubernetes.sh role): apiserver stand-in
+    # with an autonomous kubelet sim instead of agent daemons
+    KUBE_PORT=$((PORT + 60))
+    python -m cook_tpu.backends.kube.standin \
+        --port "${KUBE_PORT}" --nodes "${AGENTS}" --kubelet-sim \
+        --pod-runtime 3 > "${DIR}/apiserver.log" 2>&1 &
+    echo $! > "${DIR}/agent-kube.pid"
+    for i in $(seq 1 50); do
+        curl -fsS "http://127.0.0.1:${KUBE_PORT}/api/v1/namespaces/cook/pods" \
+            >/dev/null 2>&1 && break
+        if ! kill -0 "$(cat "${DIR}/agent-kube.pid")" 2>/dev/null; then
+            echo "apiserver stand-in died; see ${DIR}/apiserver.log" >&2
+            exit 1
+        fi
+        sleep 0.2
+    done
+    HOST_LOGS="${DIR}/apiserver.log"
+    CLUSTERS='{"kind": "kube", "name": "local-kube",
+     "kube_url": "http://127.0.0.1:'"${KUBE_PORT}"'",
+     "kube_namespace": "cook"}'
+else
+    HOST_LOGS="${DIR}/agent*.log"
+    CLUSTERS='{"kind": "agent", "name": "local-agents",
+     "agent_heartbeat_timeout_s": 10.0}'
+fi
+
 cat > "${DIR}/config.json" <<EOF
 {
   "port": ${PORT},
   "url": "${URL}",
   "clusters": [
-    {"kind": "agent", "name": "local-agents",
-     "agent_heartbeat_timeout_s": 10.0}
+    ${CLUSTERS}
   ],
   "log_path": "${DIR}/eventlog",
   "snapshot_path": "${DIR}/snapshot.json",
@@ -87,18 +115,20 @@ if ! curl -fsS "${URL}/info" >/dev/null 2>&1; then
     exit 1
 fi
 
-for i in $(seq 1 "${AGENTS}"); do
-    host="agent${i}"
-    python -m cook_tpu.agent \
-        --coordinator "${URL}" --hostname "${host}" \
-        --mem 4096 --cpus 4 \
-        --sandbox-root "${DIR}/sandboxes/${host}" \
-        --heartbeat-interval 2 \
-        > "${DIR}/${host}.log" 2>&1 &
-    echo $! > "${DIR}/agent-${i}.pid"
-done
+if [ "${COOK_KUBE:-0}" != "1" ]; then
+    for i in $(seq 1 "${AGENTS}"); do
+        host="agent${i}"
+        python -m cook_tpu.agent \
+            --coordinator "${URL}" --hostname "${host}" \
+            --mem 4096 --cpus 4 \
+            --sandbox-root "${DIR}/sandboxes/${host}" \
+            --heartbeat-interval 2 \
+            > "${DIR}/${host}.log" 2>&1 &
+        echo $! > "${DIR}/agent-${i}.pid"
+    done
+fi
 
-echo "waiting for ${AGENTS} agents to register..."
+echo "waiting for ${AGENTS} hosts to appear..."
 n=0
 for i in $(seq 1 100); do
     n=$(curl -fsS "${URL}/debug" 2>/dev/null \
@@ -109,8 +139,8 @@ for c in d.get('clusters', {}).values()))" 2>/dev/null || echo 0)
     sleep 0.2
 done
 if [ "${n}" -lt "${AGENTS}" ]; then
-    echo "only ${n}/${AGENTS} agents registered after 20s; see" \
-         "${DIR}/agent*.log" >&2
+    echo "only ${n}/${AGENTS} hosts visible after 20s; see" \
+         "${HOST_LOGS}" >&2
     "${REPO}/bin/stop-local.sh" >/dev/null 2>&1 || true
     exit 1
 fi

@@ -16,14 +16,17 @@ ages out the event window (client's resume hits 410 -> full relist).
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from cook_tpu.backends.kube.api import FakeKube, Node, Pod
+from cook_tpu.backends.kube.api import (FakeKube, Node, Pod,
+                                        PodPhase)
 from cook_tpu.backends.kube.http_api import (fmt_cpu, fmt_mem_mb,
                                              pod_from_json, pod_to_json,
                                              POOL_LABEL)
@@ -75,7 +78,8 @@ class ApiServerStandIn:
     def __init__(self, fake: Optional[FakeKube] = None,
                  namespace: str = "cook",
                  require_token: Optional[str] = None,
-                 history_window: int = 1024):
+                 history_window: int = 1024,
+                 port: int = 0):
         self.fake = fake or FakeKube()
         self.namespace = namespace
         self.require_token = require_token
@@ -112,7 +116,7 @@ class ApiServerStandIn:
             def do_DELETE(self):
                 standin._handle(self, "DELETE")
 
-        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.server.daemon_threads = True
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True)
@@ -377,3 +381,109 @@ class ApiServerStandIn:
         h.send_header("Content-Length", str(len(data)))
         h.end_headers()
         h.wfile.write(data)
+
+
+class KubeletSim:
+    """Autonomous kubelet/scheduler simulation over a FakeKube: binds
+    pending pods (schedule_pending), starts bound pods, and succeeds
+    running pods after `runtime_s` — so the full kube backend stack is
+    drivable as real processes without a cluster (the minimesos role
+    for the kube path; the reference's dev story is
+    run-local-kubernetes.sh against a real minikube)."""
+
+    def __init__(self, fake: FakeKube, interval_s: float = 0.5,
+                 runtime_s: float = 5.0):
+        self.fake = fake
+        self.interval_s = interval_s
+        self.runtime_s = runtime_s
+        self._started_at: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def step(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self.fake.schedule_pending()
+        live = self.fake.list_pods()
+        for pod in live:
+            try:
+                if pod.terminal:
+                    continue
+                if pod.phase == PodPhase.PENDING and pod.node:
+                    # bound synthetic pods start too: the backend's
+                    # RUNNING-phase GC (_on_synthetic_event) then deletes
+                    # them, releasing the capacity they held — leaving
+                    # them bound-but-pending would wedge the cluster
+                    self.fake.start_pod(pod.name)
+                    self._started_at[pod.name] = now
+                elif pod.phase == PodPhase.RUNNING and not pod.synthetic:
+                    t0 = self._started_at.setdefault(pod.name, now)
+                    if now - t0 >= self.runtime_s:
+                        self.fake.succeed_pod(pod.name)
+                        self._started_at.pop(pod.name, None)
+            except KeyError:
+                # pod deleted concurrently (kill, synthetic GC): next
+                # pod, not next step
+                continue
+        # prune start times of pods that vanished while running
+        names = {p.name for p in live}
+        for gone in [n for n in self._started_at if n not in names]:
+            self._started_at.pop(gone, None)
+
+    def start(self) -> "KubeletSim":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.step()
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "kubelet sim step failed")
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def main(argv=None) -> None:
+    """`python -m cook_tpu.backends.kube.standin --port 12380
+    --nodes 2 --kubelet-sim` — a standalone apiserver stand-in with an
+    optional kubelet simulation, for the local kube dev story."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="apiserver stand-in")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--nodes", type=int, default=2)
+    ap.add_argument("--node-mem", type=float, default=8192.0)
+    ap.add_argument("--node-cpus", type=float, default=8.0)
+    ap.add_argument("--namespace", default="cook")
+    ap.add_argument("--kubelet-sim", action="store_true",
+                    help="bind/start/succeed pods automatically")
+    ap.add_argument("--pod-runtime", type=float, default=5.0,
+                    help="simulated pod runtime seconds")
+    args = ap.parse_args(argv)
+    fake = FakeKube(nodes=[
+        Node(name=f"node{i}", mem=args.node_mem, cpus=args.node_cpus)
+        for i in range(args.nodes)])
+    server = ApiServerStandIn(fake, namespace=args.namespace,
+                              port=args.port)
+    sim = KubeletSim(fake, runtime_s=args.pod_runtime).start() \
+        if args.kubelet_sim else None
+    print(f"apiserver stand-in on {server.url} "
+          f"({args.nodes} nodes, kubelet-sim={'on' if sim else 'off'})",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if sim:
+            sim.stop()
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

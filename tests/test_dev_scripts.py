@@ -35,3 +35,24 @@ def test_run_local_cluster_lifecycle(tmp_path):
 
     st = sh("bin/run-local.sh", "status")
     assert st.returncode != 0          # coordinator really gone
+
+
+def test_run_local_kube_mode(tmp_path):
+    env = {**os.environ, "COOK_PORT": "12388", "COOK_AGENTS": "1",
+           "COOK_KUBE": "1", "COOK_LOCAL_DIR": str(tmp_path / "kube")}
+
+    def sh(*args, timeout=90):
+        return subprocess.run(
+            ["bash", *args], env=env, cwd=REPO, timeout=timeout,
+            capture_output=True, text=True)
+
+    try:
+        up = sh("bin/run-local.sh")
+        assert up.returncode == 0, up.stdout + up.stderr
+        demo = sh("bin/run-local.sh", "demo", timeout=120)
+        assert demo.returncode == 0, demo.stdout + demo.stderr
+        assert "success" in demo.stdout
+        assert "node0" in demo.stdout        # ran via the kube backend
+    finally:
+        down = sh("bin/stop-local.sh")
+        assert down.returncode == 0

@@ -283,3 +283,23 @@ def test_offers_over_http_subtract_consumption(standin):
         assert min(o.mem for o in offers.values()) == 700.0
     finally:
         api.stop()
+
+
+def test_kube_cluster_e2e_with_kubelet_sim(standin):
+    """Same wire-level flow, but the KubeletSim drives pod lifecycles
+    autonomously (the minimesos role: a kube cluster that 'runs' jobs
+    with no manual lifecycle pokes — what bin/run-local.sh --kube uses)."""
+    from cook_tpu.backends.kube.standin import KubeletSim
+
+    api, cluster, store, coord = build_http_stack(standin)
+    sim = KubeletSim(standin.fake, interval_s=0.05, runtime_s=0.2).start()
+    try:
+        jobs = [mkjob() for _ in range(3)]
+        store.create_jobs(jobs)
+        assert coord.match_cycle().matched == 3
+        wait_until(lambda: all(j.state == JobState.COMPLETED
+                               for j in jobs))
+        assert all(j.success for j in jobs)
+    finally:
+        sim.stop()
+        api.stop()
