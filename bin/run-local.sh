@@ -74,6 +74,13 @@ if [ "${COOK_KUBE:-0}" = "1" ]; then
         fi
         sleep 0.2
     done
+    if ! curl -fsS "http://127.0.0.1:${KUBE_PORT}/api/v1/namespaces/cook/pods" \
+            >/dev/null 2>&1; then
+        echo "apiserver stand-in not serving after 10s; see" \
+             "${DIR}/apiserver.log" >&2
+        "${REPO}/bin/stop-local.sh" >/dev/null 2>&1 || true
+        exit 1
+    fi
     HOST_LOGS="${DIR}/apiserver.log"
     CLUSTERS='{"kind": "kube", "name": "local-kube",
      "kube_url": "http://127.0.0.1:'"${KUBE_PORT}"'",

@@ -70,6 +70,10 @@ class AgentDaemon:
         # _post runs from heartbeat, executor-callback, and HTTP handler
         # threads concurrently: all failover-state mutation is locked
         self._url_lock = threading.Lock()
+        # terminal statuses that couldn't be delivered (leaderless
+        # window); flushed after each successful heartbeat
+        self._outbox: list[dict] = []
+        self._outbox_lock = threading.Lock()
         self.hostname = hostname or socket.gethostname()
         self.mem, self.cpus, self.gpus = mem, cpus, gpus
         self.pool = pool
@@ -195,6 +199,7 @@ class AgentDaemon:
                     "tasks": sorted(self.executor.alive_task_ids())})
                 if resp.get("reregister"):
                     self._register(block=True)
+                self._flush_outbox()
                 for tid in resp.get("kill", []):
                     # coordinator doesn't know this task: orphan from a
                     # torn launch or a previous coordinator life
@@ -204,11 +209,27 @@ class AgentDaemon:
                 logger.warning("heartbeat failed: %s", e)
 
     def _on_status(self, task_id: str, event: str, info: dict) -> None:
-        self._post_retry("/agents/status", {
+        payload = {
             "task_id": task_id, "event": event,
             "exit_code": info.get("exit_code"),
             "sandbox": info.get("sandbox", ""),
-            "hostname": self.hostname})
+            "hostname": self.hostname}
+        if not self._post_retry("/agents/status", payload):
+            # terminal statuses must not be lost to a leaderless window
+            # (the task is gone from later heartbeat task lists, so the
+            # diff safety net can't recover it): queue for redelivery
+            # after the next successful register/heartbeat
+            with self._outbox_lock:
+                self._outbox.append(payload)
+            logger.warning("queued undelivered status for %s", task_id)
+
+    def _flush_outbox(self) -> None:
+        with self._outbox_lock:
+            pending, self._outbox = self._outbox, []
+        for payload in pending:
+            if not self._post_retry("/agents/status", payload, attempts=1):
+                with self._outbox_lock:
+                    self._outbox.append(payload)
 
     def _on_progress(self, task_id: str, sequence: int, percent: int,
                      message: str) -> None:
@@ -281,21 +302,20 @@ class AgentDaemon:
         raise last_exc
 
     def _post_retry(self, path: str, payload: dict,
-                    attempts: int = 3) -> None:
+                    attempts: int = 3) -> bool:
         delay = 0.2
         for i in range(attempts):
             try:
                 self._post(path, payload)
-                return
+                return True
             except Exception as e:
                 if i == attempts - 1:
-                    # the heartbeat task-list diff is the safety net for
-                    # dropped terminal statuses
-                    logger.warning("status post %s dropped after %d "
+                    logger.warning("status post %s undelivered after %d "
                                    "attempts: %s", path, attempts, e)
-                    return
+                    return False
                 time.sleep(delay)
                 delay *= 2
+        return False
 
     # -- coordinator-issued work ---------------------------------------
     def handle_launch(self, payload: dict) -> dict:

@@ -59,7 +59,8 @@ class AgentCluster(ComputeCluster):
                  progress_aggregator=None, heartbeats=None,
                  request_timeout_s: float = 10.0,
                  lost_task_grace_s: float = 5.0,
-                 agent_token: str = ""):
+                 agent_token: str = "",
+                 task_lookup=None):
         self.name = name
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.request_timeout_s = request_timeout_s
@@ -67,6 +68,14 @@ class AgentCluster(ComputeCluster):
         self.agent_token = agent_token
         self.progress = progress_aggregator
         self.heartbeats = heartbeats
+        # task_id -> (Job, Instance) or None, consulted before declaring
+        # a reported task an orphan: a new leader's cluster starts with
+        # empty _specs, but the durable store (shared event log) may
+        # know the task as a live instance — ADOPT it instead of
+        # killing it (the startup-reconstruction role,
+        # kubernetes/compute_cluster.clj:155-190 / reconcile-tasks
+        # scheduler.clj:1041-1104)
+        self.task_lookup = task_lookup
         self.agents: dict[str, AgentInfo] = {}
         # task -> (spec, host, launched_ms)
         self._specs: dict[str, tuple[LaunchSpec, str, int]] = {}
@@ -102,11 +111,50 @@ class AgentCluster(ComputeCluster):
             lost = [tid for tid, (_, h, t0) in self._specs.items()
                     if h == hostname and tid not in reported
                     and t0 < grace_cutoff]
+            unknown = [tid for tid in reported if tid not in self._specs]
         for tid in lost:
             self._fail_lost(tid, "agent re-registered without task")
-        logger.info("agent %s registered (%s); %d tasks lost",
-                    hostname, info.url, len(lost))
+        # reported-but-untracked tasks the durable store knows as live
+        # instances are ADOPTED, not killed: this cluster object may be
+        # a fresh leader's (leader failover / coordinator restart)
+        adopted = sum(self._try_adopt(tid, hostname) for tid in unknown)
+        logger.info("agent %s registered (%s); %d tasks lost, %d adopted",
+                    hostname, info.url, len(lost), adopted)
         return {"ok": True, "hostname": hostname}
+
+    def _resolve_active(self, task_id: str):
+        """(job, instance) from the durable store, if the instance is
+        still live; None otherwise."""
+        if self.task_lookup is None:
+            return None
+        try:
+            res = self.task_lookup(task_id)
+        except Exception:
+            return None
+        if res is None:
+            return None
+        job, inst = res
+        return (job, inst) if inst.active else None
+
+    def _try_adopt(self, task_id: str, hostname: str,
+                   resolved=None) -> bool:
+        """Adopt a reported task if the store knows it as a live
+        instance on this host (startup reconstruction,
+        kubernetes/compute_cluster.clj:155-190 / reconcile-tasks
+        scheduler.clj:1041-1104). Returns True if adopted. `resolved`
+        passes an already-fetched (job, instance) pair."""
+        res = resolved if resolved is not None \
+            else self._resolve_active(task_id)
+        if res is None or res[1].hostname != hostname:
+            return False
+        job = res[0]
+        spec = LaunchSpec(task_id=task_id, job_uuid=job.uuid,
+                          hostname=hostname, command=job.command,
+                          mem=job.mem, cpus=job.cpus, gpus=job.gpus)
+        with self._lock:
+            self._specs.setdefault(task_id, (spec, hostname, now_ms()))
+        logger.info("adopted running task %s on %s", task_id, hostname)
+        return True
 
     def agent_heartbeat(self, payload: dict) -> dict:
         """POST /agents/heartbeat: {hostname, tasks: [alive ids]}.
@@ -137,10 +185,13 @@ class AgentCluster(ComputeCluster):
                 self._missing[tid] = strikes
                 if strikes >= 2:
                     lost.append(tid)
-            # reported-but-unknown: an orphan from a failed launch POST
-            # or a previous coordinator life; tell the agent to kill it
-            # so it stops consuming real capacity
-            orphans = sorted(reported - known_here)
+            # reported-but-unknown: try adoption (durable store may know
+            # it — new leader / restarted coordinator); what remains is
+            # an orphan from a failed launch POST, killed so it stops
+            # consuming real capacity
+            candidates = sorted(reported - known_here)
+        orphans = [tid for tid in candidates
+                   if not self._try_adopt(tid, hostname)]
         for tid in lost:
             self._fail_lost(tid, "missing from two consecutive heartbeats")
         # a live agent task keeps the per-task heartbeat fresh: the
@@ -160,10 +211,25 @@ class AgentCluster(ComputeCluster):
         sandbox = payload.get("sandbox", "")
         with self._lock:
             entry = self._specs.get(task_id)
-            if entry is None:
-                # not a task we launched (or already resolved as lost):
-                # don't let an arbitrary poster flip instance state
+        if entry is None:
+            # Not a task THIS cluster object launched — but the durable
+            # store may know it as a live instance (leader failover: the
+            # agent retried a terminal status that first landed in the
+            # leaderless window). Accept it iff the store vouches for
+            # the task on EXACTLY that agent; a payload without a
+            # hostname (no legitimate daemon omits it) or with the
+            # wrong one can't flip instance state.
+            res = self._resolve_active(task_id)
+            hostname = payload.get("hostname", "")
+            if res is None or not hostname or \
+                    res[1].hostname != hostname:
                 return {"ok": False, "unknown": True}
+            self._try_adopt(task_id, hostname, resolved=res)
+            with self._lock:
+                entry = self._specs.get(task_id)
+            if entry is None:
+                return {"ok": False, "unknown": True}
+        with self._lock:
             info = self.agents.get(entry[1])
             output_url = info.file_server_url if info else ""
         if event == "running":

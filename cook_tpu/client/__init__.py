@@ -102,7 +102,7 @@ class JobClient:
 
     # -- transport -----------------------------------------------------
     def _request(self, method: str, path: str, query: Optional[dict] = None,
-                 body: Any = None):
+                 body: Any = None, _follow_leader: bool = True):
         url = self.url + path
         if query:
             url += "?" + urllib.parse.urlencode(query, doseq=True)
@@ -120,6 +120,16 @@ class JobClient:
                 parsed = json.loads(payload) if payload else None
             except ValueError:
                 parsed = payload.decode(errors="replace")
+            # HA: a non-leader answers writes with 503 + the leader's
+            # address; adopt it and retry once (the reference's clients
+            # reach the leader via redirects/ZK discovery)
+            if (_follow_leader and e.code == 503
+                    and isinstance(parsed, dict) and parsed.get("leader")):
+                leader = str(parsed["leader"]).rstrip("/")
+                if leader and leader != self.url:
+                    self.url = leader
+                    return self._request(method, path, query=query,
+                                         body=body, _follow_leader=False)
             raise JobClientError(e.code, parsed)
 
     # -- submission ----------------------------------------------------

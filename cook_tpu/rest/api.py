@@ -171,15 +171,22 @@ class CookApi:
                 # its non-authoritative cluster state: refuse with the
                 # leader's address so the daemon can fail over (the
                 # Mesos-master-HA role of the reference's transport)
-                elector = getattr(self, "leader_elector", None)
-                if elector is not None and not elector.is_leader():
-                    return Response(503, {
-                        "error": "not leader",
-                        "leader": elector.current_leader()
-                        or self.leader_url})
+                blocked = self._leader_block(agent_channel=True)
+                if blocked is not None:
+                    return blocked
             elif path not in ("/info", "/debug",
                               "/metrics"):  # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)
+            if method in ("POST", "PUT", "DELETE") \
+                    and not path.startswith("/agents"):
+                # a non-leader serves reads but must not accept writes
+                # into a store where no scheduling cycles run (the
+                # reference's API-only nodes route writes to the leader;
+                # progress posts redirect, rest/api.clj:3298-3315).
+                # Clients follow the hint.
+                blocked = self._leader_block()
+                if blocked is not None:
+                    return blocked
             return self.router.dispatch(req)
         except AuthError as e:
             return Response(e.status, {"error": e.message})
@@ -187,6 +194,28 @@ class CookApi:
             return Response(e.status, e.body)
         except Exception as e:  # logging-exception-handler equivalent
             return Response(500, {"error": f"internal error: {e!r}"})
+
+    def _leader_block(self, agent_channel: bool = False) \
+            -> Optional[Response]:
+        """503 + leader hint when this node must not accept writes:
+        not the leader, OR leader whose takeover (store replay, backend
+        init) hasn't finished — the gate must not open before the
+        replayed store can vouch for live tasks. An api-only node
+        (--no-cycles) additionally refuses the agent channel: nothing
+        schedules from its cluster objects, so absorbing registrations
+        would strand agents (they rotate away on the self-hint)."""
+        if agent_channel and getattr(self, "api_only", False):
+            return Response(503, {"error": "not leader",
+                                  "leader": self.leader_url})
+        elector = getattr(self, "leader_elector", None)
+        if elector is None:
+            return None
+        ready = getattr(self, "leader_ready", None)
+        if elector.is_leader() and (ready is None or ready.is_set()):
+            return None
+        return Response(503, {
+            "error": "not leader",
+            "leader": elector.current_leader() or self.leader_url})
 
     def _build_router(self) -> Router:
         r = Router()

@@ -141,8 +141,15 @@ def build_scheduler(config):
     if isinstance(config, dict):
         config = Settings.from_dict(config)
 
+    # In an HA deployment the log is shared and a live leader may be
+    # mid-append while this (standby) process boots: trimming a torn
+    # tail would truncate under its writer. A standby replays up to the
+    # last complete line instead; the takeover reload_from (old leader
+    # dead) does the repair trim. Single-node keeps boot-time repair.
+    ha = bool(config.leader_lease_url or config.leader_lock_path)
     store = JobStore.restore(config.snapshot_path,
-                             log_path=config.log_path)
+                             log_path=config.log_path,
+                             trim_tail=not ha)
     pools = PoolRegistry(config.default_pool)
     for p in config.pools:
         pools.add(Pool(name=p.name, purpose=p.purpose,
@@ -178,11 +185,17 @@ def build_scheduler(config):
                 default_checkpoint_config=config.checkpoint or None))
         elif c.kind == "agent":
             from cook_tpu.backends.agent import AgentCluster
+            def _resolve_task(task_id, _store=store):
+                uuid = _store.task_to_job.get(task_id)
+                job = _store.get_job(uuid) if uuid else None
+                inst = _store.get_instance(task_id)
+                return (job, inst) if job and inst else None
             clusters.register(AgentCluster(
                 name=c.name,
                 heartbeat_timeout_s=c.agent_heartbeat_timeout_s,
                 progress_aggregator=progress, heartbeats=heartbeats,
-                agent_token=config.auth.agent_token))
+                agent_token=config.auth.agent_token,
+                task_lookup=_resolve_task))
         else:
             hosts = [MockHost(hostname=f"{c.name}-host-{i}",
                               mem=c.host_mem, cpus=c.host_cpus,
@@ -308,12 +321,20 @@ def main(argv=None) -> None:
     store, coord, api = build_scheduler(settings)
     api.leader_url = settings.url
 
+    api.leader_ready = threading.Event()
+
     def on_leadership():
         """The takeLeadership path (mesos.clj:153-223): start backends,
         scheduling cycles, monitors."""
+        # re-replay the shared snapshot+log: the previous leader kept
+        # appending after this standby's boot-time restore
+        store.reload_from(settings.snapshot_path)
         for cluster in coord.clusters.all():
             cluster.initialize()
         coord.run()
+        # only now may writes land: the replayed store can vouch for
+        # live tasks the agents report
+        api.leader_ready.set()
 
         def tick():  # real-time driver for mock virtual clocks + monitor
             while True:
@@ -336,7 +357,15 @@ def main(argv=None) -> None:
         threading.Thread(target=monitor_loop, daemon=True).start()
 
     if args.no_cycles:
-        elector = StandaloneElector(settings.url)
+        # API-only node with no election at all: it accepts reads and
+        # user writes into the shared store/log (the reference's
+        # api-only config role) but must still refuse the AGENT channel
+        # — nothing schedules from its cluster objects, so absorbing
+        # registrations would strand agents. No elector is attached
+        # (an unstarted one would 503 user writes with a self-hint);
+        # api_only drives the /agents-only refusal.
+        elector = None
+        api.api_only = True
     elif settings.leader_lease_url:
         from cook_tpu.scheduler.leader import LeaseElector
         token = settings.leader_lease_token
@@ -356,21 +385,23 @@ def main(argv=None) -> None:
     else:
         elector = StandaloneElector(settings.url)
         elector.start(on_leadership)
-    api.leader_elector = elector
+    if elector is not None:
+        api.leader_elector = elector
 
     if settings.metrics_jsonl:
         JsonlReporter(registry, settings.metrics_jsonl,
                       interval_s=settings.metrics_interval_s).start()
     server = ApiServer(api, port=settings.port).start()
     log.info("cook_tpu scheduler listening on %s (leader=%s)", server.url,
-             elector.is_leader())
+             elector.is_leader() if elector is not None else "api-only")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.stop()
         coord.stop()
-        elector.stop()
+        if elector is not None:
+            elector.stop()
 
 
 if __name__ == "__main__":

@@ -353,10 +353,18 @@ class JobStore:
 
     @classmethod
     def restore(cls, path: Optional[str] = None,
-                log_path: Optional[str] = None) -> "JobStore":
+                log_path: Optional[str] = None,
+                trim_tail: bool = True) -> "JobStore":
         """Rebuild: snapshot (if any) + replay of the event-log tail
         beyond the snapshot's recorded position. With no snapshot the
-        whole log replays from empty."""
+        whole log replays from empty.
+
+        trim_tail=False: do NOT truncate a torn final line — required
+        when another process (the live leader, in an HA deployment
+        sharing the log) may be mid-append: truncating under its
+        O_APPEND writer would glue its continuation to the preceding
+        line and corrupt the log. The replay simply stops before an
+        unterminated final line instead."""
         offset = 0
         store = cls()
         if path and os.path.exists(path):
@@ -373,14 +381,43 @@ class JobStore:
             store.rebalancer_config = dict(
                 data.get("rebalancer_config", {}))
         if log_path and os.path.exists(log_path):
-            _trim_torn_tail(log_path)
-            store._replay(log_path, offset)
+            if trim_tail:
+                _trim_torn_tail(log_path)
+            store._replay(log_path, offset,
+                          allow_partial_tail=not trim_tail)
         if log_path:
             store._log_path = log_path
             store._log = _make_log_writer(log_path)
         return store
 
-    def _replay(self, log_path: str, offset: int) -> None:
+    def reload_from(self, snapshot_path: Optional[str] = None) -> None:
+        """Re-replay snapshot + log INTO this store, in place.
+
+        The leader-takeover path: a standby built its store at process
+        start, but the (now dead) leader kept appending to the shared
+        event log afterwards — on takeLeadership the standby must see
+        every job/instance the old leader persisted before it can
+        schedule (the reference gets this for free from Datomic;
+        mesos.clj:153-223 + reconcile). Not needed on a fresh start;
+        harmless then (replays to the same state)."""
+        if not self._log_path:
+            return
+        fresh = JobStore.restore(snapshot_path, log_path=self._log_path)
+        with self._lock:
+            old_log = self._log
+            self.jobs = fresh.jobs
+            self.groups = fresh.groups
+            self.task_to_job = fresh.task_to_job
+            self.rebalancer_config = fresh.rebalancer_config
+            self._log = fresh._log
+        if old_log is not None:
+            try:
+                old_log.close()
+            except Exception:
+                pass
+
+    def _replay(self, log_path: str, offset: int,
+                allow_partial_tail: bool = False) -> None:
         """Apply events [offset:] through the normal transaction fns with
         logging/listeners suppressed."""
         self._replaying = True
@@ -389,6 +426,9 @@ class JobStore:
                 for lineno, line in enumerate(f):
                     if lineno < offset or not line.strip():
                         continue
+                    if allow_partial_tail and not line.endswith("\n"):
+                        # in-flight append by a live writer: not ours yet
+                        break
                     # torn tails are truncated before replay; any decode
                     # error here is real corruption and must surface
                     ev = json.loads(line)

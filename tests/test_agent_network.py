@@ -285,3 +285,86 @@ def test_multiprocess_e2e_with_agent_sigkill(tmp_path):
             if proc.poll() is None:
                 proc.kill()
         server.stop()
+
+
+# -- failover adoption + status durability -----------------------------
+def _fresh_cluster_with_store(store):
+    """A brand-new AgentCluster (empty _specs) whose task_lookup sees
+    `store` — the new-leader-after-failover shape."""
+    def resolve(task_id):
+        uuid = store.task_to_job.get(task_id)
+        job = store.get_job(uuid) if uuid else None
+        inst = store.get_instance(task_id)
+        return (job, inst) if job and inst else None
+
+    return AgentCluster(heartbeat_timeout_s=2.0, task_lookup=resolve)
+
+
+def _store_with_running(hostname="ha-agent"):
+    store = JobStore()
+    job = mkjob()
+    store.create_jobs([job])
+    inst = store.create_instance(job.uuid, hostname, "agents")
+    store.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    return store, job, inst.task_id
+
+
+def test_register_adopts_store_known_task_instead_of_orphan_kill():
+    store, job, tid = _store_with_running()
+    cluster = _fresh_cluster_with_store(store)
+    resp = cluster.register_agent({
+        "hostname": "ha-agent", "url": "http://127.0.0.1:1",
+        "mem": 1000, "cpus": 4, "tasks": [tid]})
+    assert resp["ok"]
+    hb = cluster.agent_heartbeat({"hostname": "ha-agent", "tasks": [tid]})
+    assert hb["kill"] == []                       # adopted, not orphaned
+    assert tid in cluster.known_task_ids()
+    # a genuinely unknown task is still killed
+    hb = cluster.agent_heartbeat({"hostname": "ha-agent",
+                                  "tasks": [tid, "bogus-task"]})
+    assert hb["kill"] == ["bogus-task"]
+
+
+def test_status_report_accepted_for_store_known_task():
+    store, job, tid = _store_with_running()
+    cluster = _fresh_cluster_with_store(store)
+    statuses = []
+    cluster.set_status_callback(
+        lambda task_id, status, reason=None, **kw:
+        statuses.append((task_id, status)))
+    # terminal status for a task this cluster object never launched —
+    # the durable store vouches for it (post-failover redelivery)
+    resp = cluster.status_report({"task_id": tid, "event": "exited",
+                                  "exit_code": 0,
+                                  "hostname": "ha-agent"})
+    assert resp["ok"]
+    assert statuses and statuses[-1][1] == InstanceStatus.SUCCESS
+    # no hostname: rejected (no legitimate daemon omits it)
+    store3, job3, tid3 = _store_with_running()
+    cluster3 = _fresh_cluster_with_store(store3)
+    resp = cluster3.status_report({"task_id": tid3, "event": "exited",
+                                   "exit_code": 0})
+    assert resp.get("unknown")
+    # wrong hostname: rejected (an arbitrary poster can't flip state)
+    store2, job2, tid2 = _store_with_running(hostname="other-host")
+    cluster2 = _fresh_cluster_with_store(store2)
+    resp = cluster2.status_report({"task_id": tid2, "event": "exited",
+                                   "exit_code": 0,
+                                   "hostname": "ha-agent"})
+    assert resp.get("unknown")
+
+
+def test_daemon_outbox_redelivers_terminal_status(stack, tmp_path):
+    store, cluster, coord, server, add_agent = stack
+    # a daemon pointed only at a dead coordinator queues the status
+    d = AgentDaemon("http://127.0.0.1:1", hostname="box",
+                    sandbox_root=str(tmp_path / "box"),
+                    heartbeat_interval_s=0.2, agent_token="hunter2")
+    d._on_status("t-123", "exited", {"exit_code": 0, "sandbox": ""})
+    assert len(d._outbox) == 1
+    # coordinator comes back (failover): flush delivers; the server
+    # rejects it as unknown (HTTP 200) so it leaves the outbox either way
+    d._urls = [server.url]
+    d._url_idx = 0
+    d._flush_outbox()
+    assert d._outbox == []

@@ -45,7 +45,7 @@ def wait_until(fn, timeout=30.0, interval=0.2, msg="condition"):
     raise AssertionError(f"{msg} not met within {timeout}s")
 
 
-def spawn_server(tmp_path, port, lease_url):
+def spawn_server(tmp_path, port, lease_url, shared_log=False):
     cfg = {
         "port": port,
         "url": f"http://127.0.0.1:{port}",
@@ -54,6 +54,10 @@ def spawn_server(tmp_path, port, lease_url):
         "leader_lease_url": lease_url,
         "leader_lease_duration_s": 2.0,
     }
+    if shared_log:
+        # the Datomic role: one durable log both coordinators share;
+        # the standby re-replays it on takeover (store.reload_from)
+        cfg["log_path"] = str(tmp_path / "shared-eventlog")
     cfg_path = tmp_path / f"server{port}.json"
     cfg_path.write_text(json.dumps(cfg))
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
@@ -146,6 +150,60 @@ def test_leader_kill_agent_fails_over_and_runs_jobs(tmp_path):
                    == "success", timeout=60, msg="job 2 success")
         job2 = req(f"{urls[1]}/jobs/{uuid2}")
         assert job2["instances"][0]["hostname"] == "ha-agent"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+        apiserver.close()
+
+
+def test_running_task_survives_failover_with_shared_log(tmp_path):
+    """The Datomic-durability tier: with a shared event log, a task
+    RUNNING at the moment the leader dies is adopted by the new leader
+    (store.reload_from replay + the agent's re-registration carrying
+    its live task list) and completes as a success — not orphan-killed,
+    no retry burned."""
+    from cook_tpu.client import JobClient
+
+    apiserver = ApiServerStandIn()
+    procs = []
+    try:
+        s1 = spawn_server(tmp_path, 12385, apiserver.url, shared_log=True)
+        procs.append(s1)
+        wait_until(lambda: leader_of(["http://127.0.0.1:12385"]),
+                   msg="first leader")
+        s2 = spawn_server(tmp_path, 12386, apiserver.url, shared_log=True)
+        procs.append(s2)
+        urls = ["http://127.0.0.1:12385", "http://127.0.0.1:12386"]
+        wait_until(lambda: req(urls[1] + "/info"), msg="standby up")
+        agent = spawn_agent(tmp_path, urls)
+        procs.append(agent)
+        wait_until(lambda: agent_count(urls[0]) >= 1, msg="agent up")
+
+        # submit via the STANDBY: the client must follow the 503
+        # leader hint to the real leader
+        client = JobClient(urls[1], user="root")
+        uuid = client.submit(command="sleep 15", mem=64, cpus=1)
+        assert client.url == urls[0]         # hint adopted
+        wait_until(lambda: req(f"{urls[0]}/jobs/{uuid}")["status"]
+                   == "running", msg="job running")
+
+        s1.send_signal(signal.SIGKILL)
+        wait_until(lambda: leader_of([urls[1]]) == urls[1], timeout=30,
+                   msg="standby takes over")
+        # the new leader replayed the shared log: it knows the job
+        job = wait_until(lambda: req(f"{urls[1]}/jobs/{uuid}"),
+                         msg="job visible on new leader")
+        assert job["status"] in ("running", "completed")
+        # and the running task finishes as a SUCCESS on the new leader
+        job = wait_until(
+            lambda: (j := req(f"{urls[1]}/jobs/{uuid}"))["status"]
+            == "completed" and j, timeout=60, msg="job completes")
+        assert job["state"] == "success"
+        assert job["instances"][0]["hostname"] == "ha-agent"
+        assert len(job["instances"]) == 1    # never orphan-killed/retried
     finally:
         for p in procs:
             if p.poll() is None:
