@@ -121,15 +121,25 @@ class JobClient:
             except ValueError:
                 parsed = payload.decode(errors="replace")
             # HA: a non-leader answers writes with 503 + the leader's
-            # address; adopt it and retry once (the reference's clients
-            # reach the leader via redirects/ZK discovery)
+            # address; retry once there and adopt the address only on
+            # success — a stale hint (dead ex-leader during the
+            # leaderless window) must not pin the client to a dead URL
+            # (the reference's clients reach the leader via
+            # redirects/ZK discovery)
             if (_follow_leader and e.code == 503
                     and isinstance(parsed, dict) and parsed.get("leader")):
                 leader = str(parsed["leader"]).rstrip("/")
                 if leader and leader != self.url:
+                    original = self.url
                     self.url = leader
-                    return self._request(method, path, query=query,
-                                         body=body, _follow_leader=False)
+                    try:
+                        out = self._request(method, path, query=query,
+                                            body=body,
+                                            _follow_leader=False)
+                    except Exception:
+                        self.url = original
+                        raise
+                    return out
             raise JobClientError(e.code, parsed)
 
     # -- submission ----------------------------------------------------

@@ -201,10 +201,13 @@ class CookApi:
         not the leader, OR leader whose takeover (store replay, backend
         init) hasn't finished — the gate must not open before the
         replayed store can vouch for live tasks. An api-only node
-        (--no-cycles) additionally refuses the agent channel: nothing
-        schedules from its cluster objects, so absorbing registrations
-        would strand agents (they rotate away on the self-hint)."""
-        if agent_channel and getattr(self, "api_only", False):
+        (--no-cycles) refuses BOTH channels: nothing schedules from its
+        store (a leader never re-reads the shared log while leading, so
+        an accepted submission would be acked yet never scheduled) and
+        absorbing agent registrations would strand agents. Clients and
+        daemons rotate away on the hint."""
+        del agent_channel  # same policy both channels; kept for intent
+        if getattr(self, "api_only", False):
             return Response(503, {"error": "not leader",
                                   "leader": self.leader_url})
         elector = getattr(self, "leader_elector", None)

@@ -323,17 +323,33 @@ def main(argv=None) -> None:
 
     api.leader_ready = threading.Event()
 
+    def _still_leader():
+        elector = getattr(api, "leader_elector", None)
+        return elector.is_leader() if elector is not None else True
+
     def on_leadership():
         """The takeLeadership path (mesos.clj:153-223): start backends,
-        scheduling cycles, monitors."""
+        scheduling cycles, monitors. Re-checks leadership around each
+        step: a stalled init thread must never trim/write the shared
+        log after a successor acquired the lease."""
+        if not _still_leader():
+            raise RuntimeError("leadership lost before takeover init")
         # re-replay the shared snapshot+log: the previous leader kept
         # appending after this standby's boot-time restore
         store.reload_from(settings.snapshot_path)
+        if not _still_leader():
+            raise RuntimeError("leadership lost during takeover replay")
         for cluster in coord.clusters.all():
             cluster.initialize()
-        coord.run()
+        # every write path is fenced: cycles + status entry early-out,
+        # and the store's append gate is the chokepoint for anything
+        # already in flight when the fence closes
+        store.append_gate = _still_leader
+        coord.run(leadership_check=_still_leader)
         # only now may writes land: the replayed store can vouch for
         # live tasks the agents report
+        if not _still_leader():
+            raise RuntimeError("leadership lost during takeover init")
         api.leader_ready.set()
 
         def tick():  # real-time driver for mock virtual clocks + monitor
@@ -357,13 +373,13 @@ def main(argv=None) -> None:
         threading.Thread(target=monitor_loop, daemon=True).start()
 
     if args.no_cycles:
-        # API-only node with no election at all: it accepts reads and
-        # user writes into the shared store/log (the reference's
-        # api-only config role) but must still refuse the AGENT channel
-        # — nothing schedules from its cluster objects, so absorbing
-        # registrations would strand agents. No elector is attached
-        # (an unstarted one would 503 user writes with a self-hint);
-        # api_only drives the /agents-only refusal.
+        # API-only read replica (the reference's api-only config role,
+        # minus live writes: the reference's api-only nodes share
+        # Datomic so the leader sees their writes immediately; our
+        # leader only replays the shared log at takeover, so accepting
+        # a write here would ack a job nothing ever schedules). All
+        # writes 503 with the configured leader hint; reads serve from
+        # the boot-time restore of the shared snapshot/log.
         elector = None
         api.api_only = True
     elif settings.leader_lease_url:

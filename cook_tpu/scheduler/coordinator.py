@@ -196,6 +196,14 @@ class Coordinator:
 
     def _status_entry(self, task_id: str, status, reason=None,
                       **extra) -> None:
+        # backend callbacks arrive on watch/agent threads: a fenced
+        # (deposed-but-alive) leader must not write them to the shared
+        # log — the successor collects the same state via agent
+        # re-registration / kube watches
+        lc = getattr(self, "_leadership_check", None)
+        if lc is not None and not lc():
+            log.warning("dropping status for %s: not leader", task_id)
+            return
         if self.status_shards is not None:
             self.status_shards.submit(task_id, task_id, status, reason,
                                       **extra)
@@ -956,11 +964,22 @@ class Coordinator:
 
     # ------------------------------------------------------------------
     # production mode: timer threads (make-trigger-chans mesos.clj:85-109)
-    def run(self) -> None:
+    def run(self, leadership_check=None) -> None:
+        """leadership_check: callable -> bool; when it returns False the
+        cycles SKIP (no matching, no preemption, no store appends) —
+        a deposed-but-not-yet-dead leader must stop writing to the
+        shared log strictly before a successor can acquire the lease
+        (pairs with LeaseElector.is_leader's self-fencing; the
+        reference's deposed leader suicides and Datomic's single
+        transactor refuses it anyway)."""
+        self._leadership_check = leadership_check
         def loop(interval, fn, per_pool=True):
             def body():
                 while not self._stop.wait(interval):
                     try:
+                        if leadership_check is not None \
+                                and not leadership_check():
+                            continue
                         if per_pool:
                             for p in self.pools.active():
                                 fn(p.name)

@@ -216,6 +216,12 @@ class LeaseElector(LeaderElector):
         # (holder_url, observed_at) cache fed by the campaign/renew
         # loop so current_leader() doesn't GET the apiserver per call
         self._observed: tuple[Optional[str], float] = (None, 0.0)
+        # monotonic time of the last successful acquire/renew, stamped
+        # from BEFORE the round-trip began (the lease's renewTime is
+        # holder-stamped pre-PUT, so the fence must measure from the
+        # same instant); monotonic so a local NTP step can't stretch
+        # the asserted freshness. The self-fencing clock (see is_leader).
+        self._last_renewed = 0.0
 
     # -- wire ----------------------------------------------------------
     def _path(self) -> str:
@@ -349,27 +355,49 @@ class LeaseElector(LeaderElector):
                     self._stop.wait(self.retry_interval_s)
                     continue
                 self._leader = True
+                self._last_renewed = time.monotonic()
                 log.info("acquired leadership lease %s as %s",
                          self.name, self.identity)
-                try:
-                    on_leadership()
-                except Exception:
-                    log.exception("on_leadership failed")
-                    self._leader = False
-                    self.on_loss()
-                    return
-                last_renewed = time.time()
+                # Run takeover work (store replay, backend init — can
+                # take seconds) in its own thread so renewal is NOT
+                # starved during it: a takeover longer than the lease
+                # duration must not hand the lease to a second standby
+                # mid-initialization.
+                init_failed = threading.Event()
+
+                def run_init():
+                    # a thread-scheduling stall between acquire and
+                    # here must not run takeover work (which trims the
+                    # shared log) on a node that already lost the lease
+                    if not self.is_leader():
+                        init_failed.set()
+                        return
+                    try:
+                        on_leadership()
+                    except Exception:
+                        log.exception("on_leadership failed")
+                        init_failed.set()
+
+                threading.Thread(target=run_init, daemon=True,
+                                 name="leader-init").start()
                 while not self._stop.wait(self.duration_s / 3.0):
+                    if init_failed.is_set():
+                        self._leader = False
+                        self.on_loss()
+                        return
+                    t0 = time.monotonic()   # pre-round-trip, like the
+                    #                         lease's own renewTime stamp
                     try:
                         if self._renew():
-                            last_renewed = time.time()
+                            self._last_renewed = t0
                         else:
                             self._leader = False
                             self.on_loss()
                             return
                     except Exception as e:
                         log.warning("lease renewal error: %s", e)
-                        if time.time() - last_renewed > self.duration_s:
+                        if time.monotonic() - self._last_renewed \
+                                > self.duration_s:
                             # can't prove we still hold it: step down
                             self._leader = False
                             self.on_loss()
@@ -380,7 +408,20 @@ class LeaseElector(LeaderElector):
         self._thread.start()
 
     def is_leader(self) -> bool:
-        return self._leader
+        """Self-fencing leadership check. A deposed-but-unaware leader
+        is the split-brain hazard: a successor may take the lease at
+        renewTime + duration, while this process would only notice on a
+        renew-loop tick (up to duration/3 late). So leadership is only
+        asserted while the last successful renew is FRESH — under 80%
+        of the lease duration — guaranteeing the old holder stops
+        acking writes strictly before any successor can acquire
+        (client-go's renewDeadline < leaseDuration serves the same
+        purpose). Normal renew cadence is duration/3, so freshness
+        never exceeds ~40% in a healthy process; a stalled/partitioned
+        one closes its write gates here first and suicides at the full
+        duration."""
+        return self._leader and \
+            (time.monotonic() - self._last_renewed) < self.duration_s * 0.8
 
     def current_leader(self) -> Optional[str]:
         # serve from the campaign/renew loop's observation when fresh

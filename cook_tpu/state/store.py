@@ -21,6 +21,7 @@ dataclasses and may be slightly stale, like Datomic's snapshot reads.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from dataclasses import asdict
@@ -30,6 +31,8 @@ from cook_tpu.state.model import (
     Group, Instance, InstanceStatus, Job, JobState, REASON_BY_CODE,
     REASON_UNKNOWN, VALID_INSTANCE_TRANSITIONS, new_uuid, now_ms,
 )
+
+log = logging.getLogger(__name__)
 
 
 class TransactionError(Exception):
@@ -55,9 +58,19 @@ class JobStore:
     # ------------------------------------------------------------------
     # event log plumbing
     def _append(self, kind: str, data: dict) -> None:
-        if self._log is not None and not getattr(self, "_replaying", False):
-            self._log.append(json.dumps({"t": now_ms(), "k": kind, **data},
-                                        separators=(",", ":")))
+        if self._log is None or getattr(self, "_replaying", False):
+            return
+        # final write-fencing chokepoint: early leadership gates
+        # (cycles, status entry) can't catch work already in flight
+        # when the fence closes — this one does. A deposed leader's
+        # in-memory state may briefly diverge from the log; it is
+        # about to suicide either way.
+        gate = getattr(self, "append_gate", None)
+        if gate is not None and not gate():
+            log.warning("append of %s dropped: not leader", kind)
+            return
+        self._log.append(json.dumps({"t": now_ms(), "k": kind, **data},
+                                    separators=(",", ":")))
 
     def _emit(self, kind: str, data: dict) -> None:
         if getattr(self, "_replaying", False):

@@ -204,3 +204,24 @@ def test_failover_no_double_launch(apiserver):
     assert len(job2.instances) == 1      # exactly once, on the new leader
     assert job2.instances[0].hostname == "b-h0"
     eb.stop()
+
+
+def test_is_leader_self_fences_on_stale_renewals(apiserver):
+    """A leader whose renewals stop succeeding (partition from the
+    apiserver, stopped process resumed) must stop asserting leadership
+    BEFORE a successor can legally take the lease — even though the
+    renew loop hasn't noticed yet. Pure unit-level: the elector is
+    never started, so no live renew loop can clobber the backdated
+    freshness stamp."""
+    e = make_elector(apiserver, "fency", duration=1.0)
+    e._leader = True
+    e._last_renewed = time.monotonic()
+    assert e.is_leader()
+    # simulate silent renew stalls: freshness ages past 80% of the
+    # lease duration while the loop's flag still says leader
+    e._last_renewed = time.monotonic() - 0.9
+    assert e._leader            # the loop hasn't stepped down...
+    assert not e.is_leader()    # ...but leadership is not asserted
+    # a successful renew restores it
+    e._last_renewed = time.monotonic()
+    assert e.is_leader()
