@@ -148,6 +148,9 @@ class Settings:
     leader_lease_token: str = ""
     leader_lease_token_path: str = ""   # e.g. the in-cluster SA token
     url: str = ""                             # published leader URL
+    # address handed to clients by non-leaders/replicas refusing a
+    # write (e.g. the HA service/virtual-IP); defaults to `url`
+    leader_hint_url: str = ""
     metrics_jsonl: Optional[str] = None
     metrics_interval_s: float = 60.0
     plugins: dict = field(default_factory=dict)

@@ -43,7 +43,7 @@ from cook_tpu.state.model import (Group, Instance, InstanceStatus, Job,
                                   JobState, REASONS,
                                   REASON_BY_CODE as _REASON_BY_CODE,
                                   new_uuid, now_ms)
-from cook_tpu.state.store import TransactionError
+from cook_tpu.state.store import NotLeaderError, TransactionError
 
 log = logging.getLogger(__name__)
 
@@ -188,6 +188,15 @@ class CookApi:
                 if blocked is not None:
                     return blocked
             return self.router.dispatch(req)
+        except NotLeaderError:
+            # the store's write fence closed between the gate check and
+            # the transaction (deposed mid-request): same answer as the
+            # gate, so clients fail over instead of seeing a 409/500
+            elector = getattr(self, "leader_elector", None)
+            return Response(503, {
+                "error": "not leader",
+                "leader": (elector.current_leader() if elector else None)
+                or self.leader_url})
         except AuthError as e:
             return Response(e.status, {"error": e.message})
         except ApiError as e:
@@ -416,6 +425,8 @@ class CookApi:
         try:
             uuids = self.store.create_jobs(jobs, groups, committed=False)
             self.store.commit_jobs(uuids)
+        except NotLeaderError:
+            raise   # handle() maps it to 503 + leader hint (failover)
         except TransactionError as e:
             raise ApiError(409, str(e))
         return Response(201, {"jobs": uuids})

@@ -116,10 +116,11 @@ class ApiServer:
         self.httpd.server_close()
 
 
-def build_scheduler(config):
+def build_scheduler(config, read_only=False):
     """Assemble a full single-process scheduler from a Settings tree or
     raw config dict (the components.clj scheduler-server graph
-    equivalent)."""
+    equivalent). read_only: an api-only read replica — never opens a
+    log writer and never trims the shared log."""
     from cook_tpu.backends.base import ClusterRegistry
     from cook_tpu.backends.mock import MockCluster, MockHost
     from cook_tpu.config import Settings
@@ -149,7 +150,8 @@ def build_scheduler(config):
     ha = bool(config.leader_lease_url or config.leader_lock_path)
     store = JobStore.restore(config.snapshot_path,
                              log_path=config.log_path,
-                             trim_tail=not ha)
+                             trim_tail=not ha and not read_only,
+                             open_writer=not read_only)
     pools = PoolRegistry(config.default_pool)
     for p in config.pools:
         pools.add(Pool(name=p.name, purpose=p.purpose,
@@ -318,8 +320,12 @@ def main(argv=None) -> None:
     if args.port != 12321:
         settings.port = args.port
     settings.url = settings.url or f"http://127.0.0.1:{settings.port}"
-    store, coord, api = build_scheduler(settings)
-    api.leader_url = settings.url
+    store, coord, api = build_scheduler(settings,
+                                        read_only=args.no_cycles)
+    # the hint non-leaders hand to clients: for api-only replicas this
+    # must be the real leader's (or the HA service's) address, not our
+    # own — a self-hint is a dead end for a rejected write
+    api.leader_url = settings.leader_hint_url or settings.url
 
     api.leader_ready = threading.Event()
 
@@ -382,6 +388,10 @@ def main(argv=None) -> None:
         # the boot-time restore of the shared snapshot/log.
         elector = None
         api.api_only = True
+        if settings.log_path:
+            # keep reads fresh: incrementally apply the leader's new
+            # log events (read replica; never writes)
+            store.follow_log(interval_s=2.0)
     elif settings.leader_lease_url:
         from cook_tpu.scheduler.leader import LeaseElector
         token = settings.leader_lease_token

@@ -346,6 +346,7 @@ class LeaseElector(LeaderElector):
     def start(self, on_leadership: Callable[[], None]) -> None:
         def campaign():
             while not self._stop.is_set():
+                t0 = time.monotonic()   # pre-round-trip, like renewTime
                 try:
                     acquired = self._try_acquire()
                 except Exception as e:
@@ -355,7 +356,7 @@ class LeaseElector(LeaderElector):
                     self._stop.wait(self.retry_interval_s)
                     continue
                 self._leader = True
-                self._last_renewed = time.monotonic()
+                self._last_renewed = t0
                 log.info("acquired leadership lease %s as %s",
                          self.name, self.identity)
                 # Run takeover work (store replay, backend init — can

@@ -39,6 +39,11 @@ class TransactionError(Exception):
     """Illegal transition / constraint violation; transaction rejected."""
 
 
+class NotLeaderError(TransactionError):
+    """Write rejected by the leadership fence; the API maps this to 503
+    + leader hint so clients fail over transparently."""
+
+
 class JobStore:
     def __init__(self, log_path: Optional[str] = None,
                  log_writer=None):
@@ -60,17 +65,28 @@ class JobStore:
     def _append(self, kind: str, data: dict) -> None:
         if self._log is None or getattr(self, "_replaying", False):
             return
-        # final write-fencing chokepoint: early leadership gates
-        # (cycles, status entry) can't catch work already in flight
-        # when the fence closes — this one does. A deposed leader's
-        # in-memory state may briefly diverge from the log; it is
-        # about to suicide either way.
+        # backstop re-check: a thread that passed the entry check and
+        # then stalled (GC/process pause) mid-critical-section must not
+        # write the shared log after the fence closed. Raising here can
+        # leave partial in-memory state on THIS (fenced) node — far
+        # better than a split-brain log write a successor already
+        # replayed past; see _check_writable for the primary gate.
         gate = getattr(self, "append_gate", None)
         if gate is not None and not gate():
-            log.warning("append of %s dropped: not leader", kind)
-            return
+            raise NotLeaderError("write fenced: not the leader")
         self._log.append(json.dumps({"t": now_ms(), "k": kind, **data},
                                     separators=(",", ":")))
+
+    def _check_writable(self) -> None:
+        """Primary write-fencing gate, evaluated at TRANSACTION ENTRY
+        (inside the store lock, before any in-memory mutation): a
+        fenced (deposed or stalled) leader must neither append to the
+        shared log nor ack. NotLeaderError maps to HTTP 503 + leader
+        hint, which clients follow."""
+        gate = getattr(self, "append_gate", None)
+        if gate is not None and not gate() \
+                and not getattr(self, "_replaying", False):
+            raise NotLeaderError("write fenced: not the leader")
 
     def _emit(self, kind: str, data: dict) -> None:
         if getattr(self, "_replaying", False):
@@ -103,6 +119,7 @@ class JobStore:
         batch becomes visible (committed) or none of it does
         (rest/api.clj:659 make-commit-latch, :1805 create-jobs!)."""
         with self._lock:
+            self._check_writable()
             jobs = list(jobs)
             for g in groups:
                 if g.uuid in self.groups:
@@ -134,6 +151,7 @@ class JobStore:
     def commit_jobs(self, uuids: Iterable[str]) -> None:
         """Flip the commit latch (metatransaction commit)."""
         with self._lock:
+            self._check_writable()
             for u in uuids:
                 job = self.jobs[u]
                 if not job.committed:
@@ -147,6 +165,7 @@ class JobStore:
         current config under the store lock, so concurrent partial
         updates can't lose each other's keys."""
         with self._lock:
+            self._check_writable()
             merged = {**self.rebalancer_config, **cfg} if merge \
                 else dict(cfg)
             self.rebalancer_config = merged
@@ -157,6 +176,7 @@ class JobStore:
         """Drop uncommitted jobs older than the cutoff
         (clear-uncommitted-jobs-on-schedule, tools.clj:757)."""
         with self._lock:
+            self._check_writable()
             cutoff = now_ms() - older_than_ms
             dead = [u for u, j in self.jobs.items()
                     if not j.committed and j.submit_time_ms < cutoff]
@@ -180,6 +200,7 @@ class JobStore:
         job state (:instance/create schema.clj:949; launch txn
         scheduler.clj:762-777)."""
         with self._lock:
+            self._check_writable()
             if not self.allowed_to_start(job_uuid):
                 raise TransactionError(f"job {job_uuid} not allowed to start")
             job = self.jobs[job_uuid]
@@ -205,6 +226,7 @@ class JobStore:
         apply a status update, ignore illegal transitions, recompute the
         owning job's state in the same transaction."""
         with self._lock:
+            self._check_writable()
             job_uuid = self.task_to_job.get(task_id)
             if job_uuid is None:
                 return None
@@ -243,6 +265,7 @@ class JobStore:
         """Progress pipeline writeback (progress.clj:33-121): highest
         sequence wins, duplicates dropped."""
         with self._lock:
+            self._check_writable()
             job_uuid = self.task_to_job.get(task_id)
             if job_uuid is None:
                 return False
@@ -265,6 +288,7 @@ class JobStore:
         schema.clj:1213-1235 retry txn fns): raise max_retries and, if the
         job completed with failures, reopen it as waiting."""
         with self._lock:
+            self._check_writable()
             job = self.jobs[job_uuid]
             job.max_retries = retries
             if (job.state == JobState.COMPLETED and not job.success
@@ -278,6 +302,7 @@ class JobStore:
         """Mark a job killed: complete it and return active task ids the
         backend must kill (kill-job mesos.clj:272)."""
         with self._lock:
+            self._check_writable()
             job = self.jobs.get(job_uuid)
             if job is None or job.state == JobState.COMPLETED:
                 return []
@@ -367,7 +392,8 @@ class JobStore:
     @classmethod
     def restore(cls, path: Optional[str] = None,
                 log_path: Optional[str] = None,
-                trim_tail: bool = True) -> "JobStore":
+                trim_tail: bool = True,
+                open_writer: bool = True) -> "JobStore":
         """Rebuild: snapshot (if any) + replay of the event-log tail
         beyond the snapshot's recorded position. With no snapshot the
         whole log replays from empty.
@@ -393,14 +419,20 @@ class JobStore:
                 store.groups[u] = Group(**gd)
             store.rebalancer_config = dict(
                 data.get("rebalancer_config", {}))
+        consumed = offset
         if log_path and os.path.exists(log_path):
             if trim_tail:
                 _trim_torn_tail(log_path)
-            store._replay(log_path, offset,
-                          allow_partial_tail=not trim_tail)
+            consumed = store._replay(log_path, offset,
+                                     allow_partial_tail=not trim_tail)
+        # the exact resume point for incremental followers: seeding
+        # from the writer's later line count would skip events appended
+        # between replay-finish and writer-open
+        store._replayed_offset = consumed
         if log_path:
             store._log_path = log_path
-            store._log = _make_log_writer(log_path)
+            if open_writer:
+                store._log = _make_log_writer(log_path, trim=trim_tail)
         return store
 
     def reload_from(self, snapshot_path: Optional[str] = None) -> None:
@@ -430,24 +462,122 @@ class JobStore:
                 pass
 
     def _replay(self, log_path: str, offset: int,
-                allow_partial_tail: bool = False) -> None:
+                allow_partial_tail: bool = False) -> int:
         """Apply events [offset:] through the normal transaction fns with
-        logging/listeners suppressed."""
+        logging/listeners suppressed. Returns the line offset consumed
+        up to (the resume point for incremental followers)."""
         self._replaying = True
+        consumed = offset
         try:
             with open(log_path) as f:
                 for lineno, line in enumerate(f):
-                    if lineno < offset or not line.strip():
+                    if lineno < offset:
                         continue
                     if allow_partial_tail and not line.endswith("\n"):
                         # in-flight append by a live writer: not ours yet
                         break
+                    consumed = lineno + 1
+                    if not line.strip():
+                        continue
                     # torn tails are truncated before replay; any decode
                     # error here is real corruption and must surface
                     ev = json.loads(line)
                     self._apply_event(ev)
         finally:
             self._replaying = False
+        return consumed
+
+    def follow_log(self, interval_s: float = 2.0):
+        """Read-replica mode: incrementally apply new shared-log events
+        on a timer, so an api-only node's reads stay fresh instead of
+        frozen at its boot-time restore (the role Datomic's live peer
+        index gives the reference's api-only nodes). Never writes.
+        Returns a stop() callable.
+
+        Incremental: a persistent binary handle streams only NEW bytes
+        per tick (a from-zero rescan would be O(total log) every tick).
+        The handle's position always sits at a COMPLETE-line boundary —
+        an unterminated trailing fragment is seeked back over, never
+        buffered. That makes a takeover's torn-tail repair harmless
+        even when the file regrows within one tick: the repair
+        truncates exactly the fragment we never consumed, so the new
+        leader's appends continue from our position. Each line advances
+        the applied counter only AFTER it is applied; a failing line is
+        seeked back to and retried next tick."""
+        if not self._log_path:
+            raise ValueError("follow_log needs a log_path")
+        # a follower must never append: drop any writer handle
+        if self._log is not None:
+            try:
+                self._log.close()
+            except Exception:
+                pass
+            self._log = None
+        stop = threading.Event()
+        state = {"applied": getattr(self, "_replayed_offset", 0),
+                 "f": None}
+
+        def tick():
+            path = self._log_path
+            if state["f"] is None:
+                if not os.path.exists(path):
+                    return
+                f = open(path, "rb")
+                for _ in range(state["applied"]):
+                    if not f.readline():
+                        break
+                state["f"] = f
+            f = state["f"]
+            if os.path.getsize(path) < f.tell():
+                # file shrank below our consumed boundary: full resync
+                f.close()
+                state["f"] = None
+                return
+            start = f.tell()
+            chunk = f.read()
+            if not chunk:
+                return
+            pos = 0          # offset into chunk of next unconsumed line
+            while True:
+                nl = chunk.find(b"\n", pos)
+                if nl == -1:
+                    break    # trailing fragment: not ours yet
+                raw = chunk[pos:nl]
+                if raw.strip():
+                    try:
+                        ev = json.loads(raw)
+                        with self._lock:
+                            self._replaying = True
+                            try:
+                                self._apply_event(ev)
+                            finally:
+                                self._replaying = False
+                    except Exception:
+                        log.exception("log follow: bad event; retrying "
+                                      "next tick")
+                        break
+                pos = nl + 1
+                state["applied"] += 1
+            f.seek(start + pos)
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    tick()
+                except Exception:
+                    log.exception("log follow failed")
+            if state["f"] is not None:
+                state["f"].close()
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="log-follower")
+        t.start()
+
+        def stopper():
+            stop.set()
+            t.join(timeout=5)
+
+        return stopper
 
     def _apply_event(self, ev: dict) -> None:
         k = ev["k"]
@@ -543,10 +673,12 @@ def _trim_torn_tail(path: str) -> None:
         f.truncate(0)
 
 
-def _make_log_writer(path: str):
+def _make_log_writer(path: str, trim: bool = True):
     """Prefer the native C++ group-commit writer (native/eventlog.cpp);
-    fall back to the pure-Python writer if the toolchain is missing."""
-    if os.path.exists(path):
+    fall back to the pure-Python writer if the toolchain is missing.
+    trim=False skips torn-tail repair (callers that share the log with
+    a possibly-live writer must never truncate it)."""
+    if trim and os.path.exists(path):
         _trim_torn_tail(path)
     try:
         from cook_tpu.native.eventlog import NativeLogWriter

@@ -391,3 +391,43 @@ def test_pool_registry():
     assert pr.resolve("nonexistent") == "default"
     assert not pr.accepts_submissions("dead")
     assert {p.name for p in pr.active()} == {"default", "gpu-pool"}
+
+
+def test_follow_log_read_replica(tmp_path):
+    """An api-only read replica incrementally applies the leader's new
+    log events (store.follow_log) and never writes."""
+    import time as _time
+    from cook_tpu.state.model import Job, new_uuid
+
+    log_path = str(tmp_path / "shared.log")
+    leader = JobStore(log_path=log_path)
+    j1 = Job(uuid=new_uuid(), user="u", command="a", mem=1, cpus=1)
+    leader.create_jobs([j1])
+
+    replica = JobStore.restore(log_path=log_path, trim_tail=False,
+                               open_writer=False)
+    assert j1.uuid in replica.jobs
+    stop = replica.follow_log(interval_s=0.1)
+    try:
+        assert replica._log is None            # follower can't append
+        j2 = Job(uuid=new_uuid(), user="u", command="b", mem=1, cpus=1)
+        leader.create_jobs([j2])
+        inst = leader.create_instance(j2.uuid, "h0", "mock")
+        leader.update_instance(inst.task_id, InstanceStatus.RUNNING)
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            got = replica.get_job(j2.uuid)
+            if got is not None and got.state == JobState.RUNNING:
+                break
+            _time.sleep(0.05)
+        got = replica.get_job(j2.uuid)
+        assert got is not None and got.state == JobState.RUNNING
+        # replica mutations never reach the log
+        before = open(log_path).read()
+        try:
+            replica.kill_job(j2.uuid)
+        except Exception:
+            pass
+        assert open(log_path).read() == before
+    finally:
+        stop()
