@@ -93,7 +93,14 @@ class JobClient:
 
     def __init__(self, url: str, user: Optional[str] = None,
                  auth_headers: Optional[dict] = None, timeout: float = 30.0):
-        self.url = url.rstrip("/")
+        """`url` may be a comma-separated list of candidate coordinator
+        URLs (an HA deployment's members): the client rotates on
+        connection failure and follows 503 leader hints."""
+        self._urls = [u.strip().rstrip("/")
+                      for u in url.split(",") if u.strip()]
+        if not self._urls:
+            raise ValueError("url is empty")
+        self.url = self._urls[0]
         self.user = user
         self.timeout = timeout
         self._headers = dict(auth_headers or {})
@@ -103,44 +110,67 @@ class JobClient:
     # -- transport -----------------------------------------------------
     def _request(self, method: str, path: str, query: Optional[dict] = None,
                  body: Any = None, _follow_leader: bool = True):
-        url = self.url + path
-        if query:
-            url += "?" + urllib.parse.urlencode(query, doseq=True)
+        qs = "?" + urllib.parse.urlencode(query, doseq=True) if query else ""
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json", **self._headers})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                payload = r.read()
-                return json.loads(payload) if payload else None
-        except urllib.error.HTTPError as e:
-            payload = e.read()
+        # candidate order for this request: the current URL (possibly an
+        # adopted leader hint outside the configured list) then every
+        # other configured member
+        cands = [self.url] + [u for u in self._urls if u != self.url]
+        if not _follow_leader:
+            cands = cands[:1]
+        last_exc: Optional[Exception] = None
+        for cand in cands:
+            self.url = cand
+            req = urllib.request.Request(
+                self.url + path + qs, data=data, method=method,
+                headers={"Content-Type": "application/json",
+                         **self._headers})
             try:
-                parsed = json.loads(payload) if payload else None
-            except ValueError:
-                parsed = payload.decode(errors="replace")
-            # HA: a non-leader answers writes with 503 + the leader's
-            # address; retry once there and adopt the address only on
-            # success — a stale hint (dead ex-leader during the
-            # leaderless window) must not pin the client to a dead URL
-            # (the reference's clients reach the leader via
-            # redirects/ZK discovery)
-            if (_follow_leader and e.code == 503
-                    and isinstance(parsed, dict) and parsed.get("leader")):
-                leader = str(parsed["leader"]).rstrip("/")
-                if leader and leader != self.url:
-                    original = self.url
-                    self.url = leader
-                    try:
-                        out = self._request(method, path, query=query,
-                                            body=body,
-                                            _follow_leader=False)
-                    except Exception:
-                        self.url = original
-                        raise
-                    return out
-            raise JobClientError(e.code, parsed)
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    payload = r.read()
+                    return json.loads(payload) if payload else None
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                try:
+                    parsed = json.loads(payload) if payload else None
+                except ValueError:
+                    parsed = payload.decode(errors="replace")
+                # HA: a non-leader answers writes with 503 + the
+                # leader's address; retry once there and keep the
+                # address only on success — a stale hint (dead
+                # ex-leader during the leaderless window) must not pin
+                # the client to a dead URL (the reference's clients
+                # reach the leader via redirects/ZK discovery)
+                if (_follow_leader and e.code == 503
+                        and isinstance(parsed, dict)
+                        and parsed.get("leader")):
+                    leader = str(parsed["leader"]).rstrip("/")
+                    if leader and leader != self.url:
+                        original = self.url
+                        self.url = leader
+                        try:
+                            out = self._request(method, path, query=query,
+                                                body=body,
+                                                _follow_leader=False)
+                        except Exception:
+                            self.url = original
+                            raise
+                        return out
+                raise JobClientError(e.code, parsed)
+            except urllib.error.URLError as e:
+                last_exc = e
+                if len(cands) < 2:
+                    raise
+                # Writes may only rotate when the connection was
+                # REFUSED (nothing was sent, so no duplicate-submission
+                # risk); a connection that died mid-request could have
+                # committed the write on the server. Reads are
+                # idempotent and rotate on any connection failure.
+                refused = isinstance(getattr(e, "reason", None),
+                                     ConnectionRefusedError)
+                if method != "GET" and not refused:
+                    raise
+        raise last_exc
 
     # -- submission ----------------------------------------------------
     def submit(self, command: str, mem: float = 128.0, cpus: float = 1.0,

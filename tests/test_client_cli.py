@@ -190,3 +190,18 @@ def test_rest_data_local_endpoints(live):
     import urllib.error
     with pytest.raises(urllib.error.HTTPError):
         get(f"/data-local/{new_uuid()}")
+
+
+def test_client_rotates_candidate_urls(live):
+    store, cluster, coord, server = live
+    # first candidate is dead; the client rotates to the live one
+    client = JobClient(f"http://127.0.0.1:1,{server.url}", user="alice",
+                      timeout=3.0)
+    uuid = client.submit(command="t", mem=64, cpus=1)
+    assert client.url == server.url          # settled on the live member
+    assert client.query(uuid).status == "waiting"
+    # single-URL client still raises on connection failure
+    import urllib.error
+    dead = JobClient("http://127.0.0.1:1", user="alice", timeout=2.0)
+    with pytest.raises(urllib.error.URLError):
+        dead.query("whatever")
