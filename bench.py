@@ -425,6 +425,132 @@ def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
     }))
 
 
+def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
+              runtime_s=10.0, label="e2e coordinator @ 100k-pending x "
+              "10k-offers"):
+    """END-TO-END production path: Coordinator.match_cycle itself — the
+    durable store (100k pending + ~10k running), device-resident
+    tensors updated by store-event deltas, the real launch transaction
+    (bulk create + backend launch), and bulk status writeback of
+    completions — not just the fused kernel (VERDICT r2 #1).
+
+    Steady state: every virtual second the mock cluster completes the
+    tasks launched `runtime_s` earlier, the backlog refills with as
+    many new submissions, and the cycle must absorb ~2 x matched row
+    deltas + the full match. Reported p99 is the full match_cycle wall
+    including the consume (synchronous mode: dispatch + device + compact
+    readback + bulk launch txn); readback_ms isolates the tunnel RTT +
+    device wait so a co-located deployment's number is reconstructable.
+    """
+    import tempfile
+
+    from cook_tpu.backends.base import ClusterRegistry
+    from cook_tpu.backends.mock import MockCluster, MockHost
+    from cook_tpu.scheduler.coordinator import Coordinator, SchedulerConfig
+    from cook_tpu.state.model import Job, new_uuid
+    from cook_tpu.state.store import JobStore
+
+    rng = np.random.default_rng(0)
+    hosts = [MockHost(f"h{i}", mem=float(rng.uniform(64, 256) * 1024),
+                      cpus=float(rng.uniform(16, 64)))
+             for i in range(H)]
+    log_path = tempfile.mktemp(prefix="cook_e2e_", suffix=".log")
+    store = JobStore(log_path=log_path)
+    cluster = MockCluster(hosts, runtime_fn=lambda s: (runtime_s, True, None),
+                          bulk_status=True)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, config=SchedulerConfig())
+
+    def mkjobs(n):
+        return [Job(uuid=new_uuid(), user=f"u{int(rng.integers(0, U))}",
+                    command="true",
+                    mem=float(rng.uniform(1, 10) * 1024),
+                    cpus=float(rng.uniform(0.5, 4)))
+                for _ in range(n)]
+
+    t0 = time.perf_counter()
+    seed_jobs = mkjobs(P0)
+    store.create_jobs(seed_jobs)
+    seed_s = time.perf_counter() - t0
+    coord.enable_resident(synchronous=True)
+
+    t0 = time.perf_counter()
+    wall, match_ms, readback, writeback, submit_ms, matched_hist = \
+        [], [], [], [], [], []
+    phase_keys = ("drain_ms", "ship_ms", "dispatch_ms", "launch_loop_ms",
+                  "launch_txn_ms", "backend_launch_ms")
+    phases = {k: [] for k in phase_keys}
+    completed_total = 0
+    for c in range(cycles):
+        t_c = time.perf_counter()
+        stats = coord.match_cycle()
+        t_m = time.perf_counter()
+        done = cluster.advance(1.0)
+        completed_total += done
+        t_w = time.perf_counter()
+        if done:
+            store.create_jobs(mkjobs(done))   # refill the backlog
+        t_s = time.perf_counter()
+        if c >= warmup:
+            wall.append((t_m - t_c) * 1e3)
+            match_ms.append(stats.cycle_ms)
+            readback.append(coord.metrics.get("match.default.readback_ms", 0))
+            writeback.append((t_w - t_m) * 1e3)
+            submit_ms.append((t_s - t_w) * 1e3)
+            matched_hist.append(stats.matched)
+            for k in phase_keys:
+                phases[k].append(coord.metrics.get(f"match.default.{k}", 0))
+    total_s = time.perf_counter() - t0
+    wall = np.asarray(wall)
+    readback = np.asarray(readback)
+    # pure transfer RTT for a compact readback-sized payload: device
+    # round trip with no compute queued (co-located deployments pay ~0)
+    import jax
+    import jax.numpy as jnp
+    z = jnp.zeros(8192, jnp.int32) + 1
+    np.asarray(z)
+    rtts = []
+    for _ in range(10):
+        t_r = time.perf_counter()
+        np.asarray(z + 1)
+        rtts.append(time.perf_counter() - t_r)
+    rtt_ms = float(np.median(rtts) * 1e3)
+    compute_wall = np.maximum(wall - rtt_ms, 0.0)
+    dps = float(np.mean(matched_hist)) / (np.mean(wall) / 1e3)
+
+    n_pend = len(store.pending_jobs("default"))
+    n_run = len(store.running_instances("default"))
+    print(json.dumps({
+        "metric": f"sched decisions/sec, {label}",
+        "value": round(dps, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(dps / 1000.0, 2),
+        "baseline_note": BASELINE_NOTE,
+        "p99_cycle_ms": round(float(np.percentile(wall, 99)), 2),
+        "p50_cycle_ms": round(float(np.percentile(wall, 50)), 2),
+        "mean_cycle_ms": round(float(wall.mean()), 2),
+        "max_cycle_ms": round(float(wall.max()), 2),
+        "p99_minus_rtt_ms": round(float(np.percentile(compute_wall, 99)), 2),
+        "tunnel_rtt_ms": round(rtt_ms, 2),
+        "readback_mean_ms": round(float(readback.mean()), 2),
+        "host_dispatch_mean_ms": round(float(np.mean(match_ms))
+                                       - float(readback.mean()), 2),
+        "phase_means_ms": {k: round(float(np.mean(v)), 2)
+                           for k, v in phases.items()},
+        "status_writeback_mean_ms": round(float(np.mean(writeback)), 2),
+        "submit_refill_mean_ms": round(float(np.mean(submit_ms)), 2),
+        "matched_per_cycle": round(float(np.mean(matched_hist)), 1),
+        "running_steady": n_run,
+        "pending_steady": n_pend,
+        "completed_total": completed_total,
+        "seed_submit_s": round(seed_s, 1),
+        "cycles": len(wall),
+        "wall_s": round(total_s, 1),
+        "device": str(jax.devices()[0]),
+    }))
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "headline"
     if which == "headline":
@@ -438,9 +564,14 @@ def main():
         bench_rebalance()
     elif which == "stream":
         bench_stream()
+    elif which == "e2e":
+        bench_e2e()
+    elif which == "e2e-small":
+        bench_e2e(P0=20_000, H=2_000, cycles=60, warmup=10,
+                  label="e2e coordinator @ 20k-pending x 2k-offers")
     else:
-        raise SystemExit(f"unknown config {which!r}; "
-                         "one of: headline small pools rebalance stream")
+        raise SystemExit(f"unknown config {which!r}; one of: headline "
+                         "small pools rebalance stream e2e e2e-small")
 
 
 if __name__ == "__main__":

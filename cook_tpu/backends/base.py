@@ -93,11 +93,26 @@ class ComputeCluster(abc.ABC):
     def set_status_callback(self, cb: StatusCallback) -> None:
         self._status_cb = cb
 
+    def set_bulk_status_callback(self, cb) -> None:
+        """Optional batched channel: cb([(task_id, status, reason), ...])
+        writes the whole batch in one store transaction. Backends that
+        complete many tasks at once (mock clock ticks, kube relists)
+        should prefer emit_status_bulk."""
+        self._bulk_status_cb = cb
+
     def emit_status(self, task_id: str, status: InstanceStatus,
                     reason: Optional[int] = None, **extra) -> None:
         cb = getattr(self, "_status_cb", None)
         if cb:
             cb(task_id, status, reason, **extra)
+
+    def emit_status_bulk(self, updates) -> None:
+        cb = getattr(self, "_bulk_status_cb", None)
+        if cb is not None:
+            cb(updates)
+        else:
+            for task_id, status, reason in updates:
+                self.emit_status(task_id, status, reason)
 
     # lifecycle / recovery ------------------------------------------------
     def initialize(self) -> None:

@@ -52,9 +52,25 @@ class Pod:
     # renders these into the init-container spec, api.clj:661-882)
     init_uris: list = field(default_factory=list)
     # job container config: {"type": "docker", "docker": {"image": ...,
-    # "parameters": [...]}, "volumes": [...]} — the docker translation
-    # of task.clj:338-405 / pod image selection api.clj:661-882
+    # "network": "HOST"|..., "port-mapping": [{"host-port": ..,
+    # "container-port": .., "protocol": ..}]}, "volumes": [{"host-path":
+    # .., "container-path": .., "mode": "RO"|"RW"}]} — the docker
+    # translation of task.clj:338-405 / pod image selection
+    # api.clj:661-882; materialized onto the pod spec by pod_to_json
     container: Optional[dict] = None
+    # scheduling placement depth (task-metadata->pod api.clj:661-882):
+    # tolerations the cluster stamps on every job pod, the pool node
+    # selector, and the pod priority class (synthetic pods get the
+    # cluster's preemptible class so a REAL cluster autoscaler keys on
+    # it, api.clj:29-40,:339-409)
+    tolerations: list = field(default_factory=list)
+    node_selector: dict = field(default_factory=dict)
+    priority_class: str = ""
+    # sidecar file-server spec ({"image": .., "port": ..}): the
+    # reference runs its file server inside every pod
+    # (sidecar/cook/sidecar/file_server.py:45, api.clj sidecar wiring)
+    # so `cs ls/cat/tail` work for kube-launched tasks
+    sidecar: Optional[dict] = None
 
     @property
     def synthetic(self) -> bool:

@@ -34,7 +34,12 @@ class KubeCluster(ComputeCluster):
     def __init__(self, api: KubeApi, name: str = "kube",
                  max_synthetic_pods: int = MAX_SYNTHETIC_PODS,
                  synthetic_pods: bool = True,
-                 default_checkpoint_config: Optional[dict] = None):
+                 default_checkpoint_config: Optional[dict] = None,
+                 tolerations: Optional[list] = None,
+                 priority_class: str = "",
+                 synthetic_priority_class: str = "cook-synthetic-preemptible",
+                 sidecar: Optional[dict] = None,
+                 pool_node_selector: bool = True):
         self.name = name
         self.api = api
         self.max_synthetic = max_synthetic_pods
@@ -42,6 +47,19 @@ class KubeCluster(ComputeCluster):
         # cluster-wide defaults merged under each job's checkpoint
         # config (config/kubernetes :default-checkpoint-config)
         self.default_checkpoint_config = default_checkpoint_config or {}
+        # placement depth stamped on every job pod (task-metadata->pod
+        # api.clj:661-882): cluster tolerations, a pool node selector,
+        # and the job priority class. Synthetic autoscaling pods carry
+        # their own PREEMPTIBLE priority class so a real cluster
+        # autoscaler scales up for them but any real workload evicts
+        # them (api.clj:29-40, :339-409).
+        self.tolerations = tolerations or []
+        self.priority_class = priority_class
+        self.synthetic_priority_class = synthetic_priority_class
+        # sidecar file-server config {"image":..., "port":...} injected
+        # into every job pod so cs ls/cat/tail reach kube tasks
+        self.sidecar = sidecar
+        self.pool_node_selector = pool_node_selector
         self._synthetic_seq = 0
         self._lock = threading.Lock()
         self.controller = KubeController(api, self._writeback, name=name)
@@ -65,7 +83,18 @@ class KubeCluster(ComputeCluster):
                     ExpectedState.RUNNING)
         self.controller.scan()
         self.api.watch_pods(self._on_pod_event)
-        self.api.watch_nodes(lambda kind, node: None)
+        self.api.watch_nodes(self._on_node_event)
+
+    def _on_node_event(self, kind: str, node) -> None:
+        # host-SET changes (adds/removals) bump the offer generation so
+        # the device-resident match state rebuilds its host universe
+        if kind in ("added", "deleted"):
+            with self._lock:
+                self._host_gen = getattr(self, "_host_gen", 0) + 1
+
+    def offer_generation(self, pool: str) -> int:
+        with self._lock:
+            return getattr(self, "_host_gen", 0)
 
     def _on_pod_event(self, kind: str, pod: Pod) -> None:
         if pod.synthetic:
@@ -118,7 +147,12 @@ class KubeCluster(ComputeCluster):
                       labels={"cook-job": spec.job_uuid},
                       volumes=cp.checkpoint_volumes(ckpt),
                       init_uris=list(spec.uris),
-                      container=spec.container)
+                      container=spec.container,
+                      tolerations=list(self.tolerations),
+                      node_selector=({POOL_LABEL: pool}
+                                     if self.pool_node_selector else {}),
+                      priority_class=self.priority_class,
+                      sidecar=dict(self.sidecar) if self.sidecar else None)
             self.controller.set_expected(spec.task_id,
                                          ExpectedState.STARTING,
                                          launch_pod=pod)
@@ -158,7 +192,11 @@ class KubeCluster(ComputeCluster):
                 self.api.create_pod(Pod(
                     name=f"synthetic-{self.name}-{self._synthetic_seq}",
                     mem=float(mem), cpus=float(cpus), pool=pool,
-                    labels={SYNTHETIC_LABEL: "true"}))
+                    labels={SYNTHETIC_LABEL: "true"},
+                    tolerations=list(self.tolerations),
+                    node_selector=({POOL_LABEL: pool}
+                                   if self.pool_node_selector else {}),
+                    priority_class=self.synthetic_priority_class))
 
     def _on_synthetic_event(self, kind: str, pod: Pod) -> None:
         """Synthetic pods that ever start running are useless (they hold
@@ -182,7 +220,19 @@ class KubeCluster(ComputeCluster):
     # -- controller writeback -----------------------------------------
     def _writeback(self, task_id: str, event: str, info: dict) -> None:
         if event == "running":
-            self.emit_status(task_id, InstanceStatus.RUNNING, None)
+            output_url = None
+            if self.sidecar:
+                # the in-pod file server address: cs ls/cat/tail resolve
+                # the instance's output_url to the /files API
+                pod = self.controller.actual.get(task_id)
+                node = getattr(pod, "node", "") if pod else ""
+                if node:
+                    port = int(self.sidecar.get("port", 28501))
+                    output_url = f"http://{node}:{port}"
+            self.emit_status(task_id, InstanceStatus.RUNNING, None,
+                             output_url=output_url,
+                             sandbox="/cook-sandbox" if self.sidecar
+                             else None)
         elif event == "succeeded":
             self.emit_status(task_id, InstanceStatus.SUCCESS, None,
                              exit_code=info.get("exit_code", 0))

@@ -86,24 +86,85 @@ def fmt_cpu(cores: float) -> str:
 def pod_to_json(pod: Pod, namespace: str) -> dict:
     """Pod dataclass -> V1Pod wire JSON (task-metadata->pod
     api.clj:661-882: container, env, resources, labels, init-container
-    for URI fetches, volumes; restartPolicy Never like the reference)."""
+    for URI fetches, volumes, tolerations, node selectors, priority
+    class, docker port-mappings/volumes/network from the job container
+    spec (task.clj:338-405), sidecar file-server injection;
+    restartPolicy Never like the reference)."""
     requests = {"memory": fmt_mem_mb(pod.mem), "cpu": fmt_cpu(pod.cpus)}
     if pod.gpus:
         requests["nvidia.com/gpu"] = str(int(pod.gpus))
     env = [{"name": k, "value": str(v)} for k, v in sorted(pod.env.items())]
+    cdict = pod.container or {}
+    docker = cdict.get("docker") or {}
     container = {
         "name": "cook-job",
-        "image": ((pod.container or {}).get("docker", {}) or {}).get(
-            "image", "busybox:latest"),
+        "image": docker.get("image", "busybox:latest"),
         "command": ["/bin/sh", "-c", pod.command] if pod.command else None,
         "env": env,
         "resources": {"requests": requests, "limits": dict(requests)},
     }
+    # docker port mappings -> containerPorts (task.clj:367-380)
+    cports = [
+        {k: v for k, v in {
+            "containerPort": int(m.get("container-port", 0)),
+            "hostPort": int(m["host-port"]) if m.get("host-port")
+            else None,
+            "protocol": (m.get("protocol") or "TCP").upper(),
+        }.items() if v is not None}
+        for m in (docker.get("port-mapping") or [])
+    ]
+    if cports:
+        container["ports"] = cports
+    # docker volumes -> hostPath volumes + mounts (task.clj:338-366)
+    dvols, dmounts = [], []
+    for i, v in enumerate(cdict.get("volumes") or []):
+        host_path = v.get("host-path", "")
+        if not host_path:
+            continue
+        name = f"cook-docker-vol-{i}"
+        dvols.append({"name": name, "hostPath": {"path": host_path}})
+        dmounts.append({
+            "name": name,
+            "mountPath": v.get("container-path", host_path),
+            "readOnly": (v.get("mode", "RO").upper() != "RW"),
+        })
+    if dmounts:
+        container["volumeMounts"] = dmounts
     container = {k: v for k, v in container.items() if v is not None}
     spec: dict = {
         "restartPolicy": "Never",
         "containers": [container],
     }
+    if dvols:
+        spec["volumes"] = list(dvols)
+    if (docker.get("network") or "").upper() == "HOST":
+        spec["hostNetwork"] = True
+    if pod.tolerations:
+        spec["tolerations"] = [dict(t) for t in pod.tolerations]
+    if pod.node_selector:
+        spec["nodeSelector"] = dict(pod.node_selector)
+    if pod.priority_class:
+        spec["priorityClassName"] = pod.priority_class
+    if pod.sidecar:
+        # in-pod file server sharing the sandbox volume: serves the
+        # /files/{read,download,browse} API for `cs ls/cat/tail`
+        port = int(pod.sidecar.get("port", 28501))
+        spec["containers"].append({
+            "name": "cook-sidecar",
+            "image": pod.sidecar.get("image", "busybox:latest"),
+            "command": ["/bin/sh", "-c",
+                        pod.sidecar.get(
+                            "command",
+                            "python -m cook_tpu.agent.file_server "
+                            f"--root /cook-sandbox --port {port}")],
+            "ports": [{"containerPort": port}],
+            "volumeMounts": [{"name": "cook-sandbox",
+                              "mountPath": "/cook-sandbox"}],
+        })
+        if not any(v.get("name") == "cook-sandbox"
+                   for v in spec.get("volumes", [])):
+            spec.setdefault("volumes", []).append(
+                {"name": "cook-sandbox", "emptyDir": {}})
     if pod.node:
         spec["nodeName"] = pod.node
     if pod.init_uris:
@@ -121,8 +182,16 @@ def pod_to_json(pod: Pod, namespace: str) -> dict:
             "volumeMounts": [{"name": "cook-sandbox",
                               "mountPath": "/cook-sandbox"}],
         }]
-        spec.setdefault("volumes", []).append(
-            {"name": "cook-sandbox", "emptyDir": {}})
+        if not any(v.get("name") == "cook-sandbox"
+                   for v in spec.get("volumes", [])):
+            spec.setdefault("volumes", []).append(
+                {"name": "cook-sandbox", "emptyDir": {}})
+    if any(v.get("name") == "cook-sandbox"
+           for v in spec.get("volumes", [])):
+        # the job container must see the sandbox the init-container
+        # staged and the sidecar serves
+        spec["containers"][0].setdefault("volumeMounts", []).append(
+            {"name": "cook-sandbox", "mountPath": "/cook-sandbox"})
     for vol in pod.volumes:
         spec.setdefault("volumes", []).append(vol)
     labels = {**pod.labels, POOL_LABEL: pod.pool}
@@ -160,10 +229,46 @@ def pod_from_json(obj: dict) -> Pod:
     # recover image / volumes / URI fetches so the round trip through an
     # apiserver keeps the launch-relevant fields
     image = c0.get("image")
-    container = {"type": "docker", "docker": {"image": image}} \
-        if image and image != "busybox:latest" else None
-    volumes = [v for v in (spec.get("volumes") or [])
-               if v.get("name") != "cook-sandbox"]
+    docker: dict = {}
+    if image and image != "busybox:latest":
+        docker["image"] = image
+    if spec.get("hostNetwork"):
+        docker["network"] = "HOST"
+    pmaps = [
+        {"container-port": p.get("containerPort"),
+         **({"host-port": p["hostPort"]} if p.get("hostPort") else {}),
+         "protocol": p.get("protocol", "TCP")}
+        for p in (c0.get("ports") or [])
+    ]
+    if pmaps:
+        docker["port-mapping"] = pmaps
+    dvols = []
+    mounts = {m.get("name"): m for m in (c0.get("volumeMounts") or [])}
+    cvolumes = []
+    for v in spec.get("volumes") or []:
+        name = v.get("name", "")
+        if name == "cook-sandbox":
+            continue
+        if name.startswith("cook-docker-vol-") and "hostPath" in v:
+            m = mounts.get(name, {})
+            dvols.append({
+                "host-path": v["hostPath"].get("path", ""),
+                "container-path": m.get("mountPath", ""),
+                "mode": "RO" if m.get("readOnly") else "RW"})
+        else:
+            cvolumes.append(v)
+    container = None
+    if docker or dvols:
+        container = {"type": "docker", "docker": docker}
+        if dvols:
+            container["volumes"] = dvols
+    volumes = cvolumes
+    sidecar = None
+    for c in containers[1:]:
+        if c.get("name") == "cook-sidecar":
+            sport = next((p.get("containerPort")
+                          for p in c.get("ports") or []), 28501)
+            sidecar = {"image": c.get("image", ""), "port": sport}
     init_uris = []
     for ic in spec.get("initContainers") or []:
         cmd = ic.get("command") or []
@@ -192,6 +297,10 @@ def pod_from_json(obj: dict) -> Pod:
         volumes=volumes,
         init_uris=init_uris,
         container=container,
+        tolerations=list(spec.get("tolerations") or []),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        priority_class=spec.get("priorityClassName", "") or "",
+        sidecar=sidecar,
     )
 
 
@@ -297,9 +406,29 @@ class HttpKube(KubeApi):
         return urllib.request.urlopen(
             req, timeout=timeout or self.timeout_s, context=self._ctx)
 
-    def _json(self, method: str, path: str, body: Optional[dict] = None):
-        with self._request(method, path, body) as resp:
-            return json.loads(resp.read().decode())
+    def _json(self, method: str, path: str, body: Optional[dict] = None,
+              max_429_retries: int = 4):
+        """One JSON request, honoring apiserver 429 + Retry-After
+        backpressure with bounded retries (the priority-and-fairness
+        production failure mode of kubernetes/api.clj-class clients)."""
+        attempt = 0
+        while True:
+            try:
+                with self._request(method, path, body) as resp:
+                    return json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                if e.code != 429 or attempt >= max_429_retries or \
+                        self._stopping.is_set():
+                    raise
+                retry_after = 1.0
+                try:
+                    retry_after = float(e.headers.get("Retry-After", 1))
+                except (TypeError, ValueError):
+                    pass
+                attempt += 1
+                logger.info("apiserver 429; retrying in %.1fs "
+                            "(attempt %d)", retry_after, attempt)
+                time.sleep(min(retry_after, 30.0))
 
     # -- CRUD (api.clj:1048,1088) --------------------------------------
     def _pods_path(self) -> str:
@@ -417,6 +546,25 @@ class HttpKube(KubeApi):
                 rv = None                # 410: full relist
             except TimeoutError:
                 continue                 # quiet watch: resume from rv
+            except urllib.error.HTTPError as e:
+                if self._stopping.is_set():
+                    return
+                if e.code == 429:
+                    # watch-establishment throttled: honor Retry-After
+                    # and resume from rv — the cache stays warm
+                    try:
+                        wait = float(e.headers.get("Retry-After", 1))
+                    except (TypeError, ValueError):
+                        wait = 1.0
+                    logger.info("kube %s watch throttled; retrying in "
+                                "%.1fs", kind, wait)
+                    time.sleep(min(wait, 30.0))
+                    continue
+                logger.warning("kube %s watch HTTP error (%s); "
+                               "reconnecting in %.1fs", kind, e, backoff)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, backoff_hi)
+                rv = None
             except Exception as e:
                 if self._stopping.is_set():
                     return

@@ -94,6 +94,14 @@ class ApiServerStandIn:
         # are resourceVersion compare-and-swap like a real apiserver
         self._leases: dict[str, dict] = {}
         self.list_counts = {"pods": 0, "nodes": 0}   # test observability
+        # raw wire JSON of every POSTed pod, keyed by name: tests assert
+        # the client materialized tolerations/selectors/priority/sidecar
+        # on the WIRE, not just on the dataclass
+        self.pod_specs: dict[str, dict] = {}
+        # >0: the next N non-watch requests are answered 429 with
+        # Retry-After (apiserver priority-and-fairness throttling)
+        self._throttle_left = 0
+        self._throttle_retry_after = 1
         self.fake.watch_pods(self._on_pod)
         self.fake.watch_nodes(self._on_node)
 
@@ -136,7 +144,7 @@ class ApiServerStandIn:
         """Sever all live watch connections (simulated network blip)."""
         with self._lock:
             streams, self._streams = self._streams, []
-        for _, q in streams:
+        for _, q, _bm in streams:
             q.put(None)
 
     def expire_history(self) -> None:
@@ -145,6 +153,27 @@ class ApiServerStandIn:
         with self._lock:
             self._history.clear()
             self._oldest_rv = self._rv
+
+    def throttle_next(self, n: int, retry_after: int = 1) -> None:
+        """The next n non-watch requests get 429 + Retry-After — the
+        apiserver's priority-and-fairness backpressure clients must
+        honor (kubernetes/api.clj-class clients break here)."""
+        with self._lock:
+            self._throttle_left = n
+            self._throttle_retry_after = retry_after
+
+    def post_bookmark(self) -> None:
+        """Broadcast a BOOKMARK event carrying the current rv to every
+        live watch that asked for bookmarks (allowWatchBookmarks): lets
+        idle watchers advance their resume point past history they never
+        saw, so a later reconnect doesn't 410."""
+        with self._lock:
+            for res, q, bookmarks in list(self._streams):
+                if bookmarks:
+                    q.put({"type": "BOOKMARK", "object": {
+                        "kind": {"pods": "Pod", "nodes": "Node",
+                                 "events": "Event"}.get(res, "Pod"),
+                        "metadata": {"resourceVersion": str(self._rv)}}})
 
     def post_event(self, reason: str, message: str,
                    involved_name: str = "", etype: str = "Warning") -> None:
@@ -186,7 +215,7 @@ class ApiServerStandIn:
         if len(self._history) == self._history.maxlen:
             self._oldest_rv = self._history[0][0]
         self._history.append((self._rv, resource, event))
-        for res, q in list(self._streams):
+        for res, q, _bm in list(self._streams):
             if res == resource:
                 q.put(event)
 
@@ -201,6 +230,20 @@ class ApiServerStandIn:
         parsed = urlparse(h.path)
         parts = [p for p in parsed.path.split("/") if p]
         qs = parse_qs(parsed.query)
+        if qs.get("watch", ["false"])[0] != "true":
+            with self._lock:
+                if self._throttle_left > 0:
+                    self._throttle_left -= 1
+                    data = json.dumps({"kind": "Status", "code": 429,
+                                       "reason": "TooManyRequests"}).encode()
+                    h.send_response(429)
+                    h.send_header("Retry-After",
+                                  str(self._throttle_retry_after))
+                    h.send_header("Content-Type", "application/json")
+                    h.send_header("Content-Length", str(len(data)))
+                    h.end_headers()
+                    h.wfile.write(data)
+                    return
         try:
             self._route(h, method, parts, qs)
         except BrokenPipeError:
@@ -261,11 +304,18 @@ class ApiServerStandIn:
         elif method == "POST" and parts == ns_pods:
             length = int(h.headers.get("Content-Length", 0))
             body = json.loads(h.rfile.read(length).decode())
+            bad = self._invalid_pod_reason(body)
+            if bad:
+                self._send_json(h, 422, {"kind": "Status", "code": 422,
+                                         "reason": "Invalid",
+                                         "message": bad})
+                return
             pod = pod_from_json(body)
             if pod.name in self.fake.pods:
                 self._send_json(h, 409, {"kind": "Status", "code": 409,
                                          "reason": "AlreadyExists"})
                 return
+            self.pod_specs[pod.name] = body
             self.fake.create_pod(pod)
             with self._lock:
                 self._send_json(h, 201,
@@ -354,7 +404,9 @@ class ApiServerStandIn:
                 return
             backlog = [ev for (erv, res, ev) in self._history
                        if res == resource and erv > rv]
-            self._streams.append((resource, q))
+            bookmarks = qs.get("allowWatchBookmarks",
+                               ["false"])[0] == "true"
+            self._streams.append((resource, q, bookmarks))
         h.send_response(200)
         h.send_header("Content-Type", "application/json")
         h.end_headers()
@@ -370,8 +422,41 @@ class ApiServerStandIn:
                 h.wfile.flush()
         finally:
             with self._lock:
-                self._streams = [(r, sq) for (r, sq) in self._streams
-                                 if sq is not q]
+                self._streams = [(r, sq, bm) for (r, sq, bm)
+                                 in self._streams if sq is not q]
+
+    @staticmethod
+    def _invalid_pod_reason(body: dict) -> str:
+        """Apiserver-grade structural validation of a POSTed pod: the
+        fields a real admission chain would reject on. Returns "" when
+        valid."""
+        if body.get("apiVersion") != "v1" or body.get("kind") != "Pod":
+            return "apiVersion/kind must be v1/Pod"
+        if not (body.get("metadata") or {}).get("name"):
+            return "metadata.name required"
+        spec = body.get("spec") or {}
+        containers = spec.get("containers") or []
+        if not containers:
+            return "spec.containers must be non-empty"
+        names = set()
+        vol_names = {v.get("name") for v in spec.get("volumes") or []}
+        for c in containers + (spec.get("initContainers") or []):
+            if not c.get("name"):
+                return "container name required"
+            if c["name"] in names:
+                return f"duplicate container name {c['name']}"
+            names.add(c["name"])
+            for m in c.get("volumeMounts") or []:
+                if m.get("name") not in vol_names:
+                    return (f"container {c['name']} mounts unknown "
+                            f"volume {m.get('name')}")
+        req = (containers[0].get("resources") or {}).get("requests") or {}
+        if "memory" not in req or "cpu" not in req:
+            return "first container must request memory and cpu"
+        for t in spec.get("tolerations") or []:
+            if t.get("operator", "Equal") not in ("Equal", "Exists"):
+                return f"bad toleration operator {t.get('operator')}"
+        return ""
 
     @staticmethod
     def _send_json(h, code: int, body: dict) -> None:

@@ -44,7 +44,13 @@ class MockCluster(ComputeCluster):
     reason_code)` decides each task's fate (default: 60 s success)."""
 
     def __init__(self, hosts: list[MockHost], name: str = "mock",
-                 runtime_fn: Optional[Callable] = None):
+                 runtime_fn: Optional[Callable] = None,
+                 bulk_status: bool = False):
+        # bulk_status: deliver clock-tick completions through the
+        # batched status channel (one store txn per tick) — the
+        # at-scale path; per-item default preserves the completion-
+        # plugin / reservation side effects unit tests rely on
+        self.bulk_status = bulk_status
         self.name = name
         self.hosts = {h.hostname: h for h in hosts}
         self.used: dict[str, list[float]] = {
@@ -92,19 +98,20 @@ class MockCluster(ComputeCluster):
         return ranges
 
     def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
+        batch = []
         with self._lock:
             for spec in specs:
                 host = self.hosts.get(spec.hostname)
                 if host is None:
-                    self.emit_status(spec.task_id, InstanceStatus.FAILED, 5000)
+                    batch.append((spec.task_id, InstanceStatus.FAILED, 5000))
                     continue
                 um, uc, ug = self.used[spec.hostname]
                 if (um + spec.mem > host.mem + 1e-6
                         or uc + spec.cpus > host.cpus + 1e-6
                         or ug + spec.gpus > host.gpus + 1e-6):
                     # oversubscription = launch failure
-                    self.emit_status(spec.task_id, InstanceStatus.FAILED,
-                                     99000)
+                    batch.append((spec.task_id, InstanceStatus.FAILED,
+                                  99000))
                     continue
                 self.used[spec.hostname] = [um + spec.mem, uc + spec.cpus,
                                             ug + spec.gpus]
@@ -113,7 +120,14 @@ class MockCluster(ComputeCluster):
                 t = _RunningTask(spec, self.clock + runtime, success, reason)
                 self.tasks[spec.task_id] = t
                 heapq.heappush(self._heap, (t.end_time, spec.task_id))
-                self.emit_status(spec.task_id, InstanceStatus.RUNNING, None)
+                batch.append((spec.task_id, InstanceStatus.RUNNING, None))
+        # one store transaction for the whole launch batch in bulk mode
+        # (a per-task emit costs a durability barrier per status)
+        if self.bulk_status:
+            self.emit_status_bulk(batch)
+        else:
+            for task_id, status, reason in batch:
+                self.emit_status(task_id, status, reason)
 
     def kill_task(self, task_id: str) -> None:
         with self._lock:
@@ -136,6 +150,34 @@ class MockCluster(ComputeCluster):
         with self._lock:
             return set(self.tasks)
 
+    def allocate_ports(self, hostname: str, n: int):
+        """Reserve n free host ports for a launch (the resident match
+        path assigns ports at writeback instead of carrying per-offer
+        range lists). Returns the ports or None when exhausted; the
+        reservation is released by _release via the launched spec."""
+        with self._lock:
+            h = self.hosts.get(hostname)
+            if h is None:
+                return None
+            used = self.used_ports.setdefault(hostname, set())
+            lo, hi = h.port_range
+            free = [p for p in range(lo, hi + 1) if p not in used]
+            if len(free) < n:
+                return None
+            got = free[:n]
+            used.update(got)   # reserved NOW; launch_tasks re-adds them
+            return got
+
+    def release_ports(self, hostname: str, ports) -> None:
+        with self._lock:
+            self.used_ports.get(hostname, set()).difference_update(ports)
+
+    def offer_generation(self, pool: str) -> int:
+        """Bumps whenever the host SET changes (adds/removals) so the
+        resident state knows to rebuild its host universe."""
+        with self._lock:
+            return getattr(self, "_host_gen", 0)
+
     def host_attributes(self) -> dict[str, dict[str, str]]:
         with self._lock:
             return {h.hostname: dict(h.attributes)
@@ -147,7 +189,7 @@ class MockCluster(ComputeCluster):
         number of completions emitted."""
         with self._lock:
             self.clock += dt
-            done = 0
+            batch = []
             while self._heap and self._heap[0][0] <= self.clock:
                 _, task_id = heapq.heappop(self._heap)
                 t = self.tasks.pop(task_id, None)
@@ -156,10 +198,14 @@ class MockCluster(ComputeCluster):
                 self._release(t.spec)
                 status = (InstanceStatus.SUCCESS if t.success
                           else InstanceStatus.FAILED)
-                self.emit_status(task_id, status,
-                                 t.reason if not t.success else None)
-                done += 1
-            return done
+                batch.append((task_id, status,
+                              t.reason if not t.success else None))
+        if self.bulk_status:
+            self.emit_status_bulk(batch)
+        else:
+            for task_id, status, reason in batch:
+                self.emit_status(task_id, status, reason)
+        return len(batch)
 
     def next_completion_time(self) -> Optional[float]:
         with self._lock:
@@ -193,4 +239,12 @@ class MockCluster(ComputeCluster):
                 self.emit_status(tid, InstanceStatus.FAILED, 5000)
             self.hosts.pop(hostname, None)
             self.used.pop(hostname, None)
+            self._host_gen = getattr(self, "_host_gen", 0) + 1
             return dead
+
+    def add_host(self, host: MockHost) -> None:
+        with self._lock:
+            self.hosts[host.hostname] = host
+            self.used[host.hostname] = [0.0, 0.0, 0.0]
+            self.used_ports[host.hostname] = set()
+            self._host_gen = getattr(self, "_host_gen", 0) + 1

@@ -41,6 +41,16 @@ class CycleResult(NamedTuple):
     mem_left: jnp.ndarray        # (H,)
     cpus_left: jnp.ndarray       # (H,)
     gpus_left: jnp.ndarray       # (H,)
+    slots_left: jnp.ndarray      # (H,) i32
+    # compact views of the considerable batch, queue-ordered — the
+    # device-resident coordinator reads ONLY these back (2xC i32 per
+    # cycle instead of P-sized vectors):
+    cons_idx: jnp.ndarray        # (C,) pending-row index per compact slot,
+                                 # -1 = empty slot
+    cons_host: jnp.ndarray       # (C,) assigned host per compact slot, -1
+    head_matched: jnp.ndarray    # () bool — queue-head considerable placed
+    n_matched: jnp.ndarray       # () i32
+    n_considerable: jnp.ndarray  # () i32
 
 
 @functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
@@ -56,7 +66,12 @@ def rank_and_match(
     pend_unique_group,
     # hosts
     hosts: match_ops.Hosts,
-    forbidden,                 # (P, H) bool
+    forbidden,                 # None | (P, H) bool dense | tuple of
+                               # (rows (K, H) bool, slot_of (P,) i32) —
+                               # the sparse resident form: row p's mask
+                               # is rows[slot_of[p]] when slot_of[p] >= 0,
+                               # all-allowed otherwise. K << P because
+                               # only constrained jobs own a mask row.
     # per-user quotas (U,)
     user_quota_mem, user_quota_cpus, user_quota_count,
     num_considerable: int = 1024,
@@ -75,6 +90,10 @@ def rank_and_match(
                                # tuning; ignored on the sequential path.
                                # STATIC under jit: pass a hashable
                                # (tuple of (name, value) pairs)
+    pend_ports=None,           # (P,) i32 requested port count; with
+    host_ports=None,           # (H,) i32 free ports — folds the ports
+                               # feasibility check (task.clj:254-280)
+                               # into the compact forbidden mask
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -172,8 +191,17 @@ def rank_and_match(
     )
     if forbidden is None:
         forb = match_ops.varying_full(hosts.valid, False, (C, H), bool)
+    elif isinstance(forbidden, tuple):
+        rows, slot_of = forbidden
+        Kc = rows.shape[0]
+        slot = slot_of[pend_idx]
+        forb = jnp.where((slot >= 0)[:, None],
+                         rows[jnp.clip(slot, 0, Kc - 1)], False)
+        forb &= in_use[:, None]
     else:
         forb = forbidden[pend_idx] & in_use[:, None]
+    if pend_ports is not None and host_ports is not None:
+        forb = forb | (pend_ports[pend_idx][:, None] > host_ports[None, :])
     bonusc = None if bonus is None else bonus[pend_idx] * in_use[:, None]
     if sequential:
         res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups,
@@ -189,7 +217,16 @@ def rank_and_match(
     job_host = jnp.full(P, match_ops.NO_HOST).at[scatter_idx].set(
         res.job_host, mode="drop")
 
+    # compact outputs: slot order IS queue order (slots were assigned by
+    # queue-position cumsum), so the launch loop walks cons_idx directly
+    cons_idx = jnp.where(in_use, pend_idx, -1).astype(jnp.int32)
+    matched_slot = in_use & (res.job_host >= 0)
+    head_matched = ~in_use[0] | (res.job_host[0] >= 0)
     return CycleResult(pending_dru=pending_dru, queue_rank=queue_rank,
                        considerable=considerable, job_host=job_host,
                        mem_left=res.mem_left, cpus_left=res.cpus_left,
-                       gpus_left=res.gpus_left)
+                       gpus_left=res.gpus_left, slots_left=res.slots_left,
+                       cons_idx=cons_idx, cons_host=res.job_host,
+                       head_matched=head_matched,
+                       n_matched=matched_slot.sum().astype(jnp.int32),
+                       n_considerable=in_use.sum().astype(jnp.int32))

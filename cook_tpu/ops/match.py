@@ -106,6 +106,7 @@ class MatchResult(NamedTuple):
     mem_left: jnp.ndarray   # (H,) f32 host resources after assignment
     cpus_left: jnp.ndarray
     gpus_left: jnp.ndarray
+    slots_left: jnp.ndarray  # (H,) i32 task slots after assignment
 
 
 def _fitness(job_mem, job_cpus, mem_left, cpus_left, cap_mem, cap_cpus):
@@ -188,9 +189,9 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     if bonus is None:
         bonus = varying_full(hosts.valid, 0.0, forbidden.shape, jnp.float32)
     carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots, group_occ)
-    (mem_left, cpus_left, gpus_left, _, _), job_host = _scan_assign(
+    (mem_left, cpus_left, gpus_left, slots_left, _), job_host = _scan_assign(
         jobs, hosts, forbidden, bonus, num_groups, carry)
-    return MatchResult(job_host, mem_left, cpus_left, gpus_left)
+    return MatchResult(job_host, mem_left, cpus_left, gpus_left, slots_left)
 
 
 @functools.partial(jax.jit, static_argnames=("rounds", "num_groups",
@@ -511,8 +512,8 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         need_dense = jnp.any(jobs.valid & (state[0] == NO_HOST)
                              & ~hopeless0)
         state = jax.lax.cond(need_dense, run_dense, lambda s: s, state)
-    job_host, mem_left, cpus_left, gpus_left, _, _ = state
-    return MatchResult(job_host, mem_left, cpus_left, gpus_left)
+    job_host, mem_left, cpus_left, gpus_left, slots_left, _ = state
+    return MatchResult(job_host, mem_left, cpus_left, gpus_left, slots_left)
 
 
 def inversion_positions_np(jobs: Jobs, hosts: Hosts, forbidden,

@@ -343,6 +343,14 @@ def main(argv=None) -> None:
         # re-replay the shared snapshot+log: the previous leader kept
         # appending after this standby's boot-time restore
         store.reload_from(settings.snapshot_path)
+        # epoch-stamp every log entry with this leadership's lease
+        # transition count: replay drops any entry a stalled PREVIOUS
+        # leader physically appends after this point (the TOCTOU window
+        # the append_gate check-then-append cannot fully close)
+        elector = getattr(api, "leader_elector", None)
+        epoch = getattr(elector, "epoch", 0)
+        if epoch:
+            store.epoch = max(epoch, store._replay_max_epoch + 1)
         if not _still_leader():
             raise RuntimeError("leadership lost during takeover replay")
         for cluster in coord.clusters.all():

@@ -193,6 +193,43 @@ class Coordinator:
             max_workers=8, thread_name_prefix="launch")
         for cluster in clusters.all():
             cluster.set_status_callback(self._status_entry)
+            if hasattr(cluster, "set_bulk_status_callback"):
+                cluster.set_bulk_status_callback(self._status_entry_bulk)
+
+    def _status_entry_bulk(self, updates) -> None:
+        """Batched status writeback: updates = [(task_id, status,
+        reason_code[, extras]), ...]. One store transaction (one
+        durability barrier) for the whole batch; same per-item state
+        machine and the same post-write side effects as the per-item
+        path (_on_status): completion plugins, reservation release,
+        native match-book GC. Ordering: the whole batch applies on the
+        caller's thread in order, which is strictly stronger than the
+        per-task-id ordering the sharded executors guarantee."""
+        lc = getattr(self, "_leadership_check", None)
+        if lc is not None and not lc():
+            log.warning("dropping %d statuses: not leader", len(updates))
+            return
+        self.store.update_instances_bulk(updates)
+        for item in updates:
+            task_id, status = item[0], item[1]
+            job_uuid = self.store.task_to_job.get(task_id)
+            job = self.store.jobs.get(job_uuid) if job_uuid else None
+            if job is None:
+                continue
+            if status == InstanceStatus.RUNNING and \
+                    job_uuid in self.reservations:
+                self.reservations.pop(job_uuid, None)
+            if status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
+                if self.plugins is not None:
+                    inst = self.store.get_instance(task_id)
+                    try:
+                        self.plugins.completion.on_instance_completion(
+                            job, inst)
+                    except Exception:
+                        log.exception("completion plugin failed")
+                if self.forbidden_builder is not None \
+                        and job.state == JobState.COMPLETED:
+                    self.forbidden_builder.forget(job.uuid)
 
     def _status_entry(self, task_id: str, status, reason=None,
                       **extra) -> None:
@@ -286,9 +323,305 @@ class Coordinator:
                 self.reservations.pop(uuid, None)
 
     # ------------------------------------------------------------------
+    # device-resident fast path (scheduler/resident.py): tensors stay on
+    # device, the host ships store-event deltas and reads back only the
+    # compact considerable batch
+    def enable_resident(self, pool: Optional[str] = None,
+                        synchronous: bool = True, **kw) -> None:
+        """Switch `pool`'s match cycle to the device-resident path.
+        synchronous=False decouples launch writeback onto a consumer
+        thread (production/bench mode); True consumes inline
+        (deterministic, for tests and the simulator)."""
+        if self.plugins is not None or self.data_locality is not None \
+                or self.config.estimated_completion.enabled:
+            raise ValueError(
+                "resident match path does not support launch plugins, "
+                "data-locality bonuses, or the estimated-completion "
+                "constraint; keep the legacy cycle for this config")
+        from cook_tpu.scheduler.resident import ResidentPool
+        pool = pool or self.pools.default_pool
+        if not hasattr(self, "_resident"):
+            self._resident: dict[str, "ResidentPool"] = {}
+            self.store.add_listener(self._resident_listener)
+        rp = ResidentPool(self, pool, synchronous=synchronous, **kw)
+        self._resident[pool] = rp
+        if not synchronous and not hasattr(self, "_consume_q"):
+            import queue
+            self._consume_q: "queue.Queue" = queue.Queue(maxsize=2)
+            t = threading.Thread(target=self._consume_loop, daemon=True,
+                                 name="resident-consumer")
+            t.start()
+            self._threads.append(t)
+
+    def _resident_listener(self, kind: str, data: dict) -> None:
+        for rp in self._resident.values():
+            rp.on_event(kind, data)
+
+    def _consume_loop(self) -> None:
+        while True:
+            item = self._consume_q.get()
+            if item is None:
+                return
+            pool, rp, out = item
+            try:
+                self._consume_cycle(pool, rp, out)
+            except Exception:
+                # the device already depleted this cycle's matched
+                # capacity and invalidated the matched rows; without a
+                # successful readback we cannot credit them back row by
+                # row — rebuild from the store/backend truth instead
+                log.exception("resident consume failed; scheduling "
+                              "full resync")
+                rp.consumed_through = out.cycle_no
+                if rp._inflight and rp._inflight[0] is out:
+                    rp._inflight.popleft()
+                rp.request_resync()
+
+    def drain_resident(self, pool: Optional[str] = None) -> None:
+        """Block until every in-flight resident cycle is consumed (tests
+        and shutdown)."""
+        pools = [pool] if pool else list(getattr(self, "_resident", {}))
+        for p in pools:
+            rp = self._resident.get(p)
+            while rp is not None and rp._inflight:
+                time.sleep(0.001)
+
+    def _match_cycle_resident(self, pool: str, rp) -> MatchStats:
+        t0 = time.perf_counter()
+        stats = MatchStats()
+        self._purge_reservations()
+        # a due resync must wait for the in-flight cycles (their row
+        # mappings die with the rebuild); draining them bounds the wait
+        # at the consumer queue depth, so a due resync always runs this
+        # cycle instead of being skipped under sustained load
+        if rp.resync_due():
+            self.drain_resident(pool)
+            rp.resync()
+        try:
+            deltas = rp.drain()
+            t_drain = time.perf_counter()
+            bundle = rp._ship(deltas)
+        except Exception as e:
+            from cook_tpu.scheduler.resident import _NeedResync
+            if isinstance(e, _NeedResync):
+                log.info("resident resync (%s)", e)
+                self.drain_resident(pool)
+                rp.resync()
+                deltas = rp.drain()
+                t_drain = time.perf_counter()
+                bundle = rp._ship(deltas)
+            else:
+                raise
+        t_ship = time.perf_counter()
+        qm, qc, qn = quota_arrays(self.quotas, self.interner, pool)
+        # per-user launch rate limit folds into the count quota; the
+        # global limiter gates the whole cycle (scheduler.clj:627-657)
+        if self.user_launch_rl.enforce:
+            for user, uid in self.interner.ids.items():
+                if uid < qn.shape[0] and \
+                        not self.user_launch_rl.would_allow(user):
+                    qn[uid] = 0
+        limit = self._num_considerable.get(
+            pool, self.config.max_jobs_considered)
+        if not self.launch_rl.would_allow("global"):
+            limit = 0
+        C = min(bucket(self.config.max_jobs_considered), rp.Pcap)
+        gpu_pool = self.pools.get(pool).dru_mode == DruMode.GPU
+        out = rp.dispatch(
+            bundle, qm, qc, qn, considerable_limit=limit,
+            num_considerable=C,
+            sequential=C <= self.config.sequential_match_threshold,
+            dru_mode="gpu" if gpu_pool else "default",
+            use_pallas=self.config.use_pallas)
+        t_dispatch = time.perf_counter()
+        stats.offers = len(rp.host_names)
+        if rp.synchronous:
+            try:
+                c_stats = self._consume_cycle(pool, rp, out)
+            except Exception:
+                rp.consumed_through = out.cycle_no
+                if rp._inflight and rp._inflight[0] is out:
+                    rp._inflight.popleft()
+                rp.request_resync()
+                raise
+            stats.considerable = c_stats["considerable"]
+            stats.matched = c_stats["matched"]
+            stats.head_matched = c_stats["head_matched"]
+        else:
+            self._consume_q.put((pool, rp, out))   # backpressure at 2
+            last = rp.stats_last
+            if last is not None:
+                stats.considerable = last["considerable"]
+                stats.matched = last["matched"]
+                stats.head_matched = last["head_matched"]
+        stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
+        self.metrics[f"match.{pool}.drain_ms"] = (t_drain - t0) * 1e3
+        self.metrics[f"match.{pool}.ship_ms"] = (t_ship - t_drain) * 1e3
+        self.metrics[f"match.{pool}.dispatch_ms"] = \
+            (t_dispatch - t_ship) * 1e3
+        metrics_registry.timer(f"match.{pool}.cycle_ms").update(
+            stats.cycle_ms)
+        metrics_registry.meter(f"match.{pool}.matched").mark(stats.matched)
+        metrics_registry.counter(f"match.{pool}.cycles").inc()
+        return stats
+
+    def _consume_cycle(self, pool: str, rp, out) -> dict:
+        """Block on one cycle's compact readback, run the bulk launch
+        transaction, hand specs to the backends. Returns cycle stats."""
+        import jax
+        t_rb0 = time.perf_counter()
+        cons_idx, cons_host, head_matched, n_considerable = jax.device_get(
+            (out.cons_idx, out.cons_host, out.head_matched,
+             out.n_considerable))
+        head_matched = bool(head_matched)
+        n_considerable = int(n_considerable)
+        t_rb1 = time.perf_counter()
+        self.metrics[f"match.{pool}.readback_ms"] = (t_rb1 - t_rb0) * 1e3
+        items = []        # (uuid, hostname, cluster_name)
+        item_jobs = []    # (job, ports)
+        with rp.mirror_lock:
+            m = rp._pend_m
+            for i in range(len(cons_idx)):
+                row = int(cons_idx[i])
+                h = int(cons_host[i])
+                if row < 0 or h < 0 or h >= len(rp.host_names):
+                    continue
+                uuid = rp.row_uuid[row]
+                job = self.store.get_job(uuid) if uuid else None
+                hostname = rp.host_names[h]
+                if job is None:
+                    # row freed by a racing kill: its mirror values are
+                    # still the matched job's (cooling blocks reuse), so
+                    # the credit is exact
+                    rp.queue_credit(h, float(m["mem"][row]),
+                                    float(m["cpus"][row]),
+                                    float(m["gpus"][row]), 1,
+                                    int(m["ports"][row]))
+                    continue
+
+                def refuse():
+                    rp.queue_credit(h, self._effective_mem(job), job.cpus,
+                                    job.gpus, 1, job.ports)
+
+                if not self.user_launch_rl.try_acquire(job.user):
+                    refuse()
+                    rp.mark_job_dirty(uuid)
+                    continue
+                ports: list[int] = []
+                if job.ports > 0:
+                    cluster = self.clusters.get(rp.offer_cluster[hostname])
+                    alloc = getattr(cluster, "allocate_ports", None)
+                    if alloc is not None:
+                        ports = alloc(hostname, job.ports)
+                        if not ports:
+                            # genuine exhaustion: defer to a later cycle
+                            refuse()
+                            rp.mark_job_dirty(uuid)
+                            continue
+                        ports = list(ports)
+                    else:
+                        # backend advertises no allocator: it matched
+                        # because it advertised port capacity in its
+                        # offers (backends without ports never match a
+                        # ports job — the kernel forbids it). Launch
+                        # without assigned numbers rather than refusing
+                        # forever; the backend owns port binding.
+                        log.warning("cluster %s lacks allocate_ports; "
+                                    "launching %s without assigned "
+                                    "ports", cluster.name, uuid)
+                        ports = []
+                items.append((uuid, hostname, rp.offer_cluster[hostname]))
+                item_jobs.append((job, ports))
+        t_loop = time.perf_counter()
+        self.metrics[f"match.{pool}.launch_loop_ms"] = \
+            (t_loop - t_rb1) * 1e3
+        insts = self.store.create_instances_bulk(
+            items, origin=("resident", pool)) if items else []
+        self.metrics[f"match.{pool}.launch_txn_ms"] = \
+            (time.perf_counter() - t_loop) * 1e3
+        by_cluster: dict[str, list[LaunchSpec]] = {}
+        launched = 0
+        for (uuid, hostname, cname), (job, ports), inst in zip(
+                items, item_jobs, insts):
+            if inst is None:
+                # killed/launched since matching: restore the capacity
+                # the device already depleted
+                rp.queue_credit(rp.host_ids[hostname],
+                                self._effective_mem(job), job.cpus,
+                                job.gpus, 1, job.ports)
+                rp.mark_job_dirty(uuid)
+                if ports:
+                    rel = getattr(self.clusters.get(cname),
+                                  "release_ports", None)
+                    if rel:
+                        rel(hostname, ports)
+                continue
+            inst.ports = ports
+            env = dict(job.env)
+            for k, p in enumerate(ports):
+                env[f"PORT{k}"] = str(p)
+            by_cluster.setdefault(cname, []).append(
+                LaunchSpec(task_id=inst.task_id, job_uuid=uuid,
+                           hostname=hostname, command=job.command,
+                           mem=job.mem, cpus=job.cpus, gpus=job.gpus,
+                           env=env, container=job.container,
+                           progress_regex=job.progress_regex_string,
+                           progress_output_file=job.progress_output_file,
+                           checkpoint=job.checkpoint,
+                           prior_failure_reasons=_failure_reason_names(job),
+                           ports=ports, uris=job.uris))
+            launched += 1
+            if self.heartbeats is not None:
+                self.heartbeats.track(inst.task_id)
+            self.launch_rl.spend("global")
+            self.reservations.pop(uuid, None)
+        for cname, specs in by_cluster.items():
+            self.clusters.get(cname).launch_tasks(pool, specs)
+        # scaleback feedback (scheduler.clj:1002-1036)
+        if head_matched:
+            self._num_considerable[pool] = self.config.max_jobs_considered
+        else:
+            prev = self._num_considerable.get(
+                pool, self.config.max_jobs_considered)
+            self._num_considerable[pool] = max(
+                1, int(prev * self.config.scaleback))
+        # autoscaling hook: O(1) counts + a 64-job size sample from the
+        # host mirrors (the uuid-hash distribution over the full queue
+        # is the legacy path's O(P) version, scheduler.clj:816-826)
+        clusters = self.clusters.all()
+        n_pending = len(rp.pend_row)
+        if clusters and n_pending:
+            import itertools
+            with rp.mirror_lock:
+                sample_rows = list(itertools.islice(
+                    rp.pend_row.values(), 64))
+                sizes = [(float(rp._pend_m["mem"][r]),
+                          float(rp._pend_m["cpus"][r]))
+                         for r in sample_rows]
+            share = n_pending // len(clusters)
+            for ci, cluster in enumerate(clusters):
+                extra = 1 if ci < n_pending % len(clusters) else 0
+                cluster.autoscale(pool, share + extra, pending_sizes=sizes)
+        self.metrics[f"match.{pool}.backend_launch_ms"] = \
+            (time.perf_counter() - t_loop) * 1e3 \
+            - self.metrics[f"match.{pool}.launch_txn_ms"]
+        stats = {"matched": launched, "considerable": n_considerable,
+                 "head_matched": head_matched}
+        rp.stats_last = stats
+        rp.consumed_through = out.cycle_no
+        if rp._inflight and rp._inflight[0] is out:
+            rp._inflight.popleft()
+        self.metrics[f"match.{pool}.matched"] = launched
+        return stats
+
+    # ------------------------------------------------------------------
     # match cycle (scheduler.clj:848-1036)
     def match_cycle(self, pool: Optional[str] = None) -> MatchStats:
         pool = pool or self.pools.default_pool
+        rp = getattr(self, "_resident", {}).get(pool)
+        if rp is not None and rp.enabled:
+            return self._match_cycle_resident(pool, rp)
         t0 = time.perf_counter()
         stats = MatchStats()
         self._purge_reservations()
@@ -1005,6 +1338,9 @@ class Coordinator:
 
     def stop(self) -> None:
         self._stop.set()
+        if hasattr(self, "_consume_q"):
+            self.drain_resident()
+            self._consume_q.put(None)
         for t in self._threads:
             t.join(timeout=2)
         # drain queued status updates before the workers die: a dropped

@@ -222,6 +222,17 @@ class LeaseElector(LeaderElector):
         # same instant); monotonic so a local NTP step can't stretch
         # the asserted freshness. The self-fencing clock (see is_leader).
         self._last_renewed = 0.0
+        # (renewTime string, monotonic when WE first observed it): the
+        # challenger judges expiry by how long the SAME renewTime has
+        # sat unchanged on its OWN monotonic clock — never by comparing
+        # the holder's wall-clock stamp against ours. Cross-host clock
+        # skew therefore cannot defeat the holder's 0.8x self-fencing
+        # margin (client-go's observedTime discipline).
+        self._renew_seen: tuple[str, float] = ("", 0.0)
+        # fencing epoch = leaseTransitions + 1 of OUR acquisition; the
+        # store stamps it into every log entry so replay can drop
+        # zombie appends from a deposed leader's stall window
+        self.epoch = 0
 
     # -- wire ----------------------------------------------------------
     def _path(self) -> str:
@@ -285,6 +296,7 @@ class LeaseElector(LeaderElector):
                     self._lease_body(0, None),
                     headers=self._headers(), timeout=5.0)
                 self._observed = (self.url, time.time())
+                self.epoch = 1
                 return True
             spec = lease.get("spec", {})
             holder = spec.get("holderIdentity", "")
@@ -296,12 +308,18 @@ class LeaseElector(LeaderElector):
                                       self.duration_s))
             expired = not holder        # a cleanly released lease
             if renew and holder:
-                try:
-                    expired = _parse_rfc3339(renew) + duration \
-                        < time.time()
-                except ValueError:
-                    # refuse to steal what we can't evaluate
-                    expired = False
+                # OBSERVER-clock expiry: a renewTime is stale only once
+                # it has sat unchanged for a full duration on OUR
+                # monotonic clock since we first saw it. Parsing the
+                # holder's wall-clock stamp against our wall clock
+                # would let skew > the holder's 0.2x-duration fencing
+                # margin hand the lease to us while the holder still
+                # believes it is fresh.
+                key = f"{holder}|{renew}"
+                if key != self._renew_seen[0]:
+                    self._renew_seen = (key, time.monotonic())
+                expired = (time.monotonic() - self._renew_seen[1]
+                           > duration)
             if holder != self.identity and not expired:
                 return False
             transitions = int(spec.get("leaseTransitions", 0)) + \
@@ -313,6 +331,7 @@ class LeaseElector(LeaderElector):
                     lease.get("metadata", {}).get("resourceVersion")),
                 headers=self._headers(), timeout=5.0)
             self._observed = (self.url, time.time())
+            self.epoch = transitions + 1
             return True
         except urllib.error.HTTPError as e:
             if e.code == 409:      # lost the race

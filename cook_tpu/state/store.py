@@ -55,10 +55,35 @@ class JobStore:
         # runtime-tunable rebalancer params (the reference stores these
         # in Datomic, adjustable live — rebalancer.clj:520-542)
         self.rebalancer_config: dict = {}
+        # pending-by-pool index: pool -> {uuid -> Job} for committed
+        # WAITING jobs, maintained incrementally by _reindex so
+        # pending_jobs() is O(pool pending), not an O(all jobs) scan
+        # per cycle (the reference's get-pending-job-ents walks a
+        # Datomic index the same way, tools.clj:319)
+        self._pending: dict[str, dict[str, Job]] = {}
+        # leader epoch stamped into every log entry (the lease's
+        # leaseTransitions count): replay drops entries from an epoch
+        # older than the newest seen, closing the TOCTOU window where a
+        # stalled deposed leader physically appends after its successor
+        # trimmed + replayed the log. 0 = epochless (single-node dev).
+        self.epoch: int = 0
+        self._replay_max_epoch = 0
         self._log_path = log_path
         self._log = log_writer
         if log_path and log_writer is None:
             self._log = _make_log_writer(log_path)
+
+    def _reindex(self, job: Job) -> None:
+        """Maintain the pending-by-pool index after any mutation that can
+        change (committed, state, pool)."""
+        d = self._pending.setdefault(job.pool, {})
+        if job.committed and job.state == JobState.WAITING:
+            d[job.uuid] = job
+        else:
+            d.pop(job.uuid, None)
+
+    def _deindex(self, job: Job) -> None:
+        self._pending.get(job.pool, {}).pop(job.uuid, None)
 
     # ------------------------------------------------------------------
     # event log plumbing
@@ -74,8 +99,10 @@ class JobStore:
         gate = getattr(self, "append_gate", None)
         if gate is not None and not gate():
             raise NotLeaderError("write fenced: not the leader")
-        self._log.append(json.dumps({"t": now_ms(), "k": kind, **data},
-                                    separators=(",", ":")))
+        ev = {"t": now_ms(), "k": kind, **data}
+        if self.epoch:
+            ev["ep"] = self.epoch
+        self._log.append(json.dumps(ev, separators=(",", ":")))
 
     def _check_writable(self) -> None:
         """Primary write-fencing gate, evaluated at TRANSACTION ENTRY
@@ -145,19 +172,27 @@ class JobStore:
                 job.submit_time_ms = job.submit_time_ms or now_ms()
                 self.jobs[job.uuid] = job
                 self._append("job", _job_event(job))
+                self._reindex(job)
             self._barrier()
+            for job in jobs:
+                self._emit("job", {"obj": job})
             return [j.uuid for j in jobs]
 
     def commit_jobs(self, uuids: Iterable[str]) -> None:
         """Flip the commit latch (metatransaction commit)."""
         with self._lock:
             self._check_writable()
+            flipped = []
             for u in uuids:
                 job = self.jobs[u]
                 if not job.committed:
                     job.committed = True
                     self._append("commit", {"job": u})
+                    self._reindex(job)
+                    flipped.append(job)
             self._barrier()
+            for job in flipped:
+                self._emit("commit", {"obj": job})
 
     def set_rebalancer_config(self, cfg: dict, merge: bool = False) -> None:
         """Durably update the live rebalancer params (the Datomic-stored
@@ -181,9 +216,12 @@ class JobStore:
             dead = [u for u, j in self.jobs.items()
                     if not j.committed and j.submit_time_ms < cutoff]
             for u in dead:
+                self._deindex(self.jobs[u])
                 del self.jobs[u]
                 self._append("gc", {"job": u})
             self._barrier()
+            for u in dead:
+                self._emit("gc", {"job": u})
             return dead
 
     def allowed_to_start(self, job_uuid: str) -> bool:
@@ -210,10 +248,51 @@ class JobStore:
             job.instances.append(inst)
             self.task_to_job[inst.task_id] = job_uuid
             self._update_job_state(job)
+            self._reindex(job)
             self._append("inst", {"job": job_uuid, "task": inst.task_id,
                                   "host": hostname, "backend": backend})
             self._barrier()
+            self._emit("inst", {"obj": job, "inst": inst})
             return inst
+
+    def create_instances_bulk(self, items, origin=None) -> list:
+        """Launch transaction for a whole match cycle in ONE store
+        transaction: items is [(job_uuid, hostname, backend), ...];
+        returns a same-length list of Instance | None (None = the
+        allowed-to-start guard refused that job — it was killed or
+        already launched since matching). One log record, one
+        durability barrier, one listener emission for the batch — the
+        per-cycle writeback cost the reference pays as a single Datomic
+        transact of all task txns (launch-matched-tasks!
+        scheduler.clj:762-777)."""
+        t_ms = now_ms()
+        with self._lock:
+            self._check_writable()
+            out = []
+            created = []
+            log_items = []
+            for job_uuid, hostname, backend in items:
+                if not self.allowed_to_start(job_uuid):
+                    out.append(None)
+                    continue
+                job = self.jobs[job_uuid]
+                inst = Instance(task_id=new_uuid(), job_uuid=job_uuid,
+                                hostname=hostname, backend=backend,
+                                start_time_ms=t_ms)
+                job.instances.append(inst)
+                self.task_to_job[inst.task_id] = job_uuid
+                self._update_job_state(job)
+                self._reindex(job)
+                out.append(inst)
+                created.append((job, inst))
+                log_items.append({"j": job_uuid, "i": inst.task_id,
+                                  "h": hostname, "b": backend})
+            if log_items:
+                self._append("insts", {"items": log_items})
+            self._barrier()
+            if created:
+                self._emit("insts", {"items": created, "origin": origin})
+            return out
 
     def update_instance(self, task_id: str, status: InstanceStatus,
                         reason_code: Optional[int] = None,
@@ -252,13 +331,72 @@ class JobStore:
                 inst.end_time_ms = now_ms()
             was = job.state
             self._update_job_state(job)
+            self._reindex(job)
             self._append("status", {"task": task_id, "s": status.value,
                                     "r": reason_code, "p": preempted,
                                     "e": exit_code})
             self._barrier()
+            self._emit("status", {"obj": job, "inst": inst, "was": was})
             if job.state == JobState.COMPLETED and was != JobState.COMPLETED:
                 self._emit("job-completed", {"job": job_uuid})
             return job
+
+    def update_instances_bulk(self, updates) -> int:
+        """Batched status writeback: updates is [(task_id, status,
+        reason_code), ...] or [(task_id, status, reason_code, extras),
+        ...] where extras may carry exit_code/sandbox/output_url (the
+        sandbox/exit-code publisher data). One lock acquisition, one
+        durability barrier, one listener emission; each update still
+        runs the full transition-enforcing state machine. This is the
+        store half of the sharded in-order status path at scale — a
+        backend that completes thousands of tasks per cycle must not
+        pay a fsync per status."""
+        applied = []
+        t_ms = now_ms()
+        with self._lock:
+            self._check_writable()
+            for item in updates:
+                task_id, status, reason_code = item[:3]
+                extras = item[3] if len(item) > 3 and item[3] else {}
+                job_uuid = self.task_to_job.get(task_id)
+                if job_uuid is None:
+                    continue
+                job = self.jobs[job_uuid]
+                inst = next((i for i in job.instances
+                             if i.task_id == task_id), None)
+                if inst is None or status == inst.status:
+                    continue
+                if status not in VALID_INSTANCE_TRANSITIONS[inst.status]:
+                    continue
+                inst.status = status
+                if reason_code is not None:
+                    inst.reason_code = reason_code
+                    if reason_code in (2000, 2003):
+                        inst.preempted = True
+                exit_code = extras.get("exit_code")
+                if exit_code is not None:
+                    inst.exit_code = exit_code
+                if extras.get("sandbox") is not None:
+                    inst.sandbox_directory = extras["sandbox"]
+                if extras.get("output_url") is not None:
+                    inst.output_url = extras["output_url"]
+                if status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
+                    inst.end_time_ms = t_ms
+                was = job.state
+                self._update_job_state(job)
+                self._reindex(job)
+                self._append("status", {"task": task_id, "s": status.value,
+                                        "r": reason_code, "p": inst.preempted,
+                                        "e": exit_code})
+                applied.append((job, inst, was))
+            self._barrier()
+            if applied:
+                self._emit("statuses", {"items": applied})
+            for job, inst, was in applied:
+                if job.state == JobState.COMPLETED \
+                        and was != JobState.COMPLETED:
+                    self._emit("job-completed", {"job": job.uuid})
+            return len(applied)
 
     def update_progress(self, task_id: str, sequence: int, percent: int,
                         message: str) -> bool:
@@ -295,8 +433,10 @@ class JobStore:
                     and job.retries_remaining() > 0):
                 job.state = JobState.WAITING
                 job.success = None
+            self._reindex(job)
             self._append("retry", {"job": job_uuid, "n": retries})
             self._barrier()
+            self._emit("retry", {"obj": job})
 
     def kill_job(self, job_uuid: str) -> list[str]:
         """Mark a job killed: complete it and return active task ids the
@@ -309,8 +449,10 @@ class JobStore:
             to_kill = [i.task_id for i in job.active_instances]
             job.state = JobState.COMPLETED
             job.success = False
+            self._reindex(job)
             self._append("kill", {"job": job_uuid})
             self._barrier()
+            self._emit("kill", {"obj": job, "to_kill": list(to_kill)})
             self._emit("job-completed", {"job": job_uuid})
             return to_kill
 
@@ -336,9 +478,9 @@ class JobStore:
     # ------------------------------------------------------------------
     # queries (tools.clj:298-582 equivalents)
     def pending_jobs(self, pool: Optional[str] = None) -> list[Job]:
-        return [j for j in self.jobs.values()
-                if j.committed and j.state == JobState.WAITING
-                and (pool is None or j.pool == pool)]
+        if pool is None:
+            return [j for d in self._pending.values() for j in d.values()]
+        return list(self._pending.get(pool, {}).values())
 
     def running_jobs(self, pool: Optional[str] = None) -> list[Job]:
         return [j for j in self.jobs.values()
@@ -415,6 +557,7 @@ class JobStore:
                 store.jobs[u] = job
                 for inst in job.instances:
                     store.task_to_job[inst.task_id] = u
+                store._reindex(job)
             for u, gd in data["groups"].items():
                 store.groups[u] = Group(**gd)
             store.rebalancer_config = dict(
@@ -429,6 +572,7 @@ class JobStore:
         # from the writer's later line count would skip events appended
         # between replay-finish and writer-open
         store._replayed_offset = consumed
+        store._snapshot_path = path
         if log_path:
             store._log_path = log_path
             if open_writer:
@@ -454,6 +598,8 @@ class JobStore:
             self.groups = fresh.groups
             self.task_to_job = fresh.task_to_job
             self.rebalancer_config = fresh.rebalancer_config
+            self._pending = fresh._pending
+            self._replay_max_epoch = fresh._replay_max_epoch
             self._log = fresh._log
         if old_log is not None:
             try:
@@ -529,9 +675,28 @@ class JobStore:
                 state["f"] = f
             f = state["f"]
             if os.path.getsize(path) < f.tell():
-                # file shrank below our consumed boundary: full resync
+                # file shrank below our consumed boundary: the log was
+                # genuinely truncated or rotated (beyond the benign
+                # torn-tail fragment, which we never consume). Line
+                # numbering no longer matches — resuming by count would
+                # silently skip or mis-apply events — so REBUILD the
+                # whole in-memory state from snapshot + log and swap it
+                # in, like reload_from.
+                log.warning("log follower: %s shrank below consumed "
+                            "offset; full state resync", path)
                 f.close()
                 state["f"] = None
+                fresh = JobStore.restore(
+                    getattr(self, "_snapshot_path", None),
+                    log_path=path, trim_tail=False, open_writer=False)
+                with self._lock:
+                    self.jobs = fresh.jobs
+                    self.groups = fresh.groups
+                    self.task_to_job = fresh.task_to_job
+                    self.rebalancer_config = fresh.rebalancer_config
+                    self._pending = fresh._pending
+                    self._replay_max_epoch = fresh._replay_max_epoch
+                state["applied"] = fresh._replayed_offset
                 return
             start = f.tell()
             chunk = f.read()
@@ -581,12 +746,25 @@ class JobStore:
 
     def _apply_event(self, ev: dict) -> None:
         k = ev["k"]
+        # epoch fencing on replay: an entry stamped with a leader epoch
+        # older than the newest epoch already seen was written by a
+        # deposed leader that stalled past the fence check — drop it
+        # (the live successor's entries carry the higher epoch).
+        ep = ev.get("ep", 0)
+        if ep:
+            if ep < self._replay_max_epoch:
+                log.warning("replay: dropping stale-epoch event "
+                            "(ep=%d < %d): %s", ep,
+                            self._replay_max_epoch, ev.get("k"))
+                return
+            self._replay_max_epoch = ep
         if k == "job":
             job = _job_from_dict(ev["job"])
             if job.uuid not in self.jobs:
                 self.jobs[job.uuid] = job
                 for inst in job.instances:
                     self.task_to_job[inst.task_id] = job.uuid
+                self._reindex(job)
         elif k == "group":
             g = Group(**ev["group"])
             if g.uuid not in self.groups:
@@ -595,8 +773,11 @@ class JobStore:
             job = self.jobs.get(ev["job"])
             if job:
                 job.committed = True
+                self._reindex(job)
         elif k == "gc":
-            self.jobs.pop(ev["job"], None)
+            job = self.jobs.pop(ev["job"], None)
+            if job is not None:
+                self._deindex(job)
         elif k == "rebalancer_config":
             self.rebalancer_config = dict(ev.get("cfg", {}))
         elif k == "inst":
@@ -608,6 +789,19 @@ class JobStore:
                 job.instances.append(inst)
                 self.task_to_job[inst.task_id] = job.uuid
                 self._update_job_state(job)
+                self._reindex(job)
+        elif k == "insts":
+            for it in ev.get("items", []):
+                job = self.jobs.get(it["j"])
+                if job and not any(i.task_id == it["i"]
+                                   for i in job.instances):
+                    inst = Instance(task_id=it["i"], job_uuid=it["j"],
+                                    hostname=it["h"], backend=it["b"],
+                                    start_time_ms=ev.get("t", 0))
+                    job.instances.append(inst)
+                    self.task_to_job[inst.task_id] = job.uuid
+                    self._update_job_state(job)
+                    self._reindex(job)
         elif k == "status":
             self.update_instance(ev["task"], InstanceStatus(ev["s"]),
                                  reason_code=ev.get("r"),
@@ -627,11 +821,28 @@ def _job_event(job: Job) -> dict:
     return {"job": d}
 
 
+_JOB_FIELDS = None
+_INST_FIELDS = None
+
+
 def _job_dict(job: Job) -> dict:
-    d = asdict(job)
+    """Shallow field walk instead of dataclasses.asdict: asdict deep-
+    copies recursively (~100 us/job) and dominates the submission path
+    at scale; the log line is serialized under the store lock anyway, so
+    references are safe."""
+    global _JOB_FIELDS, _INST_FIELDS
+    if _JOB_FIELDS is None:
+        import dataclasses
+        _JOB_FIELDS = tuple(f.name for f in dataclasses.fields(Job))
+        _INST_FIELDS = tuple(f.name for f in dataclasses.fields(Instance))
+    jd = job.__dict__
+    d = {k: jd[k] for k in _JOB_FIELDS}
     d["state"] = job.state.value
-    for i, inst in enumerate(d["instances"]):
-        inst["status"] = job.instances[i].status.value
+    d["instances"] = [
+        {**{k: i.__dict__[k] for k in _INST_FIELDS},
+         "status": i.status.value}
+        for i in job.instances
+    ]
     return d
 
 
