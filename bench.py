@@ -551,6 +551,57 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
     }))
 
 
+def bench_pallas():
+    """Real-TPU A/B of the Pallas kernels vs the XLA lowering (VERDICT
+    r2 #2: prove a win or drop it): the batched headline cycle (dense
+    best_host + fused exact head) and the sequential production shape
+    (C=1024 exact_scan). Reports both so docs/benchmarks.md carries
+    measured evidence for the use_pallas default."""
+    import functools
+
+    import jax
+    from cook_tpu.ops import cycle as cycle_ops
+
+    args, dev = _cycle_setup(10_000, 100_000, 10_000, 500)
+    out = {}
+
+    def timed(fn):
+        o = fn(*args)
+        matched = int((np.asarray(o.job_host) >= 0).sum())
+
+        def batch(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                o = fn(*args)
+            np.asarray(o.job_host)
+            return time.perf_counter() - t0
+
+        ms = []
+        for _ in range(6):
+            t1, t2 = batch(5), batch(10)
+            ms.append(max(t2 - t1, 0) / 5 * 1e3)
+        return round(float(np.median(ms)), 2), matched
+
+    for seq, C, tag in ((False, 8_192, "batched8k"), (True, 1_024, "seq1k")):
+        for up in (False, True):
+            fn = functools.partial(cycle_ops.rank_and_match,
+                                   num_considerable=C, sequential=seq,
+                                   use_pallas=up)
+            ms, matched = timed(fn)
+            out[f"{tag}_{'pallas' if up else 'xla'}_ms"] = ms
+    speedup = out["batched8k_xla_ms"] / out["batched8k_pallas_ms"]
+    print(json.dumps({
+        "metric": "pallas vs xla cycle time, batched 8k x 10k",
+        "value": out["batched8k_pallas_ms"],
+        "unit": "ms/cycle",
+        "vs_baseline": round(speedup, 3),
+        "baseline_note": "ratio vs the XLA lowering of the same cycle "
+                         "(>1 = pallas faster)",
+        **out,
+        "device": str(dev),
+    }))
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "headline"
     if which == "headline":
@@ -569,9 +620,12 @@ def main():
     elif which == "e2e-small":
         bench_e2e(P0=20_000, H=2_000, cycles=60, warmup=10,
                   label="e2e coordinator @ 20k-pending x 2k-offers")
+    elif which == "pallas":
+        bench_pallas()
     else:
         raise SystemExit(f"unknown config {which!r}; one of: headline "
-                         "small pools rebalance stream e2e e2e-small")
+                         "small pools rebalance stream e2e e2e-small "
+                         "pallas")
 
 
 if __name__ == "__main__":

@@ -269,6 +269,9 @@ def pod_from_json(obj: dict) -> Pod:
             sport = next((p.get("containerPort")
                           for p in c.get("ports") or []), 28501)
             sidecar = {"image": c.get("image", ""), "port": sport}
+            scmd = c.get("command") or []
+            if len(scmd) >= 3 and scmd[:2] == ["/bin/sh", "-c"]:
+                sidecar["command"] = scmd[2]
     init_uris = []
     for ic in spec.get("initContainers") or []:
         cmd = ic.get("command") or []

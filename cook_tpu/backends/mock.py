@@ -109,7 +109,11 @@ class MockCluster(ComputeCluster):
                 if (um + spec.mem > host.mem + 1e-6
                         or uc + spec.cpus > host.cpus + 1e-6
                         or ug + spec.gpus > host.gpus + 1e-6):
-                    # oversubscription = launch failure
+                    # oversubscription = launch failure; any ports
+                    # reserved for this task must come back (only a
+                    # STARTED task's _release returns them otherwise)
+                    self.used_ports.get(spec.hostname,
+                                        set()).difference_update(spec.ports)
                     batch.append((spec.task_id, InstanceStatus.FAILED,
                                   99000))
                     continue

@@ -84,26 +84,84 @@ class Federation:
 
 
 # ---------------------------------------------------------------------------
-def cmd_submit(fed: Federation, args) -> int:
+def parse_raw_job_spec(raw_text: str, template: dict) -> list[dict]:
+    """Raw-JSON job import (subcommands/submit.py parse_raw_job_spec):
+    the input is one job object, a list of job objects, or
+    {"jobs": [...]}; each is merged OVER the flag-built template (raw
+    keys win), so `cs submit --raw --pool x < jobs.json` sets defaults
+    the raw specs may override."""
+    data = json.loads(raw_text)
+    if isinstance(data, dict) and "jobs" in data:
+        specs = data["jobs"]
+    elif isinstance(data, dict):
+        specs = [data]
+    elif isinstance(data, list):
+        specs = data
+    else:
+        raise SystemExit("--raw input must be a job object, a list of "
+                         "jobs, or {\"jobs\": [...]}")
+    out = []
+    for spec in specs:
+        if not isinstance(spec, dict):
+            raise SystemExit("--raw jobs must be JSON objects")
+        merged = {**template, **spec}
+        if not merged.get("command"):
+            raise SystemExit("raw job spec missing 'command'")
+        out.append(merged)
+    return out
+
+
+def cmd_submit(fed: Federation, args, plugins=None) -> int:
     command = " ".join(args.command)
-    if not command and not sys.stdin.isatty():
-        command = sys.stdin.read().strip()
-    if not command:
-        print("no command given", file=sys.stderr)
-        return 1
-    kw = {}
+    stdin_text = None
+    # touch stdin only when it is actually the input source
+    needs_stdin = (args.raw == "-") or (not command and not args.raw)
+    if needs_stdin:
+        try:
+            if not sys.stdin.isatty():
+                stdin_text = sys.stdin.read().strip()
+        except OSError:
+            stdin_text = None
+    template = {"mem": args.mem, "cpus": args.cpus, "gpus": args.gpus,
+                "max_retries": args.max_retries}
+    for k, v in (("name", args.name), ("priority", args.priority)):
+        if v is not None:
+            template[k] = v
     if args.env:
-        kw["env"] = dict(kv.split("=", 1) for kv in args.env)
+        template["env"] = dict(kv.split("=", 1) for kv in args.env)
     if args.label:
-        kw["labels"] = dict(kv.split("=", 1) for kv in args.label)
+        template["labels"] = dict(kv.split("=", 1) for kv in args.label)
     if args.constraint:
-        kw["constraints"] = [c.split("=", 1)[0:1] + ["EQUALS"] +
-                             c.split("=", 1)[1:] for c in args.constraint]
-    uuid = fed.default.submit(
-        command=command, mem=args.mem, cpus=args.cpus, gpus=args.gpus,
-        name=args.name, priority=args.priority, max_retries=args.max_retries,
-        pool=args.pool, **kw)
-    print(uuid)
+        template["constraints"] = [c.split("=", 1)[0:1] + ["EQUALS"] +
+                                   c.split("=", 1)[1:]
+                                   for c in args.constraint]
+    if args.raw:
+        if args.raw == "-":
+            raw_text = stdin_text
+            if not raw_text:
+                raise SystemExit("--raw: no JSON on stdin (pipe a job "
+                                 "spec or pass --raw FILE)")
+        else:
+            try:
+                with open(args.raw) as f:
+                    raw_text = f.read()
+            except OSError as e:
+                raise SystemExit(f"--raw: cannot read {args.raw}: {e}")
+        try:
+            specs = parse_raw_job_spec(raw_text, template)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"--raw: malformed JSON: {e}")
+    else:
+        command = command or stdin_text or ""
+        if not command:
+            print("no command given", file=sys.stderr)
+            return 1
+        specs = [{**template, "command": command}]
+    if plugins is not None:
+        specs = [plugins.preprocess_job(s) for s in specs]
+    uuids = fed.default.submit_jobs(specs, pool=args.pool)
+    for u in uuids:
+        print(u)
     return 0
 
 
@@ -358,6 +416,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--env", action="append", metavar="K=V")
     s.add_argument("--label", action="append", metavar="K=V")
     s.add_argument("--constraint", action="append", metavar="ATTR=VAL")
+    s.add_argument("--raw", nargs="?", const="-", default=None,
+                   metavar="FILE",
+                   help="submit raw JSON job spec(s) from FILE (or "
+                        "stdin); flags become defaults the raw keys "
+                        "override")
 
     s = sub.add_parser("show", help="show jobs")
     s.add_argument("uuid", nargs="+")
@@ -411,23 +474,45 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
-    cfg = load_config(args.config)
+    from cook_tpu.cli.metrics import CliMetrics
+    from cook_tpu.cli.plugins import load_plugins
+
+    # config must load before parsing so plugin subcommands can extend
+    # the parser (SubCommandPlugin registration)
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default=None)
+    pre_args, _ = pre.parse_known_args(argv)
+    cfg = load_config(pre_args.config)
+    plugins = load_plugins(cfg)
+    parser = build_parser()
+    sub = next(a for a in parser._actions
+               if isinstance(a, argparse._SubParsersAction))
+    plugins.wire_parsers(sub)
+    args = parser.parse_args(argv)
+    metrics = CliMetrics(cfg, user=args.user or os.environ.get("USER", ""))
+    metrics.start(args.cmd)
     if args.cmd == "config":
-        args.config = args.config
-        return cmd_config(cfg, args)
+        status = cmd_config(cfg, args)
+        metrics.finish(status)
+        return status
     fed = Federation(cfg, url=args.url, user=args.user)
-    handler = {
+    plugin_cmd = plugins.subcommand(args.cmd)
+    handler = plugin_cmd or {
         "submit": cmd_submit, "show": cmd_show, "wait": cmd_wait,
         "jobs": cmd_jobs, "kill": cmd_kill, "retry": cmd_retry,
         "why": cmd_why, "usage": cmd_usage, "ls": cmd_ls, "cat": cmd_cat,
         "tail": cmd_tail, "ssh": cmd_ssh,
     }[args.cmd]
     try:
-        return handler(fed, args)
+        if handler is cmd_submit:
+            status = cmd_submit(fed, args, plugins=plugins)
+        else:
+            status = handler(fed, args)
     except JobClientError as e:
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        status = 1
+    metrics.finish(status)
+    return status
 
 
 if __name__ == "__main__":

@@ -80,13 +80,18 @@ class AuthSettings:
     imposters: list = field(default_factory=list)
     authorization: str = "configfile-admins-auth"
     cors_origins: list = field(default_factory=list)
-    # shared secret for the /agents machine channel; REQUIRED when the
-    # scheme provides real user auth (basic/header)
+    # shared secret for the /agents machine channel; REQUIRED whenever
+    # an agent cluster is configured unless dev_mode is set (see
+    # Settings.validate). agent_token_previous is accepted during a
+    # rotation window.
     agent_token: str = ""
+    agent_token_previous: str = ""
 
     def validate(self) -> None:
         if self.scheme not in ("one-user", "basic", "header"):
             raise ConfigError(f"unknown auth scheme {self.scheme!r}")
+        if self.agent_token_previous and not self.agent_token:
+            raise ConfigError("agent_token_previous without agent_token")
 
 
 @dataclass
@@ -127,6 +132,9 @@ class TaskConstraintSettings:
 @dataclass
 class Settings:
     port: int = 12321
+    # dev_mode relaxes production-safety validation (open agent
+    # channel); never set it in a real deployment
+    dev_mode: bool = False
     default_pool: str = "default"
     pools: list = field(default_factory=list)          # [PoolSettings]
     clusters: list = field(default_factory=lambda: [ClusterSettings()])
@@ -206,6 +214,15 @@ class Settings:
             c.validate()
         self.scheduler.validate()
         self.auth.validate()
+        # a write-capable machine channel must not default open: an
+        # agent cluster without an agent token is only a dev setup
+        if any(c.kind == "agent" for c in self.clusters) \
+                and not self.auth.agent_token and not self.dev_mode:
+            raise ConfigError(
+                "an 'agent' cluster requires auth.agent_token (or an "
+                "explicit dev_mode: true for local development) — an "
+                "open agent registration channel accepts task statuses "
+                "from anyone")
         for key in self.rate_limits:
             if key not in ("user_submit", "user_launch", "global_launch"):
                 raise ConfigError(f"unknown rate limit {key!r}")

@@ -157,6 +157,20 @@ class JsonParser {
  private:
   const std::string& s_;
   size_t pos_ = 0;
+  int depth_ = 0;
+  // recursion guard: value() recurses per nesting level, so untrusted
+  // input like 100k '[' would otherwise overflow the native stack and
+  // crash the embedding process (this parser is an exported fuzz
+  // surface via cook_json_roundtrip and parses server responses)
+  static constexpr int kMaxDepth = 512;
+
+  struct DepthGuard {
+    JsonParser* p;
+    explicit DepthGuard(JsonParser* parser) : p(parser) {
+      if (++p->depth_ > kMaxDepth) p->fail("too deeply nested");
+    }
+    ~DepthGuard() { --p->depth_; }
+  };
 
   [[noreturn]] void fail(const char* msg) {
     throw std::runtime_error(std::string("json: ") + msg + " at offset " +
@@ -176,6 +190,7 @@ class JsonParser {
     return false;
   }
   Json value() {
+    DepthGuard guard(this);
     ws();
     char c = peek();
     if (c == '{') return object();

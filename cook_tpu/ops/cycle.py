@@ -205,7 +205,8 @@ def rank_and_match(
     bonusc = None if bonus is None else bonus[pend_idx] * in_use[:, None]
     if sequential:
         res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups,
-                                   bonus=bonusc)
+                                   bonus=bonusc,
+                                   use_pallas=use_pallas and bonus is None)
     else:
         kw = {"rounds": 4, **dict(match_kw or ())}
         res = match_ops.match_rounds(jobs, hosts, forb,

@@ -172,10 +172,160 @@ def _scan_assign(jobs: Jobs, hosts: Hosts, forbidden, bonus,
     return jax.lax.scan(step, carry, xs)
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups",))
+def _scan_assign_candidates(jobs: Jobs, hosts: Hosts, forbidden, bonus,
+                            num_groups: int, carry, K: int = 32):
+    """Exact sequential greedy with candidate compression: identical
+    results to _scan_assign at O(K + steps) per step instead of O(H).
+
+    Precompute each job's top-K hosts by fitness against the INITIAL
+    capacities (restricted to initially-feasible, allowed hosts). The
+    scan then evaluates, per step, only (a) the job's K candidates and
+    (b) the hosts modified by earlier steps (each step depletes at most
+    one host — the chosen one — which is also the only host whose
+    group-occupancy can change).
+
+    Exactness: capacity only shrinks during a cycle, so an UNMODIFIED
+    host's feasibility and fitness equal their precomputed values. If a
+    job still has at least one unmodified initially-feasible candidate
+    c, then c dominates every unmodified non-candidate (top-K order,
+    and lax.top_k's stable tie order matches argmax's lowest-index
+    tie-break), so argmax over {candidates} ∪ {modified hosts} equals
+    the full argmax. If ALL of a job's initially-feasible candidates
+    have been modified, that guarantee lapses and the step falls back
+    to the full O(H) argmax (rare: it needs K prior placements to have
+    landed exactly on one job's candidate list).
+    """
+    H = hosts.mem.shape[0]
+    S = jobs.mem.shape[0]
+    mem0, cpus0, gpus0, slots0, occ0 = carry
+    gclip_all = jnp.clip(jobs.group, 0, num_groups - 1)
+    ok0 = _feasible(jobs.mem[:, None], jobs.cpus[:, None],
+                    jobs.gpus[:, None], mem0[None, :], cpus0[None, :],
+                    gpus0[None, :], hosts.cap_gpus[None, :],
+                    hosts.valid[None, :], slots0[None, :], forbidden)
+    ok0 &= ~(jobs.unique_group[:, None] & occ0[gclip_all])
+    fit0 = _fitness(jobs.mem[:, None], jobs.cpus[:, None], mem0[None, :],
+                    cpus0[None, :], hosts.cap_mem[None, :],
+                    hosts.cap_cpus[None, :]) + bonus
+    fit0 = jnp.where(ok0, fit0, -1.0)
+    cand_fit, cands = jax.lax.top_k(fit0, K)          # (S, K)
+    cand_ok = cand_fit > -0.5
+
+    dirty0 = varying_full(hosts.valid, False, (H,), bool)
+    chosen0 = varying_full(jobs.valid, jnp.int32(H), (S,), jnp.int32)
+    i0 = jnp.zeros((), jnp.int32) + (jobs.mem[0] * 0).astype(jnp.int32)
+
+    def step(scarry, xs):
+        (mem_left, cpus_left, gpus_left, slots_left, group_occ,
+         dirty, chosen, i) = scarry
+        (j_mem, j_cpus, j_gpus, j_valid, j_group, j_unique, forb, bon,
+         cands_i, cand_ok_i) = xs
+        g = jnp.clip(j_group, 0, num_groups - 1)
+
+        idx = jnp.concatenate([cands_i, chosen])       # (K + S,)
+        slot_live = jnp.concatenate(
+            [cand_ok_i, chosen < H])                   # padded slots out
+        idxc = jnp.clip(idx, 0, H - 1)
+        ok = _feasible(j_mem, j_cpus, j_gpus, mem_left[idxc],
+                       cpus_left[idxc], gpus_left[idxc],
+                       hosts.cap_gpus[idxc], hosts.valid[idxc],
+                       slots_left[idxc], forb[idxc])
+        ok &= ~(j_unique & group_occ[g, idxc])
+        ok &= slot_live & j_valid
+        fit = _fitness(j_mem, j_cpus, mem_left[idxc], cpus_left[idxc],
+                       hosts.cap_mem[idxc], hosts.cap_cpus[idxc]) \
+            + bon[idxc]
+        fit = jnp.where(ok, fit, -1.0)
+        m = jnp.max(fit)
+        # argmax tie-break parity: full argmax returns the LOWEST host
+        # index among equal maxima
+        best_cand = jnp.min(jnp.where(fit >= m, idxc, H))
+        assigned_cand = m > -0.5
+
+        need_full = (jnp.any(cand_ok_i)
+                     & ~jnp.any(cand_ok_i & ~dirty[jnp.clip(cands_i, 0,
+                                                            H - 1)])
+                     & j_valid)
+
+        def full_step(_):
+            okf = _feasible(j_mem, j_cpus, j_gpus, mem_left, cpus_left,
+                            gpus_left, hosts.cap_gpus, hosts.valid,
+                            slots_left, forb)
+            okf &= ~(j_unique & group_occ[g])
+            okf &= j_valid
+            fitf = jnp.where(okf, _fitness(j_mem, j_cpus, mem_left,
+                                           cpus_left, hosts.cap_mem,
+                                           hosts.cap_cpus) + bon, -1.0)
+            b = jnp.argmax(fitf).astype(jnp.int32)
+            return b, fitf[b] > -0.5
+
+        def cand_step(_):
+            return best_cand.astype(jnp.int32), assigned_cand
+
+        best, assigned = jax.lax.cond(need_full, full_step, cand_step,
+                                      None)
+        host = jnp.where(assigned, best, NO_HOST)
+        bc = jnp.clip(best, 0, H - 1)
+        take = jnp.where(assigned, 1.0, 0.0)
+        mem_left = mem_left.at[bc].add(-take * j_mem)
+        cpus_left = cpus_left.at[bc].add(-take * j_cpus)
+        gpus_left = gpus_left.at[bc].add(-take * j_gpus)
+        slots_left = slots_left.at[bc].add(
+            -jnp.where(assigned, 1, 0).astype(jnp.int32))
+        group_occ = group_occ.at[g, bc].set(
+            group_occ[g, bc] | (assigned & j_unique))
+        dirty = dirty.at[bc].set(dirty[bc] | assigned)
+        chosen = chosen.at[i].set(jnp.where(assigned, best, H))
+        return (mem_left, cpus_left, gpus_left, slots_left, group_occ,
+                dirty, chosen, i + 1), host
+
+    xs = (jobs.mem, jobs.cpus, jobs.gpus, jobs.valid, jobs.group,
+          jobs.unique_group, forbidden, bonus, cands, cand_ok)
+    (mem_left, cpus_left, gpus_left, slots_left, group_occ, _, _, _), \
+        job_host = jax.lax.scan(
+            step, (mem0, cpus0, gpus0, slots0, occ0, dirty0, chosen0,
+                   i0), xs)
+    return (mem_left, cpus_left, gpus_left, slots_left, group_occ), \
+        job_host
+
+
+def _scan_core(jobs: Jobs, hosts: Hosts, forbidden, bonus,
+               num_groups: int, carry, use_pallas: bool = False,
+               bonus_zero: bool = False):
+    """Exact sequential greedy (the Fenzo walk). On TPU with
+    single-group coupling and no fitness bonus, the whole scan runs as
+    ONE fused Pallas kernel with host state resident in VMEM
+    (pallas_match.exact_scan) — identical semantics, ~5-10x cheaper per
+    step than the XLA while-loop lowering. Everything else takes the
+    XLA scan. (A gather-based candidate compression,
+    _scan_assign_candidates, is also exact but lowers poorly on TPU —
+    kept for its tests and non-TPU backends; see docs/benchmarks.md.)
+    """
+    if use_pallas and bonus_zero:
+        from cook_tpu.ops import pallas_match as pm
+        S = jobs.mem.shape[0]
+        H = hosts.mem.shape[0]
+        if pm.exact_scan_ok(S, H, num_groups):
+            mem0, cpus0, gpus0, slots0, occ = carry
+            jp = pm.pack_jobs(jobs.mem, jobs.cpus, jobs.gpus, jobs.valid,
+                              jobs.unique_group)
+            hp = pm.pack_hosts(mem0, cpus0, gpus0, hosts.cap_mem,
+                               hosts.cap_cpus, hosts.cap_gpus, slots0,
+                               hosts.valid, occ[0])
+            jh, hout = pm.exact_scan(jp, hp, forbidden.astype(jnp.uint8))
+            new_carry = (hout[pm.H_MEM], hout[pm.H_CPUS],
+                         hout[pm.H_GPUS],
+                         hout[pm.H_SLOTS].astype(jnp.int32),
+                         hout[pm.H_OCC0:pm.H_OCC0 + 1] > 0)
+            return new_carry, jh
+    return _scan_assign(jobs, hosts, forbidden, bonus, num_groups, carry)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "use_pallas"))
 def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                num_groups: int = 1,
-               bonus: jnp.ndarray | None = None) -> MatchResult:
+               bonus: jnp.ndarray | None = None,
+               use_pallas: bool = False) -> MatchResult:
     """Exact sequential greedy assignment (Fenzo semantics) as one scan.
 
     forbidden: (N, H) bool — per-(job, host) hard-constraint exclusions
@@ -183,14 +333,18 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     num_groups: static upper bound on dense group ids in this batch.
     bonus: optional (N, H) f32 >= 0 additive fitness term (the
     data-locality fitness blend, data_locality.clj:192).
+    use_pallas: route through the fused VMEM-resident scan kernel when
+    eligible (TPU, num_groups == 1, no bonus).
     """
     group_occ = varying_full(hosts.valid, False,
                              (num_groups, hosts.mem.shape[0]), bool)
+    bonus_zero = bonus is None
     if bonus is None:
         bonus = varying_full(hosts.valid, 0.0, forbidden.shape, jnp.float32)
     carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots, group_occ)
-    (mem_left, cpus_left, gpus_left, slots_left, _), job_host = _scan_assign(
-        jobs, hosts, forbidden, bonus, num_groups, carry)
+    (mem_left, cpus_left, gpus_left, slots_left, _), job_host = _scan_core(
+        jobs, hosts, forbidden, bonus, num_groups, carry,
+        use_pallas=use_pallas, bonus_zero=bonus_zero)
     return MatchResult(job_host, mem_left, cpus_left, gpus_left, slots_left)
 
 
@@ -219,6 +373,18 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     refused is provably unservable this cycle (capacity only shrinks)
     and is excluded from every window.
 
+    head_exact sizing: the head scan is the dominant serial cost of the
+    batched cycle (~40 us/step at 10k hosts — latency-bound on the
+    per-step global argmax reduction, so neither the fused Pallas scan
+    nor the gather-based candidate compression beats it materially; see
+    docs/benchmarks.md §head-scan). The contended fairness-at-scale
+    tests show the window rounds alone do NOT keep positions 128-255
+    clean — the 256-head is load-bearing and stays the default. The
+    production coordinator runs an audit-gated adaptive controller
+    that shrinks the head only while the sampled head-window inversion
+    audit stays clean, and grows it back the moment an inversion
+    appears (coordinator AdaptiveHead).
+
     Group-unique coupling is approximated by letting at most the
     first-ranked member of each (group, host) pair through per round.
     Converges to sequential greedy when conflicts are sparse; every
@@ -234,9 +400,12 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     H = hosts.mem.shape[0]
     rank = jnp.arange(N)
     BIG = jnp.float32(3.4e38)
-    # pallas path needs block-divisible shapes with full lane tiles (the
-    # coordinator's bucket() padding guarantees this; arbitrary direct
-    # callers fall back to XLA instead of silently truncating)
+    # fused exact head (pallas_match.exact_scan) has its own gate
+    pallas_head = use_pallas and num_groups == 1 and bonus is None
+    # dense-round pallas path needs block-divisible shapes with full
+    # lane tiles (the coordinator's bucket() padding guarantees this;
+    # arbitrary direct callers fall back to XLA instead of silently
+    # truncating)
     use_pallas = (use_pallas and num_groups == 1 and N >= 8 and H >= 128
                   and N % 8 == 0 and N % min(256, N) == 0 and H % 128 == 0
                   and H % min(1024, H) == 0)
@@ -484,9 +653,9 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                          unique_group=jobs.unique_group[:S])
         head_bonus = (bonus[:S] if bonus is not None else
                       varying_full(hosts.valid, 0.0, (S, H), jnp.float32))
-        carry, head_hosts = _scan_assign(
+        carry, head_hosts = _scan_core(
             head_jobs, hosts, forbidden[:S], head_bonus, num_groups,
-            state[1:])
+            state[1:], use_pallas=pallas_head, bonus_zero=bonus is None)
         job_host0 = jnp.concatenate(
             [head_hosts, varying_full(jobs.valid, NO_HOST, (N - S,),
                                       jnp.int32)])

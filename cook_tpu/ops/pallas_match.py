@@ -163,6 +163,125 @@ def _kernel_bonus(jobs_ref, hosts_ref, forb_ref, bonus_ref, fit_ref,
                 fit_ref, idx_ref)
 
 
+def _exact_scan_kernel(jobs_ref, hosts_ref, forb_ref, out_ref,
+                       hosts_out_ref, *, steps, width):
+    """Whole sequential-greedy scan in ONE kernel invocation: host
+    state lives in registers/VMEM across all `steps` iterations, so the
+    per-step cost is pure vector work — none of the HLO-level
+    while-loop overhead that makes the XLA scan ~40 us/step.
+
+    Layout: each host field arrives as a FULLY-PACKED (8, H/8) tile
+    (row-major reshape of the (H,) vector) — a (1, H) row would waste
+    7/8 of every vector register's sublanes and erase the win. The
+    global host index of element (r, c) is r*width + c.
+    Semantics identical to ops.match._scan_assign for num_groups == 1."""
+    W = width
+    idx2 = (jax.lax.broadcasted_iota(jnp.int32, (8, W), 0) * W
+            + jax.lax.broadcasted_iota(jnp.int32, (8, W), 1))
+
+    def field(ref, r):
+        return ref[r * 8:(r + 1) * 8, :]
+
+    cap_mem = field(hosts_ref, H_CAP_MEM)
+    cap_cpus = field(hosts_ref, H_CAP_CPUS)
+    cap_gpus = field(hosts_ref, H_CAP_GPUS)
+    hvalid = field(hosts_ref, H_VALID)
+    is_gpu = (cap_gpus > 0).astype(jnp.float32)
+    inv_cm = jnp.where(cap_mem > 0, 1.0 / cap_mem, 0.0)
+    inv_cc = jnp.where(cap_cpus > 0, 1.0 / cap_cpus, 0.0)
+    base_ok = (hvalid > 0).astype(jnp.float32)
+
+    def body(i, carry):
+        mem_left, cpus_left, gpus_left, slots, occ0 = carry
+        row = jobs_ref[pl.dslice(i, 1), :]                       # (1, 8)
+        jm = row[0:1, J_MEM:J_MEM + 1]
+        jc = row[0:1, J_CPUS:J_CPUS + 1]
+        jg = row[0:1, J_GPUS:J_GPUS + 1]
+        ja = row[0:1, J_ACTIVE:J_ACTIVE + 1]
+        ju = row[0:1, J_UNIQUE:J_UNIQUE + 1]
+        forb_row = forb_ref[pl.dslice(i * 8, 8), :]              # (8, W)
+
+        ok = base_ok * (slots > 0).astype(jnp.float32)
+        ok *= (forb_row.astype(jnp.int32) == 0).astype(jnp.float32)
+        ok *= ((mem_left + EPS >= jm) & (cpus_left + EPS >= jc)).astype(
+            jnp.float32)
+        gpu_fits = (gpus_left + EPS >= jg).astype(jnp.float32) * is_gpu
+        ok *= jnp.where(jg > 0, gpu_fits, 1.0 - is_gpu)
+        ok *= 1.0 - (ju > 0).astype(jnp.float32) * (occ0 > 0).astype(
+            jnp.float32)
+        ok *= (ja > 0).astype(jnp.float32)
+
+        fit = 0.5 * ((cap_mem - mem_left + jm) * inv_cm
+                     + (cap_cpus - cpus_left + jc) * inv_cc)
+        fit = jnp.where(ok > 0, fit, -1.0)
+        m = jnp.max(fit)
+        best = jnp.min(jnp.where(fit >= m, idx2, BIG_I))
+        assigned = (m > -0.5).astype(jnp.float32)
+        sel = (idx2 == best).astype(jnp.float32) * assigned      # (8, W)
+        mem_left = mem_left - sel * jm
+        cpus_left = cpus_left - sel * jc
+        gpus_left = gpus_left - sel * jg
+        slots = slots - sel
+        occ0 = jnp.maximum(occ0, sel * (ju > 0).astype(jnp.float32))
+        host_val = jnp.where(m > -0.5, best, jnp.int32(NO_HOST))
+        out_ref[pl.dslice(i, 1), :] = jnp.reshape(host_val, (1, 1))
+        return (mem_left, cpus_left, gpus_left, slots, occ0)
+
+    carry0 = (field(hosts_ref, H_MEM), field(hosts_ref, H_CPUS),
+              field(hosts_ref, H_GPUS), field(hosts_ref, H_SLOTS),
+              field(hosts_ref, H_OCC0))
+    mem_left, cpus_left, gpus_left, slots, occ0 = jax.lax.fori_loop(
+        0, steps, body, carry0)
+    hosts_out_ref[:, :] = hosts_ref[:, :]
+    hosts_out_ref[H_MEM * 8:(H_MEM + 1) * 8, :] = mem_left
+    hosts_out_ref[H_CPUS * 8:(H_CPUS + 1) * 8, :] = cpus_left
+    hosts_out_ref[H_GPUS * 8:(H_GPUS + 1) * 8, :] = gpus_left
+    hosts_out_ref[H_SLOTS * 8:(H_SLOTS + 1) * 8, :] = slots
+    hosts_out_ref[H_OCC0 * 8:(H_OCC0 + 1) * 8, :] = occ0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def exact_scan(jobs_packed: jnp.ndarray, hosts_packed: jnp.ndarray,
+               forbidden_u8: jnp.ndarray, interpret: bool = False):
+    """Fused sequential-greedy assignment (the Fenzo walk) for
+    num_groups == 1. jobs_packed: (S, 8) f32; hosts_packed: (16, H)
+    f32; forbidden_u8: (S, H). Returns (job_host (S,) i32,
+    hosts_out (16, H) f32 — the depleted host stack incl. occ0)."""
+    S = jobs_packed.shape[0]
+    H = hosts_packed.shape[1]
+    if H % 1024:
+        raise ValueError(f"H must be a multiple of 1024 (8 sublanes x "
+                         f"128 lanes; got {H})")
+    W = H // 8
+    # fully-packed field tiles: (16, H) -> (128, W), (S, H) -> (S*8, W)
+    hosts8 = hosts_packed.reshape(HOST_ROWS * 8, W)
+    job_host, hosts_out8 = pl.pallas_call(
+        functools.partial(_exact_scan_kernel, steps=S, width=W),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((S, JOB_COLS), lambda i: (0, 0)),
+            pl.BlockSpec((HOST_ROWS * 8, W), lambda i: (0, 0)),
+            pl.BlockSpec((S * 8, W), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((S, 1), lambda i: (0, 0)),
+                   pl.BlockSpec((HOST_ROWS * 8, W), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((S, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((HOST_ROWS * 8, W), jnp.float32)],
+        interpret=interpret,
+    )(jobs_packed, hosts8, forbidden_u8.reshape(S * 8, W))
+    return job_host[:, 0], hosts_out8.reshape(HOST_ROWS, H)
+
+
+def exact_scan_ok(S: int, H: int, num_groups: int,
+                  vmem_budget: int = 12 << 20) -> bool:
+    """Eligibility gate: lane-aligned shapes, single-group coupling,
+    and the whole working set resident in VMEM."""
+    if num_groups != 1 or H % 1024 or S < 8:
+        return False
+    vmem = S * H + HOST_ROWS * H * 4 * 2 + S * JOB_COLS * 4 + S * 4
+    return vmem <= vmem_budget
+
+
 @functools.partial(jax.jit,
                    static_argnames=("block_n", "block_h", "interpret",
                                     "spread"))

@@ -101,11 +101,16 @@ class TaskConstraints:
 class Router:
     def __init__(self):
         self._routes: list[tuple[str, re.Pattern, Callable]] = []
+        # (method, pattern, handler) with the ORIGINAL ":name" pattern,
+        # for the machine-readable API description (rest/openapi.py —
+        # the compojure-api swagger role, rest/api.clj:3058-3340)
+        self.route_table: list[tuple[str, str, Callable]] = []
 
     def add(self, method: str, pattern: str, handler: Callable) -> None:
         # pattern like "/jobs/:uuid" — ":name" captures a path segment
         regex = re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern)
         self._routes.append((method, re.compile(f"^{regex}$"), handler))
+        self.route_table.append((method, pattern, handler))
 
     def dispatch(self, req: Request) -> Response:
         path_matched = False
@@ -160,8 +165,8 @@ class CookApi:
                 # configured, a token is REQUIRED — a write-capable
                 # control plane must not be the open back door.
                 if self.auth.agent_token:
-                    if headers.get("x-cook-agent-token", "") \
-                            != self.auth.agent_token:
+                    if not self.auth.agent_token_ok(
+                            headers.get("x-cook-agent-token", "")):
                         raise AuthError(401, "bad agent token")
                 elif self.auth.scheme != "one-user":
                     raise AuthError(
@@ -274,7 +279,18 @@ class CookApi:
         r.add("POST", "/agents/status", self.agent_status)
         r.add("POST", "/agents/progress", self.agent_progress)
         r.add("GET", "/agents", self.agent_list)
+        # machine-readable self-description (swagger role,
+        # rest/api.clj:3058-3340): generated from this very table
+        r.add("GET", "/openapi.json", self.get_openapi)
+        r.add("GET", "/swagger-docs", self.get_openapi)
         return r
+
+    def get_openapi(self, req: Request) -> Response:
+        """OpenAPI 3.0 description of every served route."""
+        from cook_tpu.rest.openapi import build_spec
+        if getattr(self, "_openapi_cache", None) is None:
+            self._openapi_cache = build_spec(self.router)
+        return Response(200, self._openapi_cache)
 
     def get_metrics(self, req: Request) -> Response:
         """Prometheus text exposition of the metric registry (the
@@ -417,19 +433,45 @@ class CookApi:
                             before)
                         j.pool = before
 
-        dupes = [j.uuid for j in jobs if j.uuid in self.store.jobs]
+        # failover idempotency: a retry after a mid-submission 503 may
+        # find its own uuids already present as UNCOMMITTED jobs (the
+        # old leader appended the create but fenced before the commit,
+        # and the successor replayed it). A resubmission with an
+        # identical essential spec just commits those instead of 409ing.
+        resubmits = []
+        dupes = []
+        for j in jobs:
+            existing = self.store.jobs.get(j.uuid)
+            if existing is None:
+                continue
+            if (not existing.committed and existing.user == j.user
+                    and existing.command == j.command
+                    and existing.mem == j.mem
+                    and existing.cpus == j.cpus):
+                resubmits.append(j.uuid)
+            else:
+                dupes.append(j.uuid)
         if dupes:
             raise ApiError(409, {"message": "The following job UUIDs were "
                                             "already used", "data": dupes})
-        # commit-latch: write uncommitted, then commit the whole batch
         try:
-            uuids = self.store.create_jobs(jobs, groups, committed=False)
-            self.store.commit_jobs(uuids)
+            # ONE transaction creates the batch already-committed (the
+            # reference likewise transacts job txns + latch commit in a
+            # single d/transact, rest/api.clj:1825-1850), so the
+            # leadership fence is evaluated once — no window where a
+            # fence between create and commit strands the batch.
+            rs = set(resubmits)
+            fresh = [j for j in jobs if j.uuid not in rs]
+            uuids = self.store.create_jobs(fresh, groups, committed=True) \
+                if fresh or groups else []
+            if resubmits:
+                self.store.commit_jobs(resubmits)
         except NotLeaderError:
             raise   # handle() maps it to 503 + leader hint (failover)
         except TransactionError as e:
             raise ApiError(409, str(e))
-        return Response(201, {"jobs": uuids})
+        ordered = [j.uuid for j in jobs]
+        return Response(201, {"jobs": ordered})
 
     def _parse_job(self, spec: dict, user: str, pool: Optional[str],
                    group_uuids: set) -> Job:

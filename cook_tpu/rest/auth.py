@@ -41,9 +41,18 @@ class AuthConfig:
     # "open-auth" (everyone may do anything) — authorization.clj:140-233
     authorization: str = "configfile-admins-auth"
     cors_origins: list = field(default_factory=list)
-    # shared secret for the machine channel (/agents/*); empty = open,
-    # like an unauthenticated Mesos driver port
+    # shared secret for the machine channel (/agents/*); empty = open
+    # (permitted only in dev_mode — config validation refuses it
+    # otherwise). agent_token_previous is accepted alongside during a
+    # rotation window: set previous=old + token=new, roll the agents,
+    # then clear previous.
     agent_token: str = ""
+    agent_token_previous: str = ""
+
+    def agent_token_ok(self, presented: str) -> bool:
+        return presented == self.agent_token or (
+            bool(self.agent_token_previous)
+            and presented == self.agent_token_previous)
 
 
 def authenticate(cfg: AuthConfig, headers: dict) -> str:

@@ -284,7 +284,9 @@ def build_scheduler(config, read_only=False):
                         imposters=set(config.auth.imposters),
                         authorization=config.auth.authorization,
                         cors_origins=list(config.auth.cors_origins),
-                        agent_token=config.auth.agent_token),
+                        agent_token=config.auth.agent_token,
+                        agent_token_previous=
+                        config.auth.agent_token_previous),
         task_constraints=TaskConstraints(
             max_mem_mb=config.task_constraints.max_mem_mb,
             max_cpus=config.task_constraints.max_cpus,

@@ -773,7 +773,11 @@ class ResidentPool:
                  num_considerable: int, sequential: bool,
                  dru_mode: str, use_pallas: bool,
                  match_kw=None) -> _CycleOut:
-        num_groups = bucket(max(len(self._group_ids), 1))
+        # exactly 1 when no groups exist (enables the fused pallas scan
+        # and a smaller occupancy map); bucketed otherwise for compile
+        # stability
+        num_groups = (1 if not self._group_ids
+                      else bucket(len(self._group_ids)))
         self.state, out = _device_cycle(
             self.state, bundle, qm, qc, qn,
             np.int32(considerable_limit),

@@ -368,3 +368,50 @@ def test_daemon_outbox_redelivers_terminal_status(stack, tmp_path):
     d._url_idx = 0
     d._flush_outbox()
     assert d._outbox == []
+
+
+def test_agent_bad_token_rejected(stack):
+    """A wrong token is rejected outright (not just a missing one)."""
+    store, cluster, coord, server, add_agent = stack
+    req = urllib.request.Request(
+        server.url + "/agents/status",
+        data=json.dumps({"task_id": "x", "event": "exited",
+                         "exit_code": 0}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Cook-Agent-Token": "wrong"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 401
+
+
+def test_agent_token_rotation_window():
+    """During rotation the previous token still authenticates; after
+    the window closes it stops."""
+    from cook_tpu.rest.auth import AuthConfig
+    rotating = AuthConfig(scheme="header", agent_token="new",
+                          agent_token_previous="old")
+    assert rotating.agent_token_ok("new")
+    assert rotating.agent_token_ok("old")
+    assert not rotating.agent_token_ok("stale")
+    closed = AuthConfig(scheme="header", agent_token="new")
+    assert closed.agent_token_ok("new")
+    assert not closed.agent_token_ok("old")
+    assert not closed.agent_token_ok("")
+
+
+def test_config_refuses_open_agent_channel():
+    """Settings.validate: an agent cluster without agent_token is only
+    legal with an explicit dev_mode (VERDICT r2 weakness #6)."""
+    from cook_tpu.config import ConfigError, Settings
+
+    with pytest.raises(ConfigError):
+        Settings.from_dict({"clusters": [{"kind": "agent",
+                                          "name": "agents"}]})
+    ok = Settings.from_dict({"clusters": [{"kind": "agent",
+                                           "name": "agents"}],
+                             "auth": {"agent_token": "s3cret"}})
+    ok.validate()
+    dev = Settings.from_dict({"dev_mode": True,
+                              "clusters": [{"kind": "agent",
+                                            "name": "agents"}]})
+    dev.validate()

@@ -205,3 +205,66 @@ def test_client_rotates_candidate_urls(live):
     dead = JobClient("http://127.0.0.1:1", user="alice", timeout=2.0)
     with pytest.raises(urllib.error.URLError):
         dead.query("whatever")
+
+
+def test_cli_raw_json_submit(live, capsys, tmp_path):
+    """Raw-JSON job import (subcommands/submit.py parse_raw_job_spec):
+    flags act as template defaults, raw keys override."""
+    store, cluster, coord, server = live
+    raw = tmp_path / "jobs.json"
+    raw.write_text(json.dumps([
+        {"command": "echo one", "mem": 256},
+        {"command": "echo two", "priority": 90},
+    ]))
+    assert run_cli(server, "submit", "--mem", "64", "--cpus", "2",
+                   "--raw", str(raw)) == 0
+    uuids = capsys.readouterr().out.split()
+    assert len(uuids) == 2
+    j1, j2 = store.get_job(uuids[0]), store.get_job(uuids[1])
+    assert j1.mem == 256 and j1.cpus == 2     # raw overrides template mem
+    assert j2.mem == 64 and j2.priority == 90
+
+
+def test_cli_plugin_hooks(live, capsys, tmp_path, monkeypatch):
+    """A config-named plugin module preprocesses submitted specs and
+    registers a whole subcommand."""
+    store, cluster, coord, server = live
+    plugin = tmp_path / "site_plugins.py"
+    plugin.write_text(
+        "def register(reg):\n"
+        "    def stamp(spec):\n"
+        "        spec.setdefault('labels', {})['site'] = 'tpu'\n"
+        "        return spec\n"
+        "    reg.add_hook('submit-job-preprocess', stamp)\n"
+        "    def hello(fed, args):\n"
+        "        print('plugin-hello', args.whom)\n"
+        "        return 0\n"
+        "    reg.add_hook('subcommand:hello', hello)\n"
+        "    def parsers(sub):\n"
+        "        s = sub.add_parser('hello')\n"
+        "        s.add_argument('whom')\n"
+        "    reg.register_parser(parsers)\n")
+    cfg = tmp_path / "cs.json"
+    cfg.write_text(json.dumps({"plugins": {"module": "site_plugins"}}))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    assert cli_main(["--config", str(cfg), "--url", server.url,
+                     "--user", "alice", "submit", "echo", "hi"]) == 0
+    uuid = capsys.readouterr().out.strip().splitlines()[-1]
+    assert store.get_job(uuid).labels["site"] == "tpu"
+    assert cli_main(["--config", str(cfg), "--url", server.url,
+                     "--user", "alice", "hello", "world"]) == 0
+    assert "plugin-hello world" in capsys.readouterr().out
+
+
+def test_cli_metrics_sink(live, capsys, tmp_path):
+    store, cluster, coord, server = live
+    sink = tmp_path / "metrics.jsonl"
+    cfg = tmp_path / "cs.json"
+    cfg.write_text(json.dumps({"metrics": {"enabled": True,
+                                           "path": str(sink)}}))
+    assert cli_main(["--config", str(cfg), "--url", server.url,
+                     "--user", "alice", "submit", "echo", "hi"]) == 0
+    capsys.readouterr()
+    events = [json.loads(line) for line in sink.read_text().splitlines()]
+    assert events and events[0]["command"] == "submit"
+    assert events[0]["status"] == 0 and events[0]["duration_ms"] >= 0

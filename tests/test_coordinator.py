@@ -645,3 +645,34 @@ def test_watchdog_gcs_stale_uncommitted_jobs():
     assert out["uncommitted_gced"] == [stale.uuid]
     assert stale.uuid not in store.jobs
     assert fresh.uuid in store.jobs         # too young to purge
+
+
+def test_adaptive_head_controller_logic():
+    from cook_tpu.scheduler.coordinator import AdaptiveHead
+    h = AdaptiveHead(start=128, clean_to_shrink=3)
+    assert h.head == 128
+    for _ in range(3):
+        h.observe(0)
+    assert h.head == 64          # clean streak shrinks
+    h.observe(2)
+    assert h.head == 128         # any inversion grows immediately
+    h.observe(1)
+    assert h.head == 256
+    h.observe(1)
+    assert h.head == 256         # capped at the ladder top
+
+
+def test_batched_match_cycle_runs_audit_and_stays_clean():
+    """Force the batched matcher in the production cycle; the sampled
+    head-window audit must run and observe zero inversions."""
+    store, cluster, coord = build(
+        hosts=[MockHost(f"h{i}", mem=4000, cpus=32) for i in range(4)],
+        config=SchedulerConfig(max_jobs_considered=64,
+                               sequential_match_threshold=16))
+    jobs = [mkjob(user=f"u{i % 5}", mem=50 + (i % 7) * 30,
+                  cpus=1 + (i % 3)) for i in range(120)]
+    store.create_jobs(jobs)
+    stats = coord.match_cycle()
+    assert stats.matched > 0
+    assert coord.metrics["match.default.head_inversions"] == 0
+    assert coord.metrics["match.default.head_exact"] == 256

@@ -49,6 +49,8 @@ def spawn_server(tmp_path, port, lease_url, shared_log=False):
     cfg = {
         "port": port,
         "url": f"http://127.0.0.1:{port}",
+        # open agent channel needs the explicit dev opt-in now
+        "dev_mode": True,
         "clusters": [{"kind": "agent", "name": "agents",
                       "agent_heartbeat_timeout_s": 5.0}],
         "leader_lease_url": lease_url,

@@ -126,3 +126,16 @@ def test_deep_nesting_survives():
     doc = "[" * 200 + "1" + "]" * 200
     out = _roundtrip(doc)
     assert out is not None and json.loads(out) == json.loads(doc)
+
+
+def test_deep_nesting_rejected_not_crash():
+    """100k '[' must fail cleanly ('too deeply nested'), not overflow
+    the native stack (ADVICE r2: JsonParser recursion guard)."""
+    deep = "[" * 100_000 + "]" * 100_000
+    assert _roundtrip(deep) is None       # parse error, process alive
+    # under the kMaxDepth=512 cap still parses
+    ok = "[" * 500 + "1" + "]" * 500
+    assert _roundtrip(ok) == ok
+    # just over the cap is rejected
+    over = "[" * 513 + "1" + "]" * 513
+    assert _roundtrip(over) is None

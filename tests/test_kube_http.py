@@ -303,3 +303,173 @@ def test_kube_cluster_e2e_with_kubelet_sim(standin):
     finally:
         sim.stop()
         api.stop()
+
+
+# -- pod-spec depth on the wire (task-metadata->pod api.clj:661-882) ---
+def test_pod_spec_depth_on_wire(standin):
+    """Tolerations, pool node selector, priority class, docker
+    volumes/ports/hostNetwork, and the sidecar file server must appear
+    in the POSTed wire JSON (asserted against the standin's recorded
+    raw spec), and survive a round trip through the apiserver."""
+    api = HttpKube(standin.url, namespace="cook",
+                   watch_backoff_s=(0.02, 0.2))
+    cluster = KubeCluster(
+        api, tolerations=[{"key": "cook", "operator": "Exists",
+                           "effect": "NoSchedule"}],
+        priority_class="cook-batch",
+        sidecar={"image": "cook-sidecar:1", "port": 28501})
+    store = JobStore()
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    cluster.initialize()
+    try:
+        job = mkjob(container={
+            "type": "docker",
+            "docker": {"image": "python:3.11",
+                       "network": "HOST",
+                       "port-mapping": [{"container-port": 8080,
+                                         "host-port": 31080,
+                                         "protocol": "tcp"}]},
+            "volumes": [{"host-path": "/data", "container-path": "/mnt",
+                         "mode": "RW"}],
+        })
+        store.create_jobs([job])
+        assert coord.match_cycle().matched == 1
+        task_id = job.instances[0].task_id
+        wait_until(lambda: task_id in standin.pod_specs)
+        spec = standin.pod_specs[task_id]["spec"]
+        assert spec["tolerations"] == [{"key": "cook",
+                                        "operator": "Exists",
+                                        "effect": "NoSchedule"}]
+        assert spec["nodeSelector"] == {"cook-pool": "default"}
+        assert spec["priorityClassName"] == "cook-batch"
+        assert spec["hostNetwork"] is True
+        c0 = spec["containers"][0]
+        assert c0["image"] == "python:3.11"
+        assert c0["ports"] == [{"containerPort": 8080, "hostPort": 31080,
+                                "protocol": "TCP"}]
+        mounts = {m["mountPath"]: m for m in c0["volumeMounts"]}
+        assert mounts["/mnt"]["readOnly"] is False
+        vol_names = {v["name"] for v in spec["volumes"]}
+        assert any(n.startswith("cook-docker-vol") for n in vol_names)
+        # sidecar container shares the sandbox volume
+        names = [c["name"] for c in spec["containers"]]
+        assert names == ["cook-job", "cook-sidecar"]
+        side = spec["containers"][1]
+        assert side["image"] == "cook-sidecar:1"
+        assert side["ports"] == [{"containerPort": 28501}]
+        assert "cook-sandbox" in vol_names
+        # round trip: the watch-fed pod keeps the depth fields
+        pod = wait_until(lambda: next(
+            (p for p in api.list_pods() if p.name == task_id), None))
+        assert pod.priority_class == "cook-batch"
+        assert pod.tolerations and pod.node_selector
+        assert pod.container["docker"]["network"] == "HOST"
+        assert pod.container["volumes"][0]["host-path"] == "/data"
+        assert pod.sidecar["image"] == "cook-sidecar:1"
+        assert pod.sidecar["port"] == 28501
+        # sidecar-served output_url lands on the instance at RUNNING
+        standin.fake.start_pod(task_id)
+        wait_until(lambda: job.instances[0].status
+                   == InstanceStatus.RUNNING)
+        wait_until(lambda: job.instances[0].output_url)
+        node = standin.fake.pods[task_id].node
+        assert job.instances[0].output_url == f"http://{node}:28501"
+        assert job.instances[0].sandbox_directory == "/cook-sandbox"
+    finally:
+        api.stop()
+
+
+def test_synthetic_pods_get_preemptible_priority_class(standin):
+    api, cluster, store, coord = build_http_stack(standin)
+    try:
+        cluster.autoscale("default", 2, pending_sizes=[(100.0, 1.0)])
+        wait_until(lambda: any(n.startswith("synthetic-")
+                               for n in standin.pod_specs))
+        name = next(n for n in standin.pod_specs
+                    if n.startswith("synthetic-"))
+        spec = standin.pod_specs[name]["spec"]
+        assert spec["priorityClassName"] == "cook-synthetic-preemptible"
+        assert spec["nodeSelector"] == {"cook-pool": "default"}
+    finally:
+        api.stop()
+
+
+def test_standin_rejects_invalid_pod(standin):
+    import json as _json
+    import urllib.request
+    body = {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "bad"},
+            "spec": {"containers": []}}
+    req = urllib.request.Request(
+        standin.url + "/api/v1/namespaces/cook/pods",
+        data=_json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 422
+
+
+# -- apiserver fidelity: throttling, bookmarks, chaos ------------------
+def test_429_retry_after_honored(standin):
+    api = HttpKube(standin.url, namespace="cook",
+                   watch_backoff_s=(0.02, 0.2))
+    try:
+        standin.throttle_next(2, retry_after=0)
+        # list retries through the 429s and succeeds
+        assert isinstance(api.list_nodes(), list)
+        assert standin._throttle_left == 0
+    finally:
+        api.stop()
+
+
+def test_watch_bookmark_advances_resume_point(standin, http):
+    """An idle watcher that only ever saw a BOOKMARK must reconnect
+    from the bookmarked rv, not 410 after the history window ages out."""
+    seen = []
+    http.watch_pods(lambda kind, pod: seen.append((kind, pod.name)))
+    wait_until(lambda: standin._streams)
+    # traffic the pod watcher doesn't see advances the global rv
+    for i in range(8):
+        standin.post_event("Scheduled", f"m{i}")
+    standin.post_bookmark()
+    time.sleep(0.2)
+    standin.expire_history()       # anything older than now 410s
+    standin.drop_streams()         # force a reconnect from the resume rv
+    # a reconnect from the bookmarked rv must NOT relist (no 410): a new
+    # pod event arrives over the resumed watch
+    n_lists = standin.list_counts["pods"]
+    standin.fake.create_pod(Pod(name="bm1", mem=10, cpus=1))
+    wait_until(lambda: ("added", "bm1") in seen)
+    assert standin.list_counts["pods"] == n_lists
+
+
+def test_chaos_standin_restart_mid_watch_no_status_loss(standin):
+    """Kill the apiserver mid-watch while pods change state; after it
+    returns, every terminal status must still reach the store (the
+    reconnect + relist-diff discipline of kubernetes/api.clj:200-333)."""
+    api, cluster, store, coord = build_http_stack(standin)
+    try:
+        jobs = [mkjob() for _ in range(4)]
+        store.create_jobs(jobs)
+        assert coord.match_cycle().matched == 4
+        task_ids = [j.instances[0].task_id for j in jobs]
+        wait_until(lambda: len(standin.fake.list_pods()) == 4)
+        for t in task_ids[:2]:
+            standin.fake.start_pod(t)
+        # sever every stream AND age out the watch window: the client
+        # must survive 410 + relist while state keeps moving
+        standin.drop_streams()
+        standin.expire_history()
+        standin.fake.succeed_pod(task_ids[0])     # during the gap
+        for t in task_ids[2:]:
+            standin.fake.start_pod(t)
+        standin.fake.succeed_pod(task_ids[1])
+        standin.fake.succeed_pod(task_ids[2])
+        standin.fake.succeed_pod(task_ids[3])
+        wait_until(lambda: all(j.state == JobState.COMPLETED
+                               for j in jobs))
+        assert all(j.success for j in jobs)
+    finally:
+        api.stop()

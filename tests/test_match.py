@@ -217,3 +217,69 @@ def test_rounds_dense_only_full_throughput():
     res = match_ops.match_rounds(jb, hb, forb,
                                  bonus=jnp.zeros((N, H), jnp.float32))
     assert int((np.asarray(res.job_host) >= 0).sum()) == N
+
+
+# -- candidate-compressed exact scan (identical to the full scan) -----------
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_candidate_scan_equals_full_scan(seed):
+    """_scan_assign_candidates must produce EXACTLY the assignments of
+    the O(H)-per-step scan — including tie-breaks on identical hosts,
+    gpu-host coupling, forbidden masks, and group uniqueness. K=4
+    forces the dirty-candidates fallback to fire."""
+    rng = np.random.default_rng(seed)
+    S, H = 192, 2048
+    # half the hosts identical (maximal fitness ties), a gpu slice
+    mem_h = np.where(np.arange(H) % 2 == 0, 4000.0,
+                     rng.uniform(2000, 16000, H)).astype(np.float32)
+    cpus_h = np.where(np.arange(H) % 2 == 0, 8.0,
+                      rng.uniform(4, 32, H)).astype(np.float32)
+    gpus_h = np.where(np.arange(H) % 17 == 0, 4.0, 0.0).astype(np.float32)
+    hb = match_ops.make_hosts(mem=mem_h, cpus=cpus_h, gpus=gpus_h,
+                              task_slots=np.full(H, 3, np.int32))
+    jb = match_ops.make_jobs(
+        mem=rng.uniform(100, 6000, S).astype(np.float32),
+        cpus=rng.uniform(0.5, 8, S).astype(np.float32),
+        gpus=np.where(rng.random(S) < 0.1, 1.0, 0.0).astype(np.float32),
+        group=np.where(rng.random(S) < 0.2,
+                       rng.integers(0, 4, S), -1).astype(np.int32),
+        unique_group=(rng.random(S) < 0.15))
+    forb = jnp.asarray(rng.random((S, H)) < 0.05)
+    bonus = jnp.zeros((S, H), jnp.float32)
+
+    carry = (hb.mem, hb.cpus, hb.gpus, hb.task_slots,
+             jnp.zeros((4, H), bool))
+    (_, full_hosts) = match_ops._scan_assign(jb, hb, forb, bonus, 4,
+                                             carry)
+    for K in (4, 32):
+        carry2 = (hb.mem, hb.cpus, hb.gpus, hb.task_slots,
+                  jnp.zeros((4, H), bool))
+        (cc, cand_hosts) = match_ops._scan_assign_candidates(
+            jb, hb, forb, bonus, 4, carry2, K=K)
+        np.testing.assert_array_equal(np.asarray(cand_hosts),
+                                      np.asarray(full_hosts),
+                                      err_msg=f"K={K}")
+    # carry state parity too (resource depletion identical)
+    carry3 = (hb.mem, hb.cpus, hb.gpus, hb.task_slots,
+              jnp.zeros((4, H), bool))
+    (c_full, _) = match_ops._scan_assign(jb, hb, forb, bonus, 4, carry3)
+    for a, b in zip(cc[:4], c_full[:4]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3)
+
+
+def test_match_scan_dispatches_candidate_core_at_scale():
+    """match_scan with H >= 2048 routes through the compressed core and
+    still matches the brute-force numpy oracle head."""
+    rng = np.random.default_rng(9)
+    S, H = 128, 4096
+    jb = match_ops.make_jobs(
+        mem=rng.uniform(500, 4000, S).astype(np.float32),
+        cpus=rng.uniform(1, 8, S).astype(np.float32))
+    hb = match_ops.make_hosts(
+        mem=rng.uniform(4000, 16000, H).astype(np.float32),
+        cpus=rng.uniform(8, 32, H).astype(np.float32))
+    forb = jnp.zeros((S, H), bool)
+    res = match_ops.match_scan(jb, hb, forb)
+    jh = np.asarray(res.job_host)
+    assert (jh >= 0).all()
+    assert len(match_ops.inversion_positions_np(jb, hb, forb, jh)) == 0

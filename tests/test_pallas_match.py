@@ -1,7 +1,12 @@
-"""Pallas fused match kernel (ops/pallas_match.py) vs the XLA path.
+"""Pallas fused match kernels (ops/pallas_match.py) vs the XLA path.
 
-Runs under interpret mode on CPU (conftest forces JAX_PLATFORMS=cpu);
-the real-TPU execution is exercised by bench.py.
+Runs under interpret mode on CPU (conftest forces JAX_PLATFORMS=cpu).
+Real-TPU execution and A/B timing of both kernels (dense best_host and
+the fused exact_scan) is done by `python bench.py pallas`, with the
+measured numbers recorded in docs/benchmarks.md — on a v5e both paths
+measure within noise of the XLA lowering (the scan is latency-bound on
+its per-step global argmax, not on fusion), which is why use_pallas
+defaults to False.
 """
 import numpy as np
 import pytest
@@ -141,3 +146,48 @@ def test_match_rounds_pallas_equals_xla_full():
                                   np.asarray(b.job_host))
     np.testing.assert_allclose(np.asarray(a.mem_left),
                                np.asarray(b.mem_left), rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_exact_scan_kernel_equals_xla_scan(seed):
+    """The fused sequential-scan kernel must reproduce _scan_assign
+    exactly (interpret mode on CPU; the real-TPU timing comparison is
+    published in docs/benchmarks.md)."""
+    rng = np.random.default_rng(seed)
+    S, H = 64, 1024
+    mem_h = np.where(np.arange(H) % 2 == 0, 4000.0,
+                     rng.uniform(2000, 16000, H)).astype(np.float32)
+    hb = match_ops.make_hosts(
+        mem=mem_h, cpus=rng.uniform(4, 32, H).astype(np.float32),
+        gpus=np.where(np.arange(H) % 13 == 0, 4.0, 0.0).astype(np.float32),
+        task_slots=np.full(H, 5, np.int32))
+    jb = match_ops.make_jobs(
+        mem=rng.uniform(100, 8000, S).astype(np.float32),
+        cpus=rng.uniform(0.5, 8, S).astype(np.float32),
+        gpus=np.where(rng.random(S) < 0.12, 1.0, 0.0).astype(np.float32),
+        unique_group=(rng.random(S) < 0.2),
+        group=np.zeros(S, np.int32))
+    forb = jnp.asarray(rng.random((S, H)) < 0.08)
+    bonus = jnp.zeros((S, H), jnp.float32)
+
+    carry = (hb.mem, hb.cpus, hb.gpus, hb.task_slots,
+             jnp.zeros((1, H), bool))
+    (c_ref, ref_hosts) = match_ops._scan_assign(jb, hb, forb, bonus, 1,
+                                                carry)
+    jp = pallas_match.pack_jobs(jb.mem, jb.cpus, jb.gpus, jb.valid,
+                                jb.unique_group)
+    hp = pallas_match.pack_hosts(hb.mem, hb.cpus, hb.gpus, hb.cap_mem,
+                                 hb.cap_cpus, hb.cap_gpus, hb.task_slots,
+                                 hb.valid, jnp.zeros(H, bool))
+    jh, hout = pallas_match.exact_scan(jp, hp, forb.astype(jnp.uint8),
+                                       interpret=True)
+    np.testing.assert_array_equal(np.asarray(jh), np.asarray(ref_hosts))
+    np.testing.assert_allclose(
+        np.asarray(hout[pallas_match.H_MEM]), np.asarray(c_ref[0]),
+        atol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(hout[pallas_match.H_SLOTS]),
+        np.asarray(c_ref[3]).astype(np.float32), atol=1e-3)
+    np.testing.assert_array_equal(
+        np.asarray(hout[pallas_match.H_OCC0] > 0),
+        np.asarray(c_ref[4][0]))

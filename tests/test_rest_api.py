@@ -452,3 +452,53 @@ def test_pool_mover_bad_destination_reverted(stack):
                     "users": {"alice": {"portion": 1.0}}}}))
     (uuid,) = submit(api)
     assert store.get_job(uuid).pool == "default"
+
+
+def test_resubmit_uncommitted_batch_is_idempotent(stack):
+    """Failover retry semantics (ADVICE r2): a batch whose create
+    landed but whose commit was fenced must be committable by an
+    identical resubmission instead of 409ing."""
+    store, cluster, coord, api = stack
+    u = new_uuid()
+    # simulate the stranded create of a fenced leader
+    store.create_jobs([Job(uuid=u, user="alice", command="echo hi",
+                           mem=64.0, cpus=1.0)], committed=False)
+    assert not store.jobs[u].committed
+    resp = api.handle("POST", "/jobs", {}, {
+        "jobs": [{"uuid": u, "command": "echo hi", "mem": 64,
+                  "cpus": 1}]}, {"x-cook-user": "alice"})
+    assert resp.status == 201 and resp.body["jobs"] == [u]
+    assert store.jobs[u].committed
+    # a DIFFERENT spec on the same uuid is still a 409
+    resp2 = api.handle("POST", "/jobs", {}, {
+        "jobs": [{"uuid": u, "command": "echo other", "mem": 64,
+                  "cpus": 1}]}, {"x-cook-user": "alice"})
+    assert resp2.status == 409
+
+
+def test_openapi_covers_every_route(stack):
+    """GET /openapi.json serves an OpenAPI 3 doc generated from the
+    LIVE route table — every dispatched route must appear, with path
+    params and write-body schemas (the swagger self-description role,
+    rest/api.clj:3058-3340)."""
+    import re as _re
+    store, cluster, coord, api = stack
+    resp = call(api, "GET", "/openapi.json")
+    assert resp.status == 200
+    spec = resp.body
+    assert spec["openapi"].startswith("3.")
+    for method, pattern, _h in api.router.route_table:
+        oa_path = _re.sub(r":(\w+)", r"{\1}", pattern)
+        assert oa_path in spec["paths"], pattern
+        assert method.lower() in spec["paths"][oa_path], (method, pattern)
+    # path params derived from :segments
+    job_get = spec["paths"]["/jobs/{uuid}"]["get"]
+    assert job_get["parameters"][0]["name"] == "uuid"
+    # submission body schema reachable
+    post = spec["paths"]["/jobs"]["post"]
+    ref = post["requestBody"]["content"]["application/json"]["schema"]
+    name = ref["$ref"].rsplit("/", 1)[-1]
+    assert "command" in spec["components"]["schemas"][name][
+        "properties"]["jobs"]["items"]["properties"]
+    # alias
+    assert call(api, "GET", "/swagger-docs").status == 200
