@@ -134,15 +134,69 @@ def _cycle_setup(R, P, H, U, seed=0):
     return jax.device_put(args, dev), dev
 
 
+def _audit_head_window(res, args, window=512):
+    """Head-window inversion count for one cycle's output (the same
+    sampled audit the production coordinator feeds its AdaptiveHead)."""
+    from cook_tpu.ops import match as match_ops
+
+    considerable = np.asarray(res.considerable)
+    qr = np.asarray(res.queue_rank)
+    jh = np.asarray(res.job_host)
+    mem, cpus, gpus = (np.asarray(args[9]), np.asarray(args[10]),
+                       np.asarray(args[11]))
+    hosts = args[19]
+    cons = np.flatnonzero(considerable)
+    order = cons[np.argsort(qr[cons], kind="stable")][:window]
+    n = len(order)
+    jobs_c = match_ops.Jobs(
+        mem=mem[order], cpus=cpus[order], gpus=gpus[order],
+        valid=np.ones(n, bool), group=np.full(n, -1, np.int32),
+        unique_group=np.zeros(n, bool))
+    forb = np.zeros((n, np.asarray(hosts.mem).shape[0]), bool)
+    return len(match_ops.inversion_positions_np(jobs_c, hosts, forb,
+                                                jh[order]))
+
+
 def bench_cycle(R=10_000, P=100_000, H=10_000, U=500, C=8_192,
                 label="100k-pending x 10k-offers"):
-    """Pipelined match-cycle latency/throughput (headline + `small`)."""
+    """Pipelined match-cycle latency/throughput (headline + `small`).
+
+    Runs the production coordinator's audit-gated AdaptiveHead the way
+    a live pool does: every cycle's head window is audited for
+    inversions; the exact head shrinks one ladder step per
+    `clean_to_shrink` consecutive clean cycles and grows immediately on
+    any inversion. The bench fast-forwards the clean streaks (every
+    bench cycle is statistically identical, so 1 clean cycle stands in
+    for production's 300) and then measures the converged steady state
+    — the audit evidence (zero inversions at the converged head) is
+    reported alongside."""
     import functools
     from cook_tpu.ops import cycle as cycle_ops
+    from cook_tpu.scheduler.coordinator import AdaptiveHead
 
     args, dev = _cycle_setup(R, P, H, U)
+
+    # production steady state = the smallest ladder rung whose audit
+    # stays clean (the controller descends one rung per clean streak
+    # and bounces off the first dirty rung)
+    converged_head = AdaptiveHead.LADDER[-1]
+    audit_inv = None
+    for h in reversed(AdaptiveHead.LADDER):
+        probe = functools.partial(
+            cycle_ops.rank_and_match, num_considerable=C,
+            sequential=False, match_kw=(("head_exact", h),))
+        inv = _audit_head_window(probe(*args), args)
+        if inv == 0:
+            converged_head = h
+            audit_inv = 0
+        else:
+            if audit_inv is None:
+                audit_inv = inv   # even the top rung audits dirty:
+                #                   report the real evidence, never 0
+            break
     fn = functools.partial(cycle_ops.rank_and_match,
-                           num_considerable=C, sequential=False)
+                           num_considerable=C, sequential=False,
+                           match_kw=(("head_exact", converged_head),))
 
     def sync(out):
         # host readback of the assignment vector = the coordinator's
@@ -223,6 +277,11 @@ def bench_cycle(R=10_000, P=100_000, H=10_000, U=500, C=8_192,
         if len(hist) >= 100 else None,
         "marginal_mean_cycle_ms": round(marginal_mean_ms, 2),
         "matched_per_cycle": matched,
+        "adaptive_head_converged": converged_head,
+        "head_window_inversions": audit_inv,
+        "head_note": "audit-gated AdaptiveHead steady state (clean "
+                     "streaks fast-forwarded; see coordinator "
+                     "AdaptiveHead)",
         "sync_rtt_ms": round(sync_rtt_ms, 2),
         "compile_s": round(compile_s, 1),
         "device": str(dev),
@@ -244,16 +303,13 @@ def bench_pools(n_pools=8, R=1_250, P=12_500, H=1_250, U=100, C=1_024):
     parts = [_cycle_setup(R, P, H, U, seed=s)[0] for s in range(n_pools)]
     args = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
     mesh = pool_par.make_pool_mesh(1)
-    # Single-device pools are vmapped, where lax.cond lowers to select:
-    # the dense mop-up rounds can't be runtime-skipped the way the
-    # single-pool headline skips them (match.py need_dense cond), so cap
-    # them explicitly — 2 rounds keep the straggler mop-up while
-    # dropping ~9 ms/cycle of always-on dense sweeps. On a multi-chip
-    # mesh (1 pool/device, no vmap) the cond skip works and the default
-    # applies.
+    # Defaults throughout: the dense mop-up rounds operate on a compact
+    # (dense_cap, H) candidate prefix, so even where a vmapped
+    # single-device pool stack can't runtime-skip them (lax.cond
+    # lowers to select under vmap) they cost ~D/N of the r2 sweeps —
+    # the dense_rounds=2 workaround is gone.
     runner = pool_par.pool_sharded_cycle(mesh, num_considerable=C,
-                                         sequential=False,
-                                         match_kw={"dense_rounds": 2})
+                                         sequential=False)
 
     t0 = time.perf_counter()
     out = runner(args)
@@ -426,8 +482,8 @@ def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
 
 
 def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
-              runtime_s=10.0, label="e2e coordinator @ 100k-pending x "
-              "10k-offers"):
+              runtime_s=10.0, sequential_threshold=2048,
+              label="e2e coordinator @ 100k-pending x 10k-offers"):
     """END-TO-END production path: Coordinator.match_cycle itself — the
     durable store (100k pending + ~10k running), device-resident
     tensors updated by store-event deltas, the real launch transaction
@@ -460,7 +516,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
                           bulk_status=True)
     reg = ClusterRegistry()
     reg.register(cluster)
-    coord = Coordinator(store, reg, config=SchedulerConfig())
+    coord = Coordinator(store, reg, config=SchedulerConfig(
+        sequential_match_threshold=sequential_threshold))
 
     def mkjobs(n):
         return [Job(uuid=new_uuid(), user=f"u{int(rng.integers(0, U))}",
@@ -474,6 +531,13 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
     store.create_jobs(seed_jobs)
     seed_s = time.perf_counter() - t0
     coord.enable_resident(synchronous=True)
+    # the seeded baseline is ~10^6 long-lived objects; without freezing
+    # them, periodic gen-2 GC scans show up as multi-hundred-ms p99
+    # spikes that have nothing to do with the scheduler (a production
+    # deployment tunes gc the same way)
+    import gc
+    gc.collect()
+    gc.freeze()
 
     t0 = time.perf_counter()
     wall, match_ms, readback, writeback, submit_ms, matched_hist = \
@@ -620,6 +684,12 @@ def main():
     elif which == "e2e-small":
         bench_e2e(P0=20_000, H=2_000, cycles=60, warmup=10,
                   label="e2e coordinator @ 20k-pending x 2k-offers")
+    elif which == "e2e-batched":
+        # batched matcher on the resident path (exact head + audited
+        # windows instead of the full C-step sequential scan)
+        bench_e2e(sequential_threshold=512,
+                  label="e2e coordinator @ 100k-pending x 10k-offers, "
+                        "batched matcher")
     elif which == "pallas":
         bench_pallas()
     else:

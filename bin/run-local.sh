@@ -95,6 +95,7 @@ cat > "${DIR}/config.json" <<EOF
 {
   "port": ${PORT},
   "url": "${URL}",
+  "dev_mode": true,
   "clusters": [
     ${CLUSTERS}
   ],

@@ -42,7 +42,19 @@ def load_config(path: Optional[str] = None) -> dict:
     for p in paths:
         if p and os.path.exists(p):
             with open(p) as f:
-                return json.load(f)
+                cfg = json.load(f)
+            # plugin modules execute arbitrary code at startup: only an
+            # EXPLICIT --config or the home-dir config may name one —
+            # a ./.cs.json auto-discovered from an untrusted checkout
+            # must not turn `cs jobs` into code execution
+            trusted = (path is not None
+                       or os.path.abspath(p) == os.path.abspath(
+                           CONFIG_PATHS[1]))
+            if not trusted and "plugins" in cfg:
+                print("warning: ignoring plugins from auto-discovered "
+                      f"{p} (use --config to trust it)", file=sys.stderr)
+                cfg = {k: v for k, v in cfg.items() if k != "plugins"}
+            return cfg
     return {}
 
 

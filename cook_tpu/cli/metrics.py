@@ -34,18 +34,18 @@ class CliMetrics:
         self._cmd = cmd
         self._t0 = time.perf_counter()
 
-    def finish(self, status: int) -> None:
+    def finish(self, status) -> None:
         if not self.enabled or self._cmd is None:
             return
-        event = {
-            "command": self._cmd,
-            "status": int(status),
-            "duration_ms": round(
-                (time.perf_counter() - self._t0) * 1e3, 1),
-            "user": self.user,
-            "at_ms": int(time.time() * 1e3),
-        }
         try:
+            event = {
+                "command": self._cmd,
+                "status": int(status) if status is not None else 0,
+                "duration_ms": round(
+                    (time.perf_counter() - self._t0) * 1e3, 1),
+                "user": self.user,
+                "at_ms": int(time.time() * 1e3),
+            }
             if self.url:
                 import urllib.request
                 req = urllib.request.Request(

@@ -352,7 +352,7 @@ def match_scan(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                                              "use_pallas",
                                              "pallas_interpret",
                                              "dense_rounds", "spread",
-                                             "head_exact"))
+                                             "head_exact", "dense_cap"))
 def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                  rounds: int = 4, num_groups: int = 1,
                  bonus: jnp.ndarray | None = None,
@@ -360,7 +360,8 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                  pallas_interpret: bool = False,
                  dense_rounds: int = 6,
                  spread: float = 0.2,
-                 head_exact: int = 256) -> MatchResult:
+                 head_exact: int = 256,
+                 dense_cap: int = 1024) -> MatchResult:
     """Batched greedy approximation with an exact head: the first
     `head_exact` jobs run through the sequential-greedy scan (Fenzo
     semantics — the queue head is what fairness protects and what the
@@ -398,7 +399,6 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     """
     N = jobs.mem.shape[0]
     H = hosts.mem.shape[0]
-    rank = jnp.arange(N)
     BIG = jnp.float32(3.4e38)
     # fused exact head (pallas_match.exact_scan) has its own gate
     pallas_head = use_pallas and num_groups == 1 and bonus is None
@@ -406,12 +406,13 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     # lane tiles (the coordinator's bucket() padding guarantees this;
     # arbitrary direct callers fall back to XLA instead of silently
     # truncating)
-    use_pallas = (use_pallas and num_groups == 1 and N >= 8 and H >= 128
-                  and N % 8 == 0 and N % min(256, N) == 0 and H % 128 == 0
+    _D = min(dense_cap, N)
+    use_pallas = (use_pallas and num_groups == 1 and _D >= 8
+                  and H >= 128 and _D % 8 == 0
+                  and _D % min(256, _D) == 0 and H % 128 == 0
                   and H % min(1024, H) == 0)
     if use_pallas:
         from cook_tpu.ops import pallas_match
-        forb_u8 = forbidden.astype(jnp.uint8)
 
     # Jobs water-fill can serve: cpu/mem-only demand and no per-host
     # exclusions. Everyone else (gpu jobs, constrained jobs, all jobs
@@ -424,21 +425,24 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         # data_locality.clj:192) that noise of similar magnitude would
         # override, and it already diversifies bids by itself.
         spread = 0.0
-    gclip = jnp.clip(jobs.group, 0, num_groups - 1)
 
-    def compute_accept(state, choice, bids):
+    def compute_accept_g(state, choice, bids, jmem, jcpus, jgpus, jgroup,
+                         junique):
         """Which bids hosts accept: claimants in queue order while they
         still fit — sort bidders by (choice, rank), segmented cumsum of
-        demands. Pure; returns the accept mask. Any rank-prefix subset
-        of the result is also valid (dropping later-rank acceptances
-        only frees capacity)."""
+        demands. Pure; returns the accept mask. Works on ANY queue-
+        ordered row set (the full batch or a compact candidate prefix).
+        Any rank-prefix subset of the result is also valid (dropping
+        later-rank acceptances only frees capacity)."""
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
+        n = jmem.shape[0]
+        rk = jnp.arange(n)
         sort_host = jnp.where(bids, choice, H)  # non-bidders to the end
-        perm = jnp.lexsort((rank, sort_host))
+        perm = jnp.lexsort((rk, sort_host))
         p_host = sort_host[perm]
-        p_mem = jnp.where(bids[perm], jobs.mem[perm], 0.0)
-        p_cpus = jnp.where(bids[perm], jobs.cpus[perm], 0.0)
-        p_gpus = jnp.where(bids[perm], jobs.gpus[perm], 0.0)
+        p_mem = jnp.where(bids[perm], jmem[perm], 0.0)
+        p_cpus = jnp.where(bids[perm], jcpus[perm], 0.0)
+        p_gpus = jnp.where(bids[perm], jgpus[perm], 0.0)
         p_ones = bids[perm].astype(jnp.int32)
         cums = segment_cumsum(
             jnp.stack([p_mem, p_cpus, p_gpus, p_ones.astype(jnp.float32)], -1),
@@ -450,13 +454,13 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                        & (cums[:, 3] <= slots_left[ph]))
         # group-unique: only the first member of a (group, host) pair in
         # this round's acceptance list may land.
-        p_group = jobs.group[perm]
-        p_unique = jobs.unique_group[perm]
+        p_group = jgroup[perm]
+        p_unique = junique[perm]
         # key only matters for unique-group members; others are exempted
         # below via `| ~p_unique`.
         gh_key = jnp.where(p_unique, p_group * jnp.int32(H + 1) + ph, -1)
-        gperm = jnp.lexsort((jnp.arange(N), gh_key))
-        first_of_gh = jnp.zeros(N, bool).at[gperm].set(
+        gperm = jnp.lexsort((jnp.arange(n), gh_key))
+        first_of_gh = jnp.zeros(n, bool).at[gperm].set(
             jnp.concatenate([jnp.array([True]),
                              gh_key[gperm][1:] != gh_key[gperm][:-1]]))
         # ... and hosts already holding a member from a previous round
@@ -467,31 +471,41 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                          & (first_of_gh | ~p_unique)
                          & ~(p_unique & occupied))
 
-        return jnp.zeros(N, bool).at[perm].set(accept_sorted)
+        return jnp.zeros(n, bool).at[perm].set(accept_sorted)
 
-    def apply_accept(state, choice, accept):
+    def apply_accept_g(state, choice, accept, jmem, jcpus, jgpus, jgroup,
+                       junique, row_idx=None):
         """Commit accepted assignments: deplete host resources, record
-        hosts, fold group occupancy."""
+        hosts, fold group occupancy. row_idx maps compact rows back to
+        batch rows (None = rows ARE batch rows)."""
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
-        new_host = jnp.where(accept, choice, job_host)
+        if row_idx is None:
+            new_host = jnp.where(accept, choice, job_host)
+        else:
+            new_host = job_host.at[
+                jnp.where(accept, row_idx, N)].set(choice, mode="drop")
         acc_host = jnp.where(accept, choice, H)
         mem_left = mem_left - jax.ops.segment_sum(
-            jnp.where(accept, jobs.mem, 0.0), acc_host, num_segments=H + 1)[:H]
+            jnp.where(accept, jmem, 0.0), acc_host, num_segments=H + 1)[:H]
         cpus_left = cpus_left - jax.ops.segment_sum(
-            jnp.where(accept, jobs.cpus, 0.0), acc_host, num_segments=H + 1)[:H]
+            jnp.where(accept, jcpus, 0.0), acc_host, num_segments=H + 1)[:H]
         gpus_left = gpus_left - jax.ops.segment_sum(
-            jnp.where(accept, jobs.gpus, 0.0), acc_host, num_segments=H + 1)[:H]
+            jnp.where(accept, jgpus, 0.0), acc_host, num_segments=H + 1)[:H]
         slots_left = slots_left - jax.ops.segment_sum(
             accept.astype(jnp.int32), acc_host, num_segments=H + 1)[:H]
         # fold accepted unique-group placements into the occupancy map
-        gh_hit = (accept & jobs.unique_group)
-        group_occ = group_occ.at[gclip, jnp.clip(choice, 0, H - 1)].max(gh_hit)
+        gh_hit = (accept & junique)
+        group_occ = group_occ.at[
+            jnp.clip(jgroup, 0, num_groups - 1),
+            jnp.clip(choice, 0, H - 1)].max(gh_hit)
         return (new_host, mem_left, cpus_left, gpus_left, slots_left,
                 group_occ)
 
     def accept_bids(state, choice, bids):
-        return apply_accept(state, choice, compute_accept(state, choice,
-                                                          bids))
+        accept = compute_accept_g(state, choice, bids, jobs.mem, jobs.cpus,
+                                  jobs.gpus, jobs.group, jobs.unique_group)
+        return apply_accept_g(state, choice, accept, jobs.mem, jobs.cpus,
+                              jobs.gpus, jobs.group, jobs.unique_group)
 
     def _usable_hosts(mem_left, cpus_left, slots_left):
         # Non-gpu jobs never land on gpu hosts (constraints.clj:102-128),
@@ -555,29 +569,46 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         bids = window
         return accept_bids(state, choice, bids), None
 
+    D = min(dense_cap, N)
+
     def dense_round(carry, _):
         state, hopeless = carry
         job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
         unassigned = jobs.valid & (job_host == NO_HOST)
         # candidates: unassigned jobs not already PROVEN infeasible (a
         # failed dense argmax is a proof — capacity only shrinks).
-        # Fairness window: only the queue head of the candidates bids.
-        # Sized to what the remaining capacity could plausibly absorb
-        # (total headroom over the mean candidate demand, plus one slot
-        # per usable host): under contention the window stays tight so
-        # deep-queue jobs can't leapfrog, while abundant capacity opens
-        # it wide enough to never throttle throughput. Hopeless jobs
-        # drop out so the window always advances.
+        # The round works on the COMPACT first-D candidates in queue
+        # order, so its cost is (D, H) per round instead of (N, H) —
+        # which keeps the mop-up cheap even when a vmapped multi-pool
+        # cycle can't runtime-skip it (lax.cond lowers to select under
+        # vmap), and keeps it fair (a queue prefix, like the window).
         candidates = unassigned & ~hopeless
+        cpos = jnp.cumsum(candidates.astype(jnp.int32)) - 1
+        slot = jnp.where(candidates, jnp.minimum(cpos, D), D)
+        src = jnp.full(D + 1, N, jnp.int32).at[slot].set(
+            jnp.arange(N, dtype=jnp.int32), mode="drop")[:D]
+        in_use = src < N
+        srcc = jnp.clip(src, 0, N - 1)
+        c_mem = jobs.mem[srcc]
+        c_cpus = jobs.cpus[srcc]
+        c_gpus = jobs.gpus[srcc]
+        c_group = jobs.group[srcc]
+        c_unique = jobs.unique_group[srcc] & in_use
+        # Fairness window within the compact prefix: sized to what the
+        # remaining capacity could plausibly absorb (total headroom
+        # over the mean candidate demand, plus one slot per usable
+        # host): under contention the window stays tight so deep-queue
+        # jobs can't leapfrog, while abundant capacity opens it wide.
+        # Hopeless jobs drop out so the window always advances.
         dense_usable = (hosts.valid & (slots_left > 0)
                         & ((mem_left > 1e-6) | (cpus_left > 1e-6)
                            | (gpus_left > 1e-6)))
         K = jnp.sum(dense_usable.astype(jnp.int32))
-        n_cand = jnp.maximum(jnp.sum(candidates.astype(jnp.int32)), 1)
+        n_cand = jnp.maximum(jnp.sum(in_use.astype(jnp.int32)), 1)
         mean_mem = jnp.maximum(
-            jnp.sum(jnp.where(candidates, jobs.mem, 0.0)) / n_cand, 1e-6)
+            jnp.sum(jnp.where(in_use, c_mem, 0.0)) / n_cand, 1e-6)
         mean_cpus = jnp.maximum(
-            jnp.sum(jnp.where(candidates, jobs.cpus, 0.0)) / n_cand, 1e-6)
+            jnp.sum(jnp.where(in_use, c_cpus, 0.0)) / n_cand, 1e-6)
         absorb = jnp.sum(jnp.where(
             dense_usable,
             jnp.minimum(mem_left / mean_mem, cpus_left / mean_cpus), 0.0))
@@ -585,46 +616,48 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         # candidates) can push absorb past 2^31 and an overflowing cast
         # would wrap W negative, silencing every dense bid
         W = K + jnp.minimum(absorb, jnp.float32(N)).astype(jnp.int32)
-        upos = jnp.cumsum(candidates.astype(jnp.int32)) - 1
-        window = candidates & (upos < W)
+        window = in_use & (jnp.arange(D) < W)
 
+        c_forb = forbidden[srcc] | ~in_use[:, None]
         if use_pallas:
             jobs_packed = pallas_match.pack_jobs(
-                jobs.mem, jobs.cpus, jobs.gpus, candidates,
-                jobs.unique_group)
+                c_mem, c_cpus, c_gpus, in_use, c_unique)
             hosts_packed = pallas_match.pack_hosts(
                 mem_left, cpus_left, gpus_left, hosts.cap_mem,
                 hosts.cap_cpus, hosts.cap_gpus, slots_left, hosts.valid,
                 group_occ[0])
             best_fit, best = pallas_match.best_host(
-                jobs_packed, hosts_packed, forb_u8, bonus,
+                jobs_packed, hosts_packed, c_forb.astype(jnp.uint8),
+                None if bonus is None else bonus[srcc],
                 interpret=pallas_interpret, spread=spread)
             choice = jnp.clip(best, 0, H - 1)
             has_feasible = best_fit > -0.5
-            hopeless = hopeless | (candidates & ~has_feasible)
-            bids = window & has_feasible
         else:
-            ok = _feasible(jobs.mem[:, None], jobs.cpus[:, None],
-                           jobs.gpus[:, None],
+            ok = _feasible(c_mem[:, None], c_cpus[:, None],
+                           c_gpus[:, None],
                            mem_left[None, :], cpus_left[None, :],
                            gpus_left[None, :],
                            hosts.cap_gpus[None, :], hosts.valid[None, :],
-                           slots_left[None, :], forbidden)
-            ok &= candidates[:, None]
+                           slots_left[None, :], c_forb)
+            ok &= in_use[:, None]
             # group-unique vs assignments from previous rounds
-            ok &= ~(jobs.unique_group[:, None] & group_occ[gclip])
-            fit = _fitness(jobs.mem[:, None], jobs.cpus[:, None],
+            ok &= ~(c_unique[:, None]
+                    & group_occ[jnp.clip(c_group, 0, num_groups - 1)])
+            fit = _fitness(c_mem[:, None], c_cpus[:, None],
                            mem_left[None, :], cpus_left[None, :],
                            hosts.cap_mem[None, :], hosts.cap_cpus[None, :])
             if bonus is not None:
-                fit = fit + bonus
+                fit = fit + bonus[srcc]
             # Deterministic per-(job, host) jitter spreads bids across
             # hosts within `spread` of each job's best fitness — without
             # it every job argmaxes the same most-utilized host and a
             # round lands only one host's prefix. Fenzo accepts any host
             # with fitness >= good-enough-fitness 0.8 (config.clj:337),
             # so a 0.2 preference band is the reference's own slack.
-            z = (rank.astype(jnp.uint32)[:, None] * jnp.uint32(2654435761)
+            # Keyed by the compact slot index — identical to the pallas
+            # kernel's program-id keying, so both paths jitter the same.
+            z = (jnp.arange(D, dtype=jnp.uint32)[:, None]
+                 * jnp.uint32(2654435761)
                  + jnp.arange(H, dtype=jnp.uint32)[None, :] * jnp.uint32(40503))
             z = z ^ (z >> 15)
             z = z * jnp.uint32(2246822519)
@@ -633,11 +666,17 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
                 / 65536.0 * spread
             fit = jnp.where(ok, fit + noise, -1.0)
             choice = jnp.argmax(fit, axis=1)
-            has_feasible = fit[rank, choice] > -0.5
-            hopeless = hopeless | (candidates & ~has_feasible)
-            bids = window & has_feasible
-
-        return (accept_bids(state, choice, bids), hopeless), None
+            has_feasible = fit[jnp.arange(D), choice] > -0.5
+        # a compact candidate with no feasible host is proven hopeless
+        hopeless = hopeless.at[
+            jnp.where(in_use & ~has_feasible, src, N)].set(
+                True, mode="drop")
+        bids = window & has_feasible
+        accept = compute_accept_g(state, choice, bids, c_mem, c_cpus,
+                                  c_gpus, c_group, c_unique)
+        state = apply_accept_g(state, choice, accept, c_mem, c_cpus,
+                               c_gpus, c_group, c_unique, row_idx=src)
+        return (state, hopeless), None
 
     state = (varying_full(jobs.valid, NO_HOST, (N,), jnp.int32),
              hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots,
@@ -665,22 +704,46 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     if rounds > 0:
         state = window_round(state)
     if rounds > 1:
-        state, _ = jax.lax.scan(pairing_round, state,
-                                jnp.arange(1, rounds, dtype=jnp.int32))
-    if dense_rounds > 0:
-        # Skip the N x H dense passes at runtime when nothing is left to
-        # place. Any unassigned valid job keeps them on — plain
-        # stragglers water-fill couldn't pair (e.g. big on both axes
-        # with only single-axis room left) still deserve the exact
-        # argmax before the cycle gives up on them.
-        def run_dense(s):
-            (s, _), _ = jax.lax.scan(
-                dense_round, (s, hopeless0), None, length=dense_rounds)
-            return s
+        # while_loop, not scan: a pairing round with no remaining
+        # plain-unassigned jobs is skipped at RUNTIME. Under vmap
+        # (single-device multi-pool stacks) the batched while_loop runs
+        # until every pool's predicate clears, masking finished pools —
+        # so the cost is the max rounds any pool actually needs, where
+        # a scan (or lax.cond, which lowers to select under vmap) would
+        # always pay for all of them.
+        def pairing_cond(c):
+            st, i = c
+            return (i < rounds) & jnp.any(plain & (st[0] == NO_HOST)
+                                          & ~hopeless0)
 
-        need_dense = jnp.any(jobs.valid & (state[0] == NO_HOST)
-                             & ~hopeless0)
-        state = jax.lax.cond(need_dense, run_dense, lambda s: s, state)
+        def pairing_body(c):
+            st, i = c
+            st, _ = pairing_round(st, i)
+            return (st, i + 1)
+
+        state, _ = jax.lax.while_loop(
+            pairing_cond, pairing_body,
+            (state, jnp.int32(1) + (jobs.mem[0] * 0).astype(jnp.int32)))
+    if dense_rounds > 0:
+        # same runtime skip for the dense mop-up: any unassigned valid
+        # non-hopeless job keeps it running — plain stragglers
+        # water-fill couldn't pair (e.g. big on both axes with only
+        # single-axis room left) still deserve the exact argmax before
+        # the cycle gives up on them.
+        def dense_cond(c):
+            st, hopeless, i = c
+            return (i < dense_rounds) & jnp.any(
+                jobs.valid & (st[0] == NO_HOST) & ~hopeless)
+
+        def dense_body(c):
+            st, hopeless, i = c
+            (st, hopeless), _ = dense_round((st, hopeless), None)
+            return (st, hopeless, i + 1)
+
+        state, _, _ = jax.lax.while_loop(
+            dense_cond, dense_body,
+            (state, hopeless0,
+             jnp.int32(0) + (jobs.mem[0] * 0).astype(jnp.int32)))
     job_host, mem_left, cpus_left, gpus_left, slots_left, _ = state
     return MatchResult(job_host, mem_left, cpus_left, gpus_left, slots_left)
 

@@ -440,14 +440,20 @@ class CookApi:
         # identical essential spec just commits those instead of 409ing.
         resubmits = []
         dupes = []
+        def same_spec(a: Job, b: Job) -> bool:
+            # the FULL essential spec must match — a resubmission that
+            # changed any resource/placement field is a new request and
+            # must 409 instead of silently committing the stale spec
+            return all(getattr(a, f) == getattr(b, f) for f in (
+                "user", "command", "mem", "cpus", "gpus", "priority",
+                "pool", "env", "labels", "constraints", "group",
+                "max_retries", "ports", "container", "checkpoint"))
+
         for j in jobs:
             existing = self.store.jobs.get(j.uuid)
             if existing is None:
                 continue
-            if (not existing.committed and existing.user == j.user
-                    and existing.command == j.command
-                    and existing.mem == j.mem
-                    and existing.cpus == j.cpus):
+            if not existing.committed and same_spec(existing, j):
                 resubmits.append(j.uuid)
             else:
                 dupes.append(j.uuid)

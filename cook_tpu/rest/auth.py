@@ -50,9 +50,14 @@ class AuthConfig:
     agent_token_previous: str = ""
 
     def agent_token_ok(self, presented: str) -> bool:
-        return presented == self.agent_token or (
-            bool(self.agent_token_previous)
-            and presented == self.agent_token_previous)
+        import hmac
+        ok = hmac.compare_digest(presented, self.agent_token)
+        if self.agent_token_previous:
+            # no short-circuit: both comparisons always run
+            ok_prev = hmac.compare_digest(presented,
+                                          self.agent_token_previous)
+            ok = ok or ok_prev
+        return ok
 
 
 def authenticate(cfg: AuthConfig, headers: dict) -> str:

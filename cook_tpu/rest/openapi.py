@@ -90,9 +90,12 @@ def build_spec(router, title: str = "cook_tpu scheduler API",
         doc = (handler.__doc__ or "").strip()
         summary = doc.split("\n", 1)[0][:120] if doc else \
             f"{method} {pattern}"
+        slug = re.sub(r"[^a-zA-Z0-9]+", "_", pattern).strip("_") or "root"
         op: dict[str, Any] = {
             "summary": summary,
-            "operationId": f"{method.lower()}_{handler.__name__}",
+            # path slug keeps operationIds unique when one handler
+            # serves several routes (OpenAPI 3.0 uniqueness rule)
+            "operationId": f"{method.lower()}_{slug}",
             "responses": {"200": {"description": "success"},
                           "4XX": {"description": "client error"},
                           "503": {"description":

@@ -101,7 +101,10 @@ PEND_I32 = ("user", "priority", "start_time", "group", "ports",
 RUN_F32 = ("mem", "cpus", "gpus", "mem_share", "cpus_share", "gpu_share")
 RUN_I32 = ("user", "priority", "start_time", "valid")
 FORB_CHUNK = 256
-CREDIT_CHUNK = 512
+# one cycle's completions can easily touch >512 distinct hosts at
+# 10k-host scale; the chunk must cover the steady state so the fused
+# dispatch stays the only one per cycle
+CREDIT_CHUNK = 2048
 
 
 def _apply_pend(pend, idx, pf, pi):
