@@ -285,7 +285,7 @@ def bench_cycle(R=10_000, P=100_000, H=10_000, U=500, C=8_192,
         "sync_rtt_ms": round(sync_rtt_ms, 2),
         "compile_s": round(compile_s, 1),
         "device": str(dev),
-    }))
+    }), flush=True)
 
 
 def bench_pools(n_pools=8, R=1_250, P=12_500, H=1_250, U=100, C=1_024):
@@ -341,7 +341,7 @@ def bench_pools(n_pools=8, R=1_250, P=12_500, H=1_250, U=100, C=1_024):
         "matched_per_cycle": matched,
         "compile_s": round(compile_s, 1),
         "device": str(dev),
-    }))
+    }), flush=True)
 
 
 def bench_rebalance(T0=50_000, P=64, H=2_000, U=500):
@@ -432,7 +432,7 @@ def bench_rebalance(T0=50_000, P=64, H=2_000, U=500):
         "capped8192_preempted": int(np.asarray(res_c.preempted).sum()),
         "compile_s": round(compile_s, 1),
         "device": str(dev),
-    }))
+    }), flush=True)
 
 
 def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
@@ -478,7 +478,7 @@ def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
         "wall_s": round(wall, 1),
         "day_compression": round(86_400.0 / wall, 1),
         "device": str(dev),
-    }))
+    }), flush=True)
 
 
 def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
@@ -612,7 +612,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
         "cycles": len(wall),
         "wall_s": round(total_s, 1),
         "device": str(jax.devices()[0]),
-    }))
+    }), flush=True)
 
 
 def bench_pallas():
@@ -663,7 +663,7 @@ def bench_pallas():
                          "(>1 = pallas faster)",
         **out,
         "device": str(dev),
-    }))
+    }), flush=True)
 
 
 def main():

@@ -106,7 +106,12 @@ class SchedulerSettings:
     rebalancer_max_preemption: int = 64
     rebalancer_candidate_cap: int = 0   # 0 = exact; >0 = top-K victims
     sequential_match_threshold: int = 2048
-    use_pallas: bool = False            # fused TPU kernel for dense rounds
+    use_pallas: bool = False            # fused TPU kernels (measured
+    #                                     parity on v5e; see benchmarks)
+    # device-resident match path: tensors stay on device, the host
+    # ships store-event deltas (scheduler/resident.py). Requires no
+    # launch plugins / data locality / estimated-completion.
+    resident_match: bool = False
     # hash-sharded in-order status executors (scheduler.clj:1524-1546);
     # 0 = inline on the backend callback thread
     status_shards: int = 19

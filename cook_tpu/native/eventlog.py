@@ -50,6 +50,13 @@ class NativeLogWriter:
         self._h = lib.el_open(path.encode())
         if self._h == 0:
             raise OSError(f"el_open failed for {path}")
+        # weakref.finalize (NOT __del__): it runs at interpreter exit
+        # even when the object is still reachable or gc.freeze()-pinned
+        # — the C++ syncer thread MUST be joined before static
+        # destruction or std::terminate aborts the process
+        import weakref
+        self._finalizer = weakref.finalize(self, _close_handle, lib,
+                                           self._h)
 
     def append(self, line: str) -> None:
         b = line.encode()
@@ -67,14 +74,15 @@ class NativeLogWriter:
 
     def close(self) -> None:
         if self._h:
-            self._lib.el_close(self._h)
+            self._finalizer()   # idempotent: first call closes
             self._h = 0
 
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+
+def _close_handle(lib, h) -> None:
+    try:
+        lib.el_close(h)
+    except Exception:
+        pass
 
 
 def make_log_writer(path: str):

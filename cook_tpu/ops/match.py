@@ -418,8 +418,10 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
     # exclusions. Everyone else (gpu jobs, constrained jobs, all jobs
     # under a locality bonus) goes through the dense rounds.
     plain = jobs.valid & (jobs.gpus <= 0) & ~jnp.any(forbidden, axis=1)
+    gpu_plain = jobs.valid & (jobs.gpus > 0) & ~jnp.any(forbidden, axis=1)
     if bonus is not None:
         plain &= False
+        gpu_plain &= False
         # The jitter exists to de-collapse pure bin-packing ties; a
         # locality bonus is a real preference (weight ~0.25,
         # data_locality.clj:192) that noise of similar magnitude would
@@ -534,6 +536,35 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         cc = jnp.cumsum(jnp.where(unassigned, jobs.cpus, 0.0))
         slot = jnp.maximum(jnp.searchsorted(cum_mem, cm, side="left"),
                            jnp.searchsorted(cum_cpus, cc, side="left"))
+        choice = order[jnp.clip(slot, 0, H - 1)]
+        bids = unassigned & (slot < H) & o_usable[jnp.clip(slot, 0, H - 1)]
+        return accept_bids(state, choice, bids)
+
+    def gpu_window_round(state):
+        # Mass placement for UNconstrained gpu jobs — the gpu analog of
+        # window_round with a third (gpus) cumulative window. Without
+        # it, large gpu batches reach the hosts only through the dense
+        # argmax rounds, whose bids collapse onto the fitness-top band
+        # of hosts and place just a band's worth per round.
+        job_host, mem_left, cpus_left, gpus_left, slots_left, group_occ = state
+        unassigned = gpu_plain & (job_host == NO_HOST) & ~hopeless0
+        usable = (hosts.valid & (slots_left > 0) & (hosts.cap_gpus > 0)
+                  & (mem_left > 1e-6) & (cpus_left > 1e-6)
+                  & (gpus_left > 1e-6))
+        util = _fitness(0.0, 0.0, mem_left, cpus_left,
+                        hosts.cap_mem, hosts.cap_cpus)
+        order = jnp.argsort(jnp.where(usable, -util, BIG))
+        o_usable = usable[order]
+        cum_mem = jnp.cumsum(jnp.where(o_usable, mem_left[order], 0.0))
+        cum_cpus = jnp.cumsum(jnp.where(o_usable, cpus_left[order], 0.0))
+        cum_gpus = jnp.cumsum(jnp.where(o_usable, gpus_left[order], 0.0))
+        cm = jnp.cumsum(jnp.where(unassigned, jobs.mem, 0.0))
+        cc = jnp.cumsum(jnp.where(unassigned, jobs.cpus, 0.0))
+        cg = jnp.cumsum(jnp.where(unassigned, jobs.gpus, 0.0))
+        slot = jnp.maximum(
+            jnp.maximum(jnp.searchsorted(cum_mem, cm, side="left"),
+                        jnp.searchsorted(cum_cpus, cc, side="left")),
+            jnp.searchsorted(cum_gpus, cg, side="left"))
         choice = order[jnp.clip(slot, 0, H - 1)]
         bids = unassigned & (slot < H) & o_usable[jnp.clip(slot, 0, H - 1)]
         return accept_bids(state, choice, bids)
@@ -703,6 +734,22 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
             head_jobs.valid & (head_hosts == NO_HOST))
     if rounds > 0:
         state = window_round(state)
+
+        # gpu mass placement: up to `rounds` water-fill passes, skipped
+        # at runtime (and per-pool under vmap) when no unconstrained
+        # gpu jobs remain
+        def gpu_cond(c):
+            st, i = c
+            return (i < rounds) & jnp.any(gpu_plain & (st[0] == NO_HOST)
+                                          & ~hopeless0)
+
+        def gpu_body(c):
+            st, i = c
+            return (gpu_window_round(st), i + 1)
+
+        state, _ = jax.lax.while_loop(
+            gpu_cond, gpu_body,
+            (state, jnp.int32(0) + (jobs.mem[0] * 0).astype(jnp.int32)))
     if rounds > 1:
         # while_loop, not scan: a pairing round with no remaining
         # plain-unassigned jobs is skipped at RUNTIME. Under vmap
@@ -730,9 +777,22 @@ def match_rounds(jobs: Jobs, hosts: Hosts, forbidden: jnp.ndarray,
         # water-fill couldn't pair (e.g. big on both axes with only
         # single-axis room left) still deserve the exact argmax before
         # the cycle gives up on them.
+        #
+        # Iteration bound: non-plain jobs (gpu/constrained/bonus) place
+        # ONLY through the head + these rounds, and each round resolves
+        # at most D compact candidates — so the bound must cover
+        # ceil(N/D) passes or a large non-plain batch would be
+        # throughput-capped at dense_rounds*D per cycle despite free
+        # capacity. The any-work-remaining predicate keeps the extra
+        # allowance free when it isn't needed (zero idle rounds run);
+        # every round resolves each compact candidate (accept, hopeless
+        # mark, or host saturation that ends in hopeless), so the loop
+        # drains.
+        max_dense = max(dense_rounds, -(-N // D) + 2)
+
         def dense_cond(c):
             st, hopeless, i = c
-            return (i < dense_rounds) & jnp.any(
+            return (i < max_dense) & jnp.any(
                 jobs.valid & (st[0] == NO_HOST) & ~hopeless)
 
         def dense_body(c):

@@ -133,8 +133,21 @@ class PluginRegistry:
     pool_selector: PoolSelector = None
     adjuster: JobAdjuster = None
     file_url: FileUrlGenerator = None
+    # names of the slots actually customized, DERIVED from which fields
+    # were passed (not trusted from the caller): the device-resident
+    # match path is compatible with every DEFAULT (no-op) plugin but
+    # must refuse any registry that hooks the per-cycle launch filter
+    # or adjuster — however it was constructed
+    custom: frozenset = frozenset()
+
+    def affects_match_cycle(self) -> bool:
+        return bool(self.custom & {"launch", "adjuster"})
 
     def __post_init__(self):
+        self.custom = frozenset(
+            name for name in ("submission", "launch", "completion",
+                              "pool_selector", "adjuster", "file_url")
+            if getattr(self, name) is not None)
         self.submission = self.submission or SubmissionValidator()
         self.launch = self.launch or CachedLaunchFilter(LaunchFilter())
         self.completion = self.completion or CompletionHandler()

@@ -51,11 +51,15 @@ class AuthConfig:
 
     def agent_token_ok(self, presented: str) -> bool:
         import hmac
-        ok = hmac.compare_digest(presented, self.agent_token)
+        # bytes, not str: compare_digest raises on non-ASCII str input,
+        # and the header value is attacker-controlled — a weird byte
+        # must be a 401, not a TypeError-turned-500
+        p = presented.encode("utf-8", "surrogateescape")
+        ok = hmac.compare_digest(p, self.agent_token.encode())
         if self.agent_token_previous:
             # no short-circuit: both comparisons always run
-            ok_prev = hmac.compare_digest(presented,
-                                          self.agent_token_previous)
+            ok_prev = hmac.compare_digest(
+                p, self.agent_token_previous.encode())
             ok = ok or ok_prev
         return ok
 

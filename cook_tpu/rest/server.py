@@ -255,6 +255,13 @@ def build_scheduler(config, read_only=False):
         checkpoint_defaults=config.checkpoint or None,
         status_shards=s.status_shards)
 
+    # device-resident match path (scheduler/resident.py): per-pool
+    # opt-in via config; incompatible configs (plugins, data locality,
+    # estimated completion) fail fast at startup rather than per cycle
+    if s.resident_match:
+        for p in pools.active():
+            coord.enable_resident(p.name, synchronous=False)
+
     # optimizer cycle (start-optimizer-cycles! mesos.clj:216,
     # optimizer.clj:115): config {"optimizer": {"optimizer": "pkg:fn",
     # "host_feed": "pkg:fn", "interval_s": 30}} — or the built-in

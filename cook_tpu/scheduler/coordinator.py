@@ -367,12 +367,17 @@ class Coordinator:
         synchronous=False decouples launch writeback onto a consumer
         thread (production/bench mode); True consumes inline
         (deterministic, for tests and the simulator)."""
-        if self.plugins is not None or self.data_locality is not None \
+        plugins_block = (
+            self.plugins is not None
+            and (not hasattr(self.plugins, "affects_match_cycle")
+                 or self.plugins.affects_match_cycle()))
+        if plugins_block or self.data_locality is not None \
                 or self.config.estimated_completion.enabled:
             raise ValueError(
-                "resident match path does not support launch plugins, "
-                "data-locality bonuses, or the estimated-completion "
-                "constraint; keep the legacy cycle for this config")
+                "resident match path does not support per-cycle launch "
+                "filter/adjuster plugins, data-locality bonuses, or the "
+                "estimated-completion constraint; keep the legacy cycle "
+                "for this config")
         from cook_tpu.scheduler.resident import ResidentPool
         pool = pool or self.pools.default_pool
         if not hasattr(self, "_resident"):

@@ -326,3 +326,27 @@ def test_ha_failover_end_to_end(tmp_path):
     stats = coord_b.match_cycle()
     assert stats.matched == 4            # the 4 surviving jobs run
     el_b.stop()
+
+
+def test_server_resident_match_config(tmp_path):
+    """scheduler.resident_match wires the device-resident path into the
+    built coordinator for every active pool."""
+    from cook_tpu.config import Settings
+    from cook_tpu.rest.server import build_scheduler
+
+    cfg = Settings.from_dict({
+        "scheduler": {"resident_match": True},
+        "clusters": [{"kind": "mock", "name": "m", "hosts": 2}],
+    })
+    store, coord, api = build_scheduler(cfg)
+    try:
+        assert "default" in coord._resident
+        from cook_tpu.state.model import Job, new_uuid
+        job = Job(uuid=new_uuid(), user="alice", command="true",
+                  mem=64.0, cpus=1.0)
+        store.create_jobs([job])
+        coord.match_cycle()
+        coord.drain_resident()
+        assert job.state.value == "running"
+    finally:
+        coord.stop()

@@ -286,3 +286,23 @@ def test_match_scan_at_scale_zero_inversions():
     jh = np.asarray(res.job_host)
     assert (jh >= 0).all()
     assert len(match_ops.inversion_positions_np(jb, hb, forb, jh)) == 0
+
+
+def test_dense_only_jobs_not_throughput_capped():
+    """GPU jobs place ONLY through the head + dense rounds; with free
+    capacity, a batch larger than dense_rounds*dense_cap must still
+    fully place in ONE cycle (the while_loop's iteration allowance
+    covers ceil(N/D) passes — review r3 finding)."""
+    N, H = 8192, 1024
+    jb = match_ops.make_jobs(
+        mem=np.full(N, 10.0, np.float32),
+        cpus=np.full(N, 1.0, np.float32),
+        gpus=np.ones(N, np.float32))
+    hb = match_ops.make_hosts(
+        mem=np.full(H, 200.0, np.float32),
+        cpus=np.full(H, 20.0, np.float32),
+        gpus=np.full(H, 16.0, np.float32))
+    forb = jnp.zeros((N, H), bool)
+    res = match_ops.match_rounds(jb, hb, forb)
+    matched = int((np.asarray(res.job_host) >= 0).sum())
+    assert matched == N, f"only {matched}/{N} gpu jobs placed"

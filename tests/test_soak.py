@@ -142,3 +142,86 @@ def test_soak_random_ops(seed):
     # instance
     for j in pending:
         assert not j.active_instances
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_soak_random_ops_resident(seed):
+    """The same chaotic op soak, through the device-resident match path
+    (async consumer): every invariant must hold despite the one-cycle
+    readback lag, capacity credits, and row cooling."""
+    rng = np.random.default_rng(1000 + seed)
+    hosts = [
+        MockHost(f"h{i}", mem=float(rng.integers(100, 400)),
+                 cpus=float(rng.integers(8, 32)),
+                 gpus=float(rng.integers(0, 2) * 4),
+                 attributes={"rack": f"r{i % 3}"},
+                 port_range=(31000, 31000 + int(rng.integers(3, 20))))
+        for i in range(6)
+    ]
+    store = JobStore()
+    cluster = MockCluster(
+        hosts,
+        runtime_fn=lambda spec: (float(rng.uniform(5, 120)),
+                                 bool(rng.random() < 0.8),
+                                 None if rng.random() < 0.8 else 1003),
+        bulk_status=True)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(
+        store, reg,
+        config=SchedulerConfig(
+            rebalancer=RebalancerParams(safe_dru_threshold=0.2,
+                                        min_dru_diff=0.05,
+                                        max_preemption=8)))
+    coord.shares.set("default", "default", mem=200.0, cpus=20.0)
+    coord.enable_resident(synchronous=False, resync_interval=37)
+
+    users = ["alice", "bob", "carol", "dan"]
+    all_jobs: list[Job] = []
+    try:
+        for step in range(50):
+            op = rng.random()
+            if op < 0.35:
+                batch = []
+                for _ in range(int(rng.integers(1, 8))):
+                    batch.append(Job(
+                        uuid=new_uuid(), user=str(rng.choice(users)),
+                        command="true",
+                        mem=float(rng.integers(5, 80)),
+                        cpus=float(rng.integers(1, 6)),
+                        gpus=(float(rng.integers(1, 3))
+                              if rng.random() < 0.15 else 0.0),
+                        ports=int(rng.integers(0, 4)),
+                        max_retries=int(rng.integers(1, 3)),
+                        constraints=([("rack", "EQUALS",
+                                       f"r{int(rng.integers(3))}")]
+                                     if rng.random() < 0.2 else []),
+                    ))
+                store.create_jobs(batch)
+                all_jobs.extend(batch)
+            elif op < 0.5 and all_jobs:
+                victim = all_jobs[int(rng.integers(len(all_jobs)))]
+                if victim.state != JobState.COMPLETED:
+                    for tid in store.kill_job(victim.uuid):
+                        cluster.kill_task(tid)
+            elif op < 0.65:
+                cluster.advance(float(rng.uniform(1, 60)))
+            elif op < 0.8:
+                coord.rebalance_cycle()
+            elif op < 0.9:
+                coord.watchdog_cycle()
+            coord.match_cycle()
+            if step % 7 == 6:
+                coord.drain_resident()
+                check_invariants(store, cluster)
+
+        for _ in range(60):
+            cluster.advance(120.0)
+            coord.match_cycle()
+        coord.drain_resident()
+        check_invariants(store, cluster)
+        running = [j for j in all_jobs if j.state == JobState.RUNNING
+                   and not j.active_instances]
+        assert not running
+    finally:
+        coord.stop()
