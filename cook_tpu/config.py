@@ -151,6 +151,11 @@ class Settings:
     # {user_submit|user_launch|global_launch: RateLimitSettings}
     log_path: Optional[str] = None
     snapshot_path: Optional[str] = None
+    # periodic checkpoint + log compaction (leader-only; 0 disables).
+    # When the event log exceeds log_rotate_lines, snapshot + rotate
+    # (JobStore.rotate_log) instead of snapshotting alongside.
+    snapshot_interval_s: float = 300.0
+    log_rotate_lines: int = 1_000_000
     leader_lock_path: Optional[str] = None   # None = standalone leader
     # distributed HA via Kubernetes Lease objects (no shared FS): point
     # at an apiserver and every candidate races for the named lease

@@ -395,6 +395,31 @@ def main(argv=None) -> None:
 
         threading.Thread(target=monitor_loop, daemon=True).start()
 
+        if settings.snapshot_path and settings.log_path \
+                and settings.snapshot_interval_s > 0:
+            # periodic checkpoint + log compaction (the role Datomic's
+            # indexing/gc plays for the reference): snapshot on a
+            # cadence, rotate the log once it outgrows the threshold.
+            # Leader-only — every write inside is append-gate fenced,
+            # and followers absorb a rotation via their shrink-resync.
+            def snapshot_loop():
+                while True:
+                    time.sleep(settings.snapshot_interval_s)
+                    if not _still_leader():
+                        continue
+                    try:
+                        lines = store._log.lines() if store._log else 0
+                        if lines >= settings.log_rotate_lines > 0:
+                            store.rotate_log(settings.snapshot_path)
+                            log.info("rotated event log at %d lines",
+                                     lines)
+                        else:
+                            store.snapshot(settings.snapshot_path)
+                    except Exception:
+                        log.exception("snapshot/rotate failed")
+
+            threading.Thread(target=snapshot_loop, daemon=True).start()
+
     if args.no_cycles:
         # API-only read replica (the reference's api-only config role,
         # minus live writes: the reference's api-only nodes share

@@ -276,6 +276,8 @@ class ResidentPool:
         co, pool = self.coord, self.pool
         store = co.store
         self._share_cache = {}
+        self._fill_batch: dict = {}
+        self._run_batch: dict = {}
         # host universe from current offers (one O(H) pass, only at
         # resync; per-cycle host state lives on device)
         offers = []
@@ -357,6 +359,8 @@ class ResidentPool:
             self._consumed_res[inst.task_id] = (
                 hid, self.coord._effective_mem(job), job.cpus, job.gpus,
                 1, job.ports)
+        self._flush_fill_batch()
+        self._flush_run_batch()
         # device state: upload mirrors wholesale (resync only)
         dev = jax.devices()[0]
         self.state = jax.device_put({
@@ -385,6 +389,23 @@ class ResidentPool:
         return row
 
     def _fill_pend(self, row: int, job) -> None:
+        """Write (or queue) one pending job's mirror row. Unconstrained
+        jobs with no mask slot to release take the BATCH path — a dict
+        of row -> job flushed vectorized at the end of the drain, which
+        is several times cheaper than per-row numpy scalar stores at
+        thousands of churned rows per cycle. Constrained jobs (mask
+        rows) and rows holding a stale mask slot go scalar."""
+        m = self._pend_m
+        if m["forb_slot"][row] < 0 and not self._constrained(job):
+            self._fill_batch[row] = job
+            return
+        self._fill_batch_pop(row)
+        self._fill_pend_scalar(row, job)
+
+    def _fill_batch_pop(self, row: int) -> None:
+        self._fill_batch.pop(row, None)
+
+    def _fill_pend_scalar(self, row: int, job) -> None:
         co = self.coord
         m = self._pend_m
         m["user"][row] = co.interner.id(job.user)
@@ -426,6 +447,36 @@ class ResidentPool:
             self._forb_rows_m[slot, len(self.host_names):] = True
             self._dirty_forb.add(slot)
 
+    def _flush_fill_batch(self) -> None:
+        batch = self._fill_batch
+        if not batch:
+            return
+        self._fill_batch = {}
+        co = self.coord
+        m = self._pend_m
+        rows = np.fromiter(batch.keys(), np.int64, len(batch))
+        jobs = list(batch.values())
+        iid = co.interner.id
+        m["user"][rows] = [iid(j.user) for j in jobs]
+        m["mem"][rows] = [co._effective_mem(j) for j in jobs]
+        m["cpus"][rows] = [j.cpus for j in jobs]
+        m["gpus"][rows] = [j.gpus for j in jobs]
+        m["priority"][rows] = [j.priority for j in jobs]
+        m["start_time"][rows] = [(j.submit_time_ms // 1000) % (2 ** 30)
+                                 for j in jobs]
+        m["valid"][rows] = True
+        shares = [self._share_cached(j.user) for j in jobs]
+        m["mem_share"][rows] = [s[0] for s in shares]
+        m["cpus_share"][rows] = [s[1] for s in shares]
+        m["gpu_share"][rows] = [s[2] for s in shares]
+        m["ports"][rows] = [j.ports for j in jobs]
+        gids = self._group_ids
+        m["group"][rows] = [
+            (gids.setdefault(j.group, len(gids)) if j.group is not None
+             else -1) for j in jobs]
+        m["unique_group"][rows] = False   # batch path = unconstrained
+        # forb_slot already < 0 for every batch row (path precondition)
+
     def _constrained(self, job) -> bool:
         co = self.coord
         if job.constraints or job.uuid in co.reservations:
@@ -457,6 +508,7 @@ class ResidentPool:
         row = self.pend_row.pop(uuid, None)
         if row is None:
             return
+        self._fill_batch_pop(row)   # a queued fill must not resurrect it
         m = self._pend_m
         m["valid"][row] = False
         self._dirty_pend.add(row)
@@ -474,6 +526,10 @@ class ResidentPool:
             raise _NeedResync("running capacity exceeded")
         row = self._run_free.pop()
         self.run_row[inst.task_id] = row
+        self._run_batch[row] = (inst, job)
+        return row
+
+    def _fill_run_scalar(self, row: int, inst, job) -> None:
         m = self._run_m
         co = self.coord
         m["user"][row] = co.interner.id(job.user)
@@ -487,7 +543,29 @@ class ResidentPool:
         m["mem_share"][row] = ms
         m["cpus_share"][row] = cs
         m["gpu_share"][row] = gs
-        return row
+
+    def _flush_run_batch(self) -> None:
+        batch = self._run_batch
+        if not batch:
+            return
+        self._run_batch = {}
+        co = self.coord
+        m = self._run_m
+        rows = np.fromiter(batch.keys(), np.int64, len(batch))
+        pairs = list(batch.values())
+        iid = co.interner.id
+        m["user"][rows] = [iid(j.user) for _, j in pairs]
+        m["mem"][rows] = [j.mem for _, j in pairs]
+        m["cpus"][rows] = [j.cpus for _, j in pairs]
+        m["gpus"][rows] = [j.gpus for _, j in pairs]
+        m["priority"][rows] = [j.priority for _, j in pairs]
+        m["start_time"][rows] = [(i.start_time_ms // 1000) % (2 ** 30)
+                                 for i, _ in pairs]
+        m["valid"][rows] = True
+        shares = [self._share_cached(j.user) for _, j in pairs]
+        m["mem_share"][rows] = [s[0] for s in shares]
+        m["cpus_share"][rows] = [s[1] for s in shares]
+        m["gpu_share"][rows] = [s[2] for s in shares]
 
     def _share_cached(self, user: str):
         """Per-cycle share lookup cache (share values repeat across the
@@ -503,6 +581,7 @@ class ResidentPool:
         row = self.run_row.pop(task_id, None)
         if row is None:
             return
+        self._run_batch.pop(row, None)
         self._run_m["valid"][row] = False
         self._dirty_run.add(row)
         self._cooling.append((self.cycle_no, "run", row))
@@ -674,6 +753,10 @@ class ResidentPool:
                     if job is not None and self._constrained(job):
                         self._fill_pend(self.pend_row[ju], job)
                         self._dirty_pend.add(self.pend_row[ju])
+        # vectorized flush of every queued row fill — mirrors must be
+        # final before the deltas pack them
+        self._flush_fill_batch()
+        self._flush_run_batch()
         deltas = {
             "pend": sorted(self._dirty_pend),
             "run": sorted(self._dirty_run),

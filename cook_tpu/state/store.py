@@ -518,18 +518,73 @@ class JobStore:
     # snapshot / replay (checkpoint-resume; the restarted-leader path)
     def snapshot(self, path: str) -> None:
         """Atomic snapshot recording the current log position, so restore
-        replays only the tail written after this point."""
+        replays only the tail written after this point.
+
+        Locking: the log position is recorded FIRST, then jobs are
+        serialized in small locked chunks and the JSON dump runs with
+        no lock held — a monolithic under-lock dump would stall every
+        write transaction for seconds at 100k-job scale. A job mutated
+        after the position was recorded may serialize with LATER state;
+        replaying the tail re-applies those events, and every event
+        application is idempotent/transition-guarded, so the restore
+        converges to the same state."""
         with self._lock:
-            data = {
-                "log_lines": self._log.lines() if self._log else 0,
-                "jobs": {u: _job_dict(j) for u, j in self.jobs.items()},
-                "groups": {u: asdict(g) for u, g in self.groups.items()},
-                "rebalancer_config": self.rebalancer_config,
-            }
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(data, f)
-            os.replace(tmp, path)
+            lines0 = self._log.lines() if self._log else 0
+            genesis = getattr(self, "_log_genesis", None)
+            items = list(self.jobs.items())
+            groups = {u: asdict(g) for u, g in self.groups.items()}
+            rcfg = dict(self.rebalancer_config)
+        jobs_ser: dict = {}
+        CHUNK = 2000
+        for lo in range(0, len(items), CHUNK):
+            with self._lock:
+                for u, j in items[lo:lo + CHUNK]:
+                    jobs_ser[u] = _job_dict(j)
+        data = {
+            "log_lines": lines0,
+            "log_genesis": genesis,
+            "jobs": jobs_ser,
+            "groups": groups,
+            "rebalancer_config": rcfg,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def rotate_log(self, snapshot_path: str) -> None:
+        """Compaction: snapshot the full state, then restart the log
+        from a fresh GENESIS line whose id the snapshot records. A
+        restore (or follower resync) whose snapshot genesis does not
+        match the log's first line knows the offsets are from a
+        different log incarnation and replays the whole log instead of
+        seeking — the rotation-ambiguity the raw line counts cannot
+        resolve. Only the leader may rotate; followers pick the change
+        up through their shrink-resync path."""
+        if not self._log_path:
+            raise ValueError("rotate_log needs a log-backed store")
+        with self._lock:
+            self._check_writable()
+            # 1) checkpoint the CURRENT incarnation before touching the
+            # log: a crash anywhere past this point restores from this
+            # snapshot (a genesis mismatch with whatever the log then
+            # contains forces a full replay of it over this base), so
+            # no acked transaction is ever lost to the rotation window.
+            self.snapshot(snapshot_path)
+            genesis = new_uuid()
+            old_log = self._log
+            if old_log is not None:
+                old_log.close()
+            with open(self._log_path, "w") as f:
+                f.write(json.dumps({"t": now_ms(), "k": "genesis",
+                                    "g": genesis},
+                                   separators=(",", ":")) + "\n")
+            self._log = _make_log_writer(self._log_path, trim=False)
+            self._log_genesis = genesis
+            # 2) re-checkpoint against the fresh incarnation so normal
+            # restores seek by offset again
+            self.snapshot(snapshot_path)
+            self._barrier()
 
     @classmethod
     def restore(cls, path: Optional[str] = None,
@@ -547,11 +602,13 @@ class JobStore:
         line and corrupt the log. The replay simply stops before an
         unterminated final line instead."""
         offset = 0
+        snap_genesis = None
         store = cls()
         if path and os.path.exists(path):
             with open(path) as f:
                 data = json.load(f)
             offset = int(data.get("log_lines", 0))
+            snap_genesis = data.get("log_genesis")
             for u, jd in data["jobs"].items():
                 job = _job_from_dict(jd)
                 store.jobs[u] = job
@@ -566,6 +623,14 @@ class JobStore:
         if log_path and os.path.exists(log_path):
             if trim_tail:
                 _trim_torn_tail(log_path)
+            # rotation detection: the snapshot's line offset only means
+            # anything against the log incarnation it was taken from.
+            # A genesis mismatch (the log was rotated since, or the
+            # snapshot predates a rotation) invalidates the offset —
+            # replay the WHOLE log over the snapshot state instead (all
+            # event applications are idempotent/transition-guarded).
+            if snap_genesis != _read_log_genesis(log_path):
+                offset = 0
             consumed = store._replay(log_path, offset,
                                      allow_partial_tail=not trim_tail)
         # the exact resume point for incremental followers: seeding
@@ -573,6 +638,12 @@ class JobStore:
         # between replay-finish and writer-open
         store._replayed_offset = consumed
         store._snapshot_path = path
+        # seed the live genesis even when the offset seek skipped the
+        # genesis line itself — otherwise the next snapshot records
+        # log_genesis: null against a genesis-stamped log and every
+        # later restore full-replays instead of seeking
+        if log_path and os.path.exists(log_path):
+            store._log_genesis = _read_log_genesis(log_path)
         if log_path:
             store._log_path = log_path
             if open_writer:
@@ -661,10 +732,39 @@ class JobStore:
             self._log = None
         stop = threading.Event()
         state = {"applied": getattr(self, "_replayed_offset", 0),
-                 "f": None}
+                 "f": None,
+                 "genesis": getattr(self, "_log_genesis", None)}
+
+        def full_resync(reason: str):
+            log.warning("log follower: %s; full state resync", reason)
+            if state["f"] is not None:
+                state["f"].close()
+                state["f"] = None
+            fresh = JobStore.restore(
+                getattr(self, "_snapshot_path", None),
+                log_path=self._log_path, trim_tail=False,
+                open_writer=False)
+            with self._lock:
+                self.jobs = fresh.jobs
+                self.groups = fresh.groups
+                self.task_to_job = fresh.task_to_job
+                self.rebalancer_config = fresh.rebalancer_config
+                self._pending = fresh._pending
+                self._replay_max_epoch = fresh._replay_max_epoch
+                self._log_genesis = getattr(fresh, "_log_genesis", None)
+            state["applied"] = fresh._replayed_offset
+            state["genesis"] = getattr(fresh, "_log_genesis", None)
 
         def tick():
             path = self._log_path
+            # incarnation check EVERY tick: a rotation that regrows the
+            # file past our byte offset before the next tick would slip
+            # past the size-shrink check below, silently resuming
+            # mid-stream in the new incarnation
+            if os.path.exists(path) and \
+                    _read_log_genesis(path) != state["genesis"]:
+                full_resync("log genesis changed (rotation)")
+                return
             if state["f"] is None:
                 if not os.path.exists(path):
                     return
@@ -676,27 +776,11 @@ class JobStore:
             f = state["f"]
             if os.path.getsize(path) < f.tell():
                 # file shrank below our consumed boundary: the log was
-                # genuinely truncated or rotated (beyond the benign
-                # torn-tail fragment, which we never consume). Line
-                # numbering no longer matches — resuming by count would
-                # silently skip or mis-apply events — so REBUILD the
-                # whole in-memory state from snapshot + log and swap it
-                # in, like reload_from.
-                log.warning("log follower: %s shrank below consumed "
-                            "offset; full state resync", path)
-                f.close()
-                state["f"] = None
-                fresh = JobStore.restore(
-                    getattr(self, "_snapshot_path", None),
-                    log_path=path, trim_tail=False, open_writer=False)
-                with self._lock:
-                    self.jobs = fresh.jobs
-                    self.groups = fresh.groups
-                    self.task_to_job = fresh.task_to_job
-                    self.rebalancer_config = fresh.rebalancer_config
-                    self._pending = fresh._pending
-                    self._replay_max_epoch = fresh._replay_max_epoch
-                state["applied"] = fresh._replayed_offset
+                # genuinely truncated (beyond the benign torn-tail
+                # fragment, which we never consume). Line numbering no
+                # longer matches — resuming by count would silently
+                # skip or mis-apply events.
+                full_resync(f"{path} shrank below consumed offset")
                 return
             start = f.tell()
             chunk = f.read()
@@ -758,6 +842,9 @@ class JobStore:
                             self._replay_max_epoch, ev.get("k"))
                 return
             self._replay_max_epoch = ep
+        if k == "genesis":
+            self._log_genesis = ev.get("g")
+            return
         if k == "job":
             job = _job_from_dict(ev["job"])
             if job.uuid not in self.jobs:
@@ -854,6 +941,17 @@ def _job_from_dict(d: dict) -> Job:
     d["state"] = JobState(d["state"])
     job = Job(**{**d, "instances": insts})
     return job
+
+
+def _read_log_genesis(path: str):
+    """First-line genesis id of a log, or None for never-rotated logs."""
+    try:
+        with open(path, "rb") as f:
+            first = f.readline(4096)
+        ev = json.loads(first)
+        return ev.get("g") if ev.get("k") == "genesis" else None
+    except (OSError, ValueError):
+        return None
 
 
 def _trim_torn_tail(path: str) -> None:

@@ -431,3 +431,100 @@ def test_follow_log_read_replica(tmp_path):
         assert open(log_path).read() == before
     finally:
         stop()
+
+
+def test_replay_drops_stale_epoch_entries(tmp_path):
+    """Epoch fencing (ADVICE r2): a deposed leader that stalls past the
+    append-gate check and physically writes to the shared log must have
+    its zombie entries DROPPED on the next replay — entries are stamped
+    with the writer's lease epoch and replay ignores anything older
+    than the newest epoch seen."""
+    log = str(tmp_path / "log")
+    s1 = JobStore(log_path=log)
+    s1.epoch = 1
+    j1 = mkjob()
+    s1.create_jobs([j1])
+
+    # successor at epoch 2 appends
+    s2 = JobStore.restore(log_path=log)
+    s2.epoch = 2
+    j2 = mkjob()
+    s2.create_jobs([j2])
+
+    # zombie: the old epoch-1 writer appends AFTER the successor (its
+    # gate check passed before it stalled)
+    s1._log = None  # drop its writer handle; append via a fresh handle
+    from cook_tpu.state.store import _make_log_writer
+    s1._log = _make_log_writer(log, trim=False)
+    zombie = mkjob()
+    s1.create_jobs([zombie])
+    s1._log.close()
+    s2._log.close()
+
+    restored = JobStore.restore(log_path=log)
+    assert j1.uuid in restored.jobs       # epoch 1, before epoch 2: kept
+    assert j2.uuid in restored.jobs
+    assert zombie.uuid not in restored.jobs, \
+        "zombie append from a deposed epoch must not replay"
+
+
+def test_follower_shrink_resync_uses_snapshot(tmp_path):
+    """Log-shrink full resync must rebuild from snapshot + log, not the
+    log alone (review r2 follow-up): pre-rotation state survives."""
+    log = str(tmp_path / "log")
+    snap = str(tmp_path / "snap")
+    leader = JobStore(log_path=log)
+    j_old = mkjob()
+    leader.create_jobs([j_old])
+    leader.snapshot(snap)
+
+    replica = JobStore.restore(snap, log_path=log, trim_tail=False,
+                               open_writer=False)
+    stop = replica.follow_log(interval_s=0.05)
+    try:
+        # sanctioned compaction: snapshot + fresh genesis-stamped log
+        leader.create_jobs([mkjob() for _ in range(5)])  # grow the log
+        leader.rotate_log(snap)
+        import time as _t
+        j_new = mkjob()
+        leader.create_jobs([j_new])
+        deadline = _t.time() + 5
+        while _t.time() < deadline:
+            if j_old.uuid in replica.jobs and j_new.uuid in replica.jobs:
+                break
+            _t.sleep(0.05)
+        assert j_old.uuid in replica.jobs, "snapshot state lost on resync"
+        assert j_new.uuid in replica.jobs, "post-rotation events lost"
+    finally:
+        stop()
+
+
+def test_rotate_log_compaction_roundtrip(tmp_path):
+    """rotate_log compacts: state survives entirely through the
+    snapshot, the log restarts from a genesis marker, and a stale
+    PRE-rotation snapshot is detected by genesis mismatch (whole-log
+    replay over the stale base instead of a bogus offset seek)."""
+    log = str(tmp_path / "log")
+    snap = str(tmp_path / "snap")
+    stale_snap = str(tmp_path / "stale")
+    s = JobStore(log_path=log)
+    jobs = [mkjob() for _ in range(10)]
+    s.create_jobs(jobs)
+    inst = s.create_instance(jobs[0].uuid, "h", "mock")
+    s.snapshot(stale_snap)              # pre-rotation snapshot
+    s.rotate_log(snap)
+    j_after = mkjob()
+    s.create_jobs([j_after])
+    s.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    s._log.close()
+
+    # fresh snapshot + rotated log: exact state
+    r = JobStore.restore(snap, log_path=log)
+    assert set(r.jobs) == set(s.jobs)
+    assert r.get_instance(inst.task_id).status == InstanceStatus.RUNNING
+
+    # stale snapshot + rotated log: genesis mismatch -> full replay;
+    # post-rotation events must not be skipped by the stale offset
+    r2 = JobStore.restore(stale_snap, log_path=log)
+    assert j_after.uuid in r2.jobs
+    assert r2.get_instance(inst.task_id).status == InstanceStatus.RUNNING
