@@ -483,6 +483,7 @@ def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
 
 def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
               runtime_s=10.0, sequential_threshold=2048,
+              async_consumer=False,
               label="e2e coordinator @ 100k-pending x 10k-offers"):
     """END-TO-END production path: Coordinator.match_cycle itself — the
     durable store (100k pending + ~10k running), device-resident
@@ -530,7 +531,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
     seed_jobs = mkjobs(P0)
     store.create_jobs(seed_jobs)
     seed_s = time.perf_counter() - t0
-    coord.enable_resident(synchronous=True)
+    coord.enable_resident(synchronous=not async_consumer)
     # the seeded baseline is ~10^6 long-lived objects; without freezing
     # them, periodic gen-2 GC scans show up as multi-hundred-ms p99
     # spikes that have nothing to do with the scheduler (a production
@@ -565,6 +566,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
             matched_hist.append(stats.matched)
             for k in phase_keys:
                 phases[k].append(coord.metrics.get(f"match.default.{k}", 0))
+    coord.drain_resident()
     total_s = time.perf_counter() - t0
     wall = np.asarray(wall)
     readback = np.asarray(readback)
@@ -690,12 +692,19 @@ def main():
         bench_e2e(sequential_threshold=512,
                   label="e2e coordinator @ 100k-pending x 10k-offers, "
                         "batched matcher")
+    elif which == "e2e-async":
+        # production server default: launch writeback on the consumer
+        # thread; match_cycle wall = the dispatch path only, consume
+        # overlaps the next cycle (backpressure at queue depth 2)
+        bench_e2e(async_consumer=True,
+                  label="e2e coordinator @ 100k-pending x 10k-offers, "
+                        "async consumer")
     elif which == "pallas":
         bench_pallas()
     else:
         raise SystemExit(f"unknown config {which!r}; one of: headline "
                          "small pools rebalance stream e2e e2e-small "
-                         "pallas")
+                         "e2e-batched e2e-async pallas")
 
 
 if __name__ == "__main__":

@@ -87,7 +87,12 @@ class JobStore:
 
     # ------------------------------------------------------------------
     # event log plumbing
-    def _append(self, kind: str, data: dict) -> None:
+    def _append_raw(self, line: str) -> None:
+        """Append a pre-serialized event line (same gate semantics as
+        _append; the caller must have included the epoch stamp). The
+        bulk transactions build their fixed-shape lines by hand —
+        json.dumps of a fresh dict per status is a third of the bulk
+        writeback cost at 10k statuses."""
         if self._log is None or getattr(self, "_replaying", False):
             return
         # backstop re-check: a thread that passed the entry check and
@@ -99,10 +104,18 @@ class JobStore:
         gate = getattr(self, "append_gate", None)
         if gate is not None and not gate():
             raise NotLeaderError("write fenced: not the leader")
+        self._log.append(line)
+
+    def _epoch_suffix(self) -> str:
+        return f',"ep":{self.epoch}' if self.epoch else ""
+
+    def _append(self, kind: str, data: dict) -> None:
+        if self._log is None or getattr(self, "_replaying", False):
+            return
         ev = {"t": now_ms(), "k": kind, **data}
         if self.epoch:
             ev["ep"] = self.epoch
-        self._log.append(json.dumps(ev, separators=(",", ":")))
+        self._append_raw(json.dumps(ev, separators=(",", ":")))
 
     def _check_writable(self) -> None:
         """Primary write-fencing gate, evaluated at TRANSACTION ENTRY
@@ -285,10 +298,14 @@ class JobStore:
                 self._reindex(job)
                 out.append(inst)
                 created.append((job, inst))
-                log_items.append({"j": job_uuid, "i": inst.task_id,
-                                  "h": hostname, "b": backend})
+                log_items.append(
+                    f'{{"j":{json.dumps(job_uuid)},"i":"{inst.task_id}",'
+                    f'"h":{json.dumps(hostname)},"b":{json.dumps(backend)}}}')
             if log_items:
-                self._append("insts", {"items": log_items})
+                self._append_raw(
+                    f'{{"t":{t_ms},"k":"insts","items":['
+                    + ",".join(log_items)
+                    + f']{self._epoch_suffix()}}}')
             self._barrier()
             if created:
                 self._emit("insts", {"items": created, "origin": origin})
@@ -385,9 +402,18 @@ class JobStore:
                 was = job.state
                 self._update_job_state(job)
                 self._reindex(job)
-                self._append("status", {"task": task_id, "s": status.value,
-                                        "r": reason_code, "p": inst.preempted,
-                                        "e": exit_code})
+                # hand-built fixed-shape line (see _append_raw); task
+                # ids are store-generated uuids and status values are
+                # enum literals, but reason/exit codes come from opaque
+                # backend tuples — coerce to int so a bool/str can't
+                # write a malformed line into the durable log
+                self._append_raw(
+                    f'{{"t":{t_ms},"k":"status","task":"{task_id}",'
+                    f'"s":"{status.value}",'
+                    f'"r":{int(reason_code) if reason_code is not None else "null"},'
+                    f'"p":{"true" if inst.preempted else "false"},'
+                    f'"e":{int(exit_code) if exit_code is not None else "null"}'
+                    f'{self._epoch_suffix()}}}')
                 applied.append((job, inst, was))
             self._barrier()
             if applied:
