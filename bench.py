@@ -514,7 +514,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=140, warmup=15,
     hosts = [MockHost(f"h{i}", mem=float(rng.uniform(64, 256) * 1024),
                       cpus=float(rng.uniform(16, 64)))
              for i in range(H)]
-    log_path = tempfile.mktemp(prefix="cook_e2e_", suffix=".log")
+    fd, log_path = tempfile.mkstemp(prefix="cook_e2e_", suffix=".log")
+    os.close(fd)
     store = JobStore(log_path=log_path)
     cluster = MockCluster(hosts, runtime_fn=lambda s: (runtime_s, True, None),
                           bulk_status=True)

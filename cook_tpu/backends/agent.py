@@ -107,6 +107,11 @@ class AgentCluster(ComputeCluster):
         reported = set(payload.get("tasks", []))
         grace_cutoff = now_ms() - int(self.lost_task_grace_s * 1000)
         with self._lock:
+            prev = self.agents.get(hostname)
+            if prev is None or not prev.alive:
+                # new host (or resurrection): the resident match path
+                # polls offer_generation to learn the host set changed
+                self.bump_offer_generation()
             self.agents[hostname] = info
             lost = [tid for tid, (_, h, t0) in self._specs.items()
                     if h == hostname and tid not in reported
@@ -370,6 +375,8 @@ class AgentCluster(ComputeCluster):
                 if info.alive and info.last_heartbeat_ms < cutoff:
                     info.alive = False
                     dead.append(hostname)
+            if dead:
+                self.bump_offer_generation()
             lost = [tid for tid, (_, h, _) in self._specs.items()
                     if h in dead]
         for hostname in dead:

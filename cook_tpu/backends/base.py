@@ -130,6 +130,17 @@ class ComputeCluster(abc.ABC):
         offer path (the agent-attributes-cache, scheduler.clj:986-993)."""
         return {}
 
+    def offer_generation(self, pool: str) -> int:
+        """Monotonic counter the backend bumps on any host add/remove.
+        The device-resident match path (scheduler/resident.py) polls it
+        each cycle and rebuilds its host universe when it moved — a
+        backend that never bumps would leave a resident pool matching
+        onto a stale host set for up to resync_interval cycles."""
+        return getattr(self, "_offer_gen", 0)
+
+    def bump_offer_generation(self) -> None:
+        self._offer_gen = getattr(self, "_offer_gen", 0) + 1
+
     def autoscale(self, pool: str, queue_depth: int,
                   pending_sizes: Optional[list] = None) -> None:
         """Hook for synthetic-pod style autoscaling (autoscale!,

@@ -90,11 +90,7 @@ class KubeCluster(ComputeCluster):
         # the device-resident match state rebuilds its host universe
         if kind in ("added", "deleted"):
             with self._lock:
-                self._host_gen = getattr(self, "_host_gen", 0) + 1
-
-    def offer_generation(self, pool: str) -> int:
-        with self._lock:
-            return getattr(self, "_host_gen", 0)
+                self.bump_offer_generation()
 
     def _on_pod_event(self, kind: str, pod: Pod) -> None:
         if pod.synthetic:

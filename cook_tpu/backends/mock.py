@@ -103,6 +103,11 @@ class MockCluster(ComputeCluster):
             for spec in specs:
                 host = self.hosts.get(spec.hostname)
                 if host is None:
+                    # ports reserved via allocate_ports for a host that
+                    # vanished between match and launch must come back
+                    # (symmetric with the oversubscription branch)
+                    self.used_ports.get(spec.hostname,
+                                        set()).difference_update(spec.ports)
                     batch.append((spec.task_id, InstanceStatus.FAILED, 5000))
                     continue
                 um, uc, ug = self.used[spec.hostname]
@@ -176,12 +181,6 @@ class MockCluster(ComputeCluster):
         with self._lock:
             self.used_ports.get(hostname, set()).difference_update(ports)
 
-    def offer_generation(self, pool: str) -> int:
-        """Bumps whenever the host SET changes (adds/removals) so the
-        resident state knows to rebuild its host universe."""
-        with self._lock:
-            return getattr(self, "_host_gen", 0)
-
     def host_attributes(self) -> dict[str, dict[str, str]]:
         with self._lock:
             return {h.hostname: dict(h.attributes)
@@ -243,7 +242,7 @@ class MockCluster(ComputeCluster):
                 self.emit_status(tid, InstanceStatus.FAILED, 5000)
             self.hosts.pop(hostname, None)
             self.used.pop(hostname, None)
-            self._host_gen = getattr(self, "_host_gen", 0) + 1
+            self.bump_offer_generation()
             return dead
 
     def add_host(self, host: MockHost) -> None:
@@ -251,4 +250,4 @@ class MockCluster(ComputeCluster):
             self.hosts[host.hostname] = host
             self.used[host.hostname] = [0.0, 0.0, 0.0]
             self.used_ports[host.hostname] = set()
-            self._host_gen = getattr(self, "_host_gen", 0) + 1
+            self.bump_offer_generation()

@@ -50,10 +50,18 @@ def load_config(path: Optional[str] = None) -> dict:
             trusted = (path is not None
                        or os.path.abspath(p) == os.path.abspath(
                            CONFIG_PATHS[1]))
-            if not trusted and "plugins" in cfg:
-                print("warning: ignoring plugins from auto-discovered "
-                      f"{p} (use --config to trust it)", file=sys.stderr)
-                cfg = {k: v for k, v in cfg.items() if k != "plugins"}
+            # "metrics" is gated with "plugins": an untrusted checkout's
+            # metrics.url/path would silently POST {command, user, ...}
+            # to an attacker URL (or append to an arbitrary file) on
+            # every cs invocation
+            untrusted_keys = [k for k in ("plugins", "metrics")
+                              if not trusted and k in cfg]
+            if untrusted_keys:
+                print(f"warning: ignoring {'/'.join(untrusted_keys)} from "
+                      f"auto-discovered {p} (use --config to trust it)",
+                      file=sys.stderr)
+                cfg = {k: v for k, v in cfg.items()
+                       if k not in untrusted_keys}
             return cfg
     return {}
 

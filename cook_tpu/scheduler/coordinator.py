@@ -234,16 +234,29 @@ class Coordinator:
     def _status_entry_bulk(self, updates) -> None:
         """Batched status writeback: updates = [(task_id, status,
         reason_code[, extras]), ...]. One store transaction (one
-        durability barrier) for the whole batch; same per-item state
+        durability barrier) per shard sub-batch; same per-item state
         machine and the same post-write side effects as the per-item
         path (_on_status): completion plugins, reservation release,
-        native match-book GC. Ordering: the whole batch applies on the
-        caller's thread in order, which is strictly stronger than the
-        per-task-id ordering the sharded executors guarantee."""
+        native match-book GC. Ordering: when the sharded executors are
+        on, the batch is partitioned onto the SAME shards the per-item
+        channel uses, so a backend mixing both channels for one task
+        still applies that task's updates in arrival order. Durability
+        cost of the fan-out: the native eventlog group-commits, so
+        concurrent shard sub-batches coalesce into ~one fsync; the
+        pure-Python fallback writer pays one fsync per sub-batch
+        (bounded by the shard count, still far under per-item)."""
         lc = getattr(self, "_leadership_check", None)
         if lc is not None and not lc():
             log.warning("dropping %d statuses: not leader", len(updates))
             return
+        if self.status_shards is not None:
+            self.status_shards.submit_batch(
+                [(item[0], item) for item in updates],
+                self._apply_status_bulk)
+        else:
+            self._apply_status_bulk(updates)
+
+    def _apply_status_bulk(self, updates) -> None:
         self.store.update_instances_bulk(updates)
         for item in updates:
             task_id, status = item[0], item[1]

@@ -284,11 +284,14 @@ class ResidentPool:
         self.offer_cluster: dict[str, str] = {}
         gens = {}
         for cluster in co.clusters.all():
+            # generation BEFORE the offers read: a host registering
+            # between the two must surface as a gen mismatch next
+            # resync_due, not be silently absorbed into _host_gens
+            gens[cluster.name] = getattr(cluster, "offer_generation",
+                                         lambda p: 0)(pool)
             for o in cluster.pending_offers(pool):
                 offers.append(o)
                 self.offer_cluster[o.hostname] = cluster.name
-            gens[cluster.name] = getattr(cluster, "offer_generation",
-                                         lambda p: 0)(pool)
         self._host_gens = gens
         self.host_names = [o.hostname for o in offers]
         self.host_ids = {h: i for i, h in enumerate(self.host_names)}
@@ -894,6 +897,17 @@ class ResidentPool:
             if gen is not None and \
                     self._host_gens.get(cluster.name) != gen(self.pool):
                 return True
+        # built before any backend registered hosts (the server enables
+        # the resident path at build time): an empty host universe while
+        # a cluster has offers means we'd schedule nothing until the
+        # interval backstop — rebuild now. Backends that bump
+        # offer_generation are caught above; this probe is the backstop
+        # for ones that don't, throttled because pending_offers is an
+        # O(hosts) construction per cluster.
+        if not self.host_names and self.cycle_no % 8 == 0:
+            for cluster in self.coord.clusters.all():
+                if cluster.pending_offers(self.pool):
+                    return True
         return False
 
     def resync(self) -> None:

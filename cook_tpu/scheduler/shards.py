@@ -40,7 +40,21 @@ class InOrderShards:
         with self._inflight_lock:
             self._inflight += 1
         shard = hash(key) % self.n
-        self._queues[shard].put((args, kwargs))
+        self._queues[shard].put((None, args, kwargs))
+
+    def submit_batch(self, keyed_items: list, handler: Callable) -> None:
+        """Partition (key, item) pairs onto the same shards `submit`
+        uses and run `handler(sub_batch)` once per shard — a batched
+        channel that preserves per-key ordering against the per-item
+        channel (a bulk status batch must not reorder around a per-task
+        status already queued for the same task)."""
+        by_shard: dict[int, list] = {}
+        for key, item in keyed_items:
+            by_shard.setdefault(hash(key) % self.n, []).append(item)
+        with self._inflight_lock:
+            self._inflight += len(by_shard)
+        for shard, items in by_shard.items():
+            self._queues[shard].put((handler, (items,), {}))
 
     def _worker(self, i: int) -> None:
         q = self._queues[i]
@@ -49,9 +63,9 @@ class InOrderShards:
                 item = q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            args, kwargs = item
+            handler, args, kwargs = item
             try:
-                self.handler(*args, **kwargs)
+                (handler or self.handler)(*args, **kwargs)
             except Exception:
                 log.exception("sharded handler failed")
             finally:
