@@ -108,10 +108,12 @@ class SchedulerSettings:
     sequential_match_threshold: int = 2048
     use_pallas: bool = False            # fused TPU kernels (measured
     #                                     parity on v5e; see benchmarks)
-    # device-resident match path: tensors stay on device, the host
-    # ships store-event deltas (scheduler/resident.py). Requires no
-    # launch plugins / data locality / estimated-completion.
-    resident_match: bool = False
+    # device-resident match path (scheduler/resident.py): tensors stay
+    # on device, the host ships store-event deltas. THE production
+    # default — full feature parity with the legacy cycle (plugins,
+    # data locality, estimated completion all supported); set false to
+    # force the legacy per-cycle re-tensorize path.
+    resident_match: bool = True
     # hash-sharded in-order status executors (scheduler.clj:1524-1546);
     # 0 = inline on the backend callback thread
     status_shards: int = 19

@@ -78,7 +78,13 @@ def rank_and_match(
     num_groups: int = 1,
     sequential: bool = True,
     considerable_limit=None,
-    bonus=None,                # (P, H) f32 >= 0 fitness bonus (data locality)
+    bonus=None,                # data-locality fitness bonus: (P, H) f32
+                               # dense, or tuple (rows (Kb, H) f32,
+                               # slot_of (P,) i32) — the sparse resident
+                               # form mirroring `forbidden`: row p's
+                               # bonus is rows[slot_of[p]] when
+                               # slot_of[p] >= 0, zero otherwise. Only
+                               # jobs with datasets own a bonus row.
     use_pallas: bool = False,  # fused Pallas TPU kernel in match_rounds
     dru_mode: str = "default",  # "default" (cpu/mem) | "gpu" (pool
                                 # dru-mode :pool.dru-mode/gpu, schema.clj:816)
@@ -94,6 +100,16 @@ def rank_and_match(
     host_ports=None,           # (H,) i32 free ports — folds the ports
                                # feasibility check (task.clj:254-280)
                                # into the compact forbidden mask
+    pend_est_s=None,           # (P,) i32 capped expected-runtime seconds
+    host_death_s=None,         # (H,) i32 host death time (s, relative
+                               # epoch; sentinel = no advertised start).
+    now_s=None,                # () i32 wall clock on the same epoch —
+                               # with pend_est_s/host_death_s this folds
+                               # the estimated-completion constraint
+                               # (constraints.clj:200-247) into the
+                               # compact mask as a pure time-lane
+                               # comparison, so host lifetimes decay on
+                               # device without any per-cycle re-masking
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -202,7 +218,22 @@ def rank_and_match(
         forb = forbidden[pend_idx] & in_use[:, None]
     if pend_ports is not None and host_ports is not None:
         forb = forb | (pend_ports[pend_idx][:, None] > host_ports[None, :])
-    bonusc = None if bonus is None else bonus[pend_idx] * in_use[:, None]
+    if pend_est_s is not None and host_death_s is not None:
+        # est_end >= death forbids the host; est <= 0 = unconstrained
+        est = pend_est_s[pend_idx]
+        forb = forb | ((est > 0)[:, None]
+                       & ((now_s + est)[:, None] >= host_death_s[None, :]))
+    if bonus is None:
+        bonusc = None
+    elif isinstance(bonus, tuple):
+        brows, bslot = bonus
+        Kb = brows.shape[0]
+        bs = bslot[pend_idx]
+        bonusc = jnp.where((bs >= 0)[:, None],
+                           brows[jnp.clip(bs, 0, Kb - 1)], 0.0) \
+            * in_use[:, None]
+    else:
+        bonusc = bonus[pend_idx] * in_use[:, None]
     if sequential:
         res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups,
                                    bonus=bonusc,

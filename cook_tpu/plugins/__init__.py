@@ -124,6 +124,26 @@ class CachedLaunchFilter:
         self._first_deferred.pop(job.uuid, None)
         return status.status == ACCEPT
 
+    def defer_for(self, uuid: str) -> float:
+        """SECONDS until a failed check() should be revalidated — the
+        cached defer's remaining life, clamped to the age-out deadline
+        (a REJECT or stale entry re-checks within a minute). A duration
+        (not a timestamp) so callers on a different clock than this
+        filter's injectable one can schedule it safely. The
+        device-resident path parks the job's row for this long so the
+        kernel stops re-matching a deferred job every cycle."""
+        now = self._clock()
+        s = self._cache.get(uuid)
+        exp = s.expires_at if s is not None and s.status == DEFER else 0.0
+        if exp <= now:
+            exp = now + 60.0
+        first = self._first_deferred.get(uuid)
+        if first is not None:
+            exp = min(exp, first + self.age_out_s)
+        # a floor keeps a pathological plugin from re-running every
+        # cycle, scaled down with short age-outs (tests)
+        return max(exp, now + min(1.0, self.age_out_s / 4.0)) - now
+
 
 @dataclass
 class PluginRegistry:

@@ -379,18 +379,15 @@ class Coordinator:
         """Switch `pool`'s match cycle to the device-resident path.
         synchronous=False decouples launch writeback onto a consumer
         thread (production/bench mode); True consumes inline
-        (deterministic, for tests and the simulator)."""
-        plugins_block = (
-            self.plugins is not None
-            and (not hasattr(self.plugins, "affects_match_cycle")
-                 or self.plugins.affects_match_cycle()))
-        if plugins_block or self.data_locality is not None \
-                or self.config.estimated_completion.enabled:
-            raise ValueError(
-                "resident match path does not support per-cycle launch "
-                "filter/adjuster plugins, data-locality bonuses, or the "
-                "estimated-completion constraint; keep the legacy cycle "
-                "for this config")
+        (deterministic, for tests and the simulator).
+
+        Full feature parity with the legacy cycle: data-locality
+        bonuses ride as sparse resident rows, estimated-completion as a
+        device time-lane, launch-filter plugins run against the compact
+        readback at consume time and adjusters at row fill — the
+        reference blends all of these into its one match loop
+        (data_locality.clj:192, plugins/launch.clj:59-121,
+        constraints.clj:200)."""
         from cook_tpu.scheduler.resident import ResidentPool
         pool = pool or self.pools.default_pool
         if not hasattr(self, "_resident"):
@@ -409,6 +406,12 @@ class Coordinator:
     def _resident_listener(self, kind: str, data: dict) -> None:
         for rp in self._resident.values():
             rp.on_event(kind, data)
+
+    def _mark_dirty_all(self, uuid: str) -> None:
+        """Re-sync one job on every resident pool next drain (pool
+        migrations must land in the destination pool's state)."""
+        for rp in getattr(self, "_resident", {}).values():
+            rp.mark_job_dirty(uuid)
 
     def _consume_loop(self) -> None:
         while True:
@@ -532,7 +535,15 @@ class Coordinator:
         t_rb1 = time.perf_counter()
         self.metrics[f"match.{pool}.readback_ms"] = (t_rb1 - t_rb0) * 1e3
         items = []        # (uuid, hostname, cluster_name)
-        item_jobs = []    # (job, ports)
+        item_jobs = []    # (job, ports, credit_snapshot)
+        # per-cycle launch plugins run against the compact batch, the
+        # resident form of the reference's considerable filtering
+        # (plugins/launch.clj:59-121); skipped entirely for the default
+        # (no-op) registry
+        plug = self.plugins if (
+            self.plugins is not None
+            and getattr(self.plugins, "affects_match_cycle",
+                        lambda: True)()) else None
         with rp.mirror_lock:
             m = rp._pend_m
             for i in range(len(cons_idx)):
@@ -543,20 +554,33 @@ class Coordinator:
                 uuid = rp.row_uuid[row]
                 job = self.store.get_job(uuid) if uuid else None
                 hostname = rp.host_names[h]
+                # mirror values are what the device depleted at match
+                # (cooling blocks row reuse), so crediting them back is
+                # exact — for freed rows AND refused launches alike
+                credit = (h, float(m["mem"][row]), float(m["cpus"][row]),
+                          float(m["gpus"][row]), 1, int(m["ports"][row]))
                 if job is None:
-                    # row freed by a racing kill: its mirror values are
-                    # still the matched job's (cooling blocks reuse), so
-                    # the credit is exact
-                    rp.queue_credit(h, float(m["mem"][row]),
-                                    float(m["cpus"][row]),
-                                    float(m["gpus"][row]), 1,
-                                    int(m["ports"][row]))
+                    # row freed by a racing kill
+                    rp.queue_credit(*credit)
                     continue
 
                 def refuse():
-                    rp.queue_credit(h, self._effective_mem(job), job.cpus,
-                                    job.gpus, 1, job.ports)
+                    rp.queue_credit(*credit)
 
+                if plug is not None:
+                    job = plug.adjuster.adjust_job(job)
+                    if job.pool != pool:
+                        # adjuster migrated the job (pool_mover): it
+                        # belongs to the destination pool's cycle
+                        refuse()
+                        self._mark_dirty_all(uuid)
+                        continue
+                    if not plug.launch.check(job):
+                        refuse()
+                        rp.defer_job_locked(
+                            uuid,
+                            time.monotonic() + plug.launch.defer_for(uuid))
+                        continue
                 if not self.user_launch_rl.try_acquire(job.user):
                     refuse()
                     rp.mark_job_dirty(uuid)
@@ -585,7 +609,7 @@ class Coordinator:
                                     "ports", cluster.name, uuid)
                         ports = []
                 items.append((uuid, hostname, rp.offer_cluster[hostname]))
-                item_jobs.append((job, ports))
+                item_jobs.append((job, ports, credit))
         t_loop = time.perf_counter()
         self.metrics[f"match.{pool}.launch_loop_ms"] = \
             (t_loop - t_rb1) * 1e3
@@ -595,14 +619,13 @@ class Coordinator:
             (time.perf_counter() - t_loop) * 1e3
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
-        for (uuid, hostname, cname), (job, ports), inst in zip(
+        for (uuid, hostname, cname), (job, ports, credit), inst in zip(
                 items, item_jobs, insts):
             if inst is None:
                 # killed/launched since matching: restore the capacity
-                # the device already depleted
-                rp.queue_credit(rp.host_ids[hostname],
-                                self._effective_mem(job), job.cpus,
-                                job.gpus, 1, job.ports)
+                # the device already depleted (the mirror snapshot taken
+                # under the lock, so a concurrent re-fill can't skew it)
+                rp.queue_credit(*credit)
                 rp.mark_job_dirty(uuid)
                 if ports:
                     rel = getattr(self.clusters.get(cname),

@@ -83,6 +83,10 @@ class DataLocalityCosts:
         self._costs: dict[str, dict[str, float]] = {}
         self._fetched_at: dict[str, float] = {}
         self._lock = threading.Lock()
+        # bumped whenever a fetched batch lands: cheap change detection
+        # for consumers that cache derived forms (the resident path's
+        # sparse bonus rows re-fill only when this moves)
+        self.generation = 0
 
     def update(self, jobs) -> int:
         """Batched fetch for jobs with datasets whose costs are missing
@@ -111,6 +115,7 @@ class DataLocalityCosts:
                 # be re-requested on every cycle
                 for uuid in batch:
                     self._fetched_at[uuid] = now
+                self.generation += 1
             fetched += len(batch)
         return fetched
 

@@ -73,12 +73,18 @@ from cook_tpu.state.pools import DruMode
 # field order is the wire format of a pend-row delta
 PEND_FIELDS = ("user", "mem", "cpus", "gpus", "priority", "start_time",
                "valid", "mem_share", "cpus_share", "gpu_share", "group",
-               "unique_group", "ports", "forb_slot")
+               "unique_group", "ports", "forb_slot", "est_s", "bonus_slot")
 RUN_FIELDS = ("user", "mem", "cpus", "gpus", "priority", "start_time",
               "valid", "mem_share", "cpus_share", "gpu_share")
 _DTYPES = {"user": np.int32, "priority": np.int32, "start_time": np.int32,
            "group": np.int32, "ports": np.int32, "forb_slot": np.int32,
+           "est_s": np.int32, "bonus_slot": np.int32,
            "valid": bool, "unique_group": bool}
+
+# host death-time sentinel for the estimated-completion lane: hosts with
+# no advertised start time never expire. Relative-epoch seconds keep the
+# i32 comparisons exact (now_s + est_s stays far below this).
+EST_NEVER = 1 << 30
 
 DELTA_CHUNK = 4096          # fixed scatter width: one compile per kind
 
@@ -97,10 +103,14 @@ def _dtype(name):
 # dispatches (rare: only when >4096 rows change in one cycle).
 PEND_F32 = ("mem", "cpus", "gpus", "mem_share", "cpus_share", "gpu_share")
 PEND_I32 = ("user", "priority", "start_time", "group", "ports",
-            "forb_slot", "valid", "unique_group")     # bools ride as i32
+            "forb_slot", "est_s", "bonus_slot",
+            "valid", "unique_group")     # bools ride as i32
 RUN_F32 = ("mem", "cpus", "gpus", "mem_share", "cpus_share", "gpu_share")
 RUN_I32 = ("user", "priority", "start_time", "valid")
 FORB_CHUNK = 256
+BONUS_CHUNK = 64   # f32 rows are 4x the bool mask bytes; data-locality
+#                    costs refresh on a minutes TTL, so a smaller chunk
+#                    still covers the steady state in one dispatch
 # one cycle's completions can easily touch >512 distinct hosts at
 # 10k-host scale; the chunk must cover the steady state so the fused
 # dispatch stays the only one per cycle
@@ -162,18 +172,27 @@ def _scatter_credit(state, idx, cf, ci):
     return {**state, "host": _apply_credit(state["host"], idx, cf, ci)}
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_bonus(state, slot_idx, rows):
+    return {**state, "bonus": state["bonus"].at[slot_idx].set(
+        rows, mode="drop")}
+
+
 @functools.partial(jax.jit, static_argnames=(
     "num_considerable", "sequential", "num_groups", "dru_mode",
-    "use_pallas", "match_kw"), donate_argnums=(0,))
-def _device_cycle(state, deltas, qm, qc, qn, considerable_limit,
+    "use_pallas", "match_kw", "with_bonus", "with_est"),
+    donate_argnums=(0,))
+def _device_cycle(state, deltas, qm, qc, qn, considerable_limit, now_s,
                   num_considerable, sequential, num_groups, dru_mode,
-                  use_pallas, match_kw):
-    (p_idx, pf, pi, r_idx, rf, ri, c_idx, cf, ci, f_idx, frows) = deltas
+                  use_pallas, match_kw, with_bonus, with_est):
+    (p_idx, pf, pi, r_idx, rf, ri, c_idx, cf, ci, f_idx, frows,
+     b_idx, brows) = deltas
     p = _apply_pend(state["pend"], p_idx, pf, pi)
     r = _apply_run(state["run"], r_idx, rf, ri)
     h = _apply_credit(state["host"], c_idx, cf, ci)
     state = {**state, "pend": p, "run": r, "host": h,
-             "forb": state["forb"].at[f_idx].set(frows, mode="drop")}
+             "forb": state["forb"].at[f_idx].set(frows, mode="drop"),
+             "bonus": state["bonus"].at[b_idx].set(brows, mode="drop")}
     hosts = match_ops.Hosts(
         mem=h["mem"], cpus=h["cpus"], gpus=h["gpus"],
         cap_mem=h["cap_mem"], cap_cpus=h["cap_cpus"],
@@ -193,7 +212,11 @@ def _device_cycle(state, deltas, qm, qc, qn, considerable_limit,
         run_gpu_share=r["gpu_share"] if dru_mode == "gpu" else None,
         pend_gpu_share=p["gpu_share"] if dru_mode == "gpu" else None,
         match_kw=match_kw,
-        pend_ports=p["ports"], host_ports=h["ports"])
+        pend_ports=p["ports"], host_ports=h["ports"],
+        bonus=(state["bonus"], p["bonus_slot"]) if with_bonus else None,
+        pend_est_s=p["est_s"] if with_est else None,
+        host_death_s=h["death_s"] if with_est else None,
+        now_s=now_s if with_est else None)
     Pcap = p["valid"].shape[0]
     # matched rows leave the pending set ON DEVICE, immediately: the
     # readback lag can then never double-launch (see module docstring)
@@ -247,13 +270,46 @@ class ResidentPool:
 
     def __init__(self, coordinator, pool: str,
                  forb_cap: int = 4096,
+                 bonus_cap: int = 2048,
                  resync_interval: int = 512,
-                 synchronous: bool = True):
+                 locality_refresh_cycles: int = 16,
+                 synchronous: bool = True,
+                 device=None):
         self.coord = coordinator
         self.pool = pool
         self.forb_cap = forb_cap
         self.resync_interval = resync_interval
         self.synchronous = synchronous
+        # per-pool device pinning: each pool's resident state may live
+        # on its own chip (the per-pool parallel loops of SURVEY §2.5.1
+        # — pools are independent scheduling problems; N pools across N
+        # chips scale the leader horizontally). None = default device.
+        self.device = device
+        # per-cycle launch plugins run against the COMPACT readback at
+        # consume time (the reference filters considerables,
+        # plugins/launch.clj:59-121 — the readback loop is the same
+        # choke point); the adjuster is applied wherever a job's row is
+        # (re)filled, so the mirrors always hold adjusted values.
+        # Adjusters must be deterministic AND (when they mutate the job
+        # in place) idempotent — the reference re-applies them every
+        # cycle to the same store-backed jobs, so it assumes the same;
+        # a copy-returning adjuster is re-derived from the store job at
+        # fill and at consume and never compounds.
+        # _adjust / with_bonus / with_est are captured per REBUILD and
+        # resync_due watches for live config changes (a plugin or cost
+        # store installed after enable must not half-apply).
+        self._adjust = None
+        self.with_bonus = False
+        self.bonus_cap = 1
+        self._bonus_cap_cfg = bonus_cap
+        self.locality_refresh_cycles = locality_refresh_cycles
+        self._dl_gen = -1
+        self._dl_fetching = False
+        self._dataset_jobs: set[str] = set()
+        # launch-filter deferrals: uuid -> monotonic revalidation time.
+        # A deferred job's row goes invalid until the expiry so the
+        # kernel stops re-matching it every cycle.
+        self._deferred: dict[str, float] = {}
         self._ev_lock = threading.Lock()
         # serializes mirror access between the cycle thread (drain) and
         # the consumer thread's launch loop; the device readback — the
@@ -271,6 +327,17 @@ class ResidentPool:
         self.stats_last = None
         self._build_from_scratch()
 
+    def _feature_sig(self) -> tuple:
+        """The match-affecting feature config a rebuild bakes into the
+        mirrors/device program; resync_due forces a rebuild when it
+        moves (e.g. plugins installed after enable_resident)."""
+        co = self.coord
+        plugins = co.plugins
+        return ("adjuster" in getattr(plugins, "custom", ())
+                if plugins is not None else False,
+                co.data_locality is not None,
+                co.config.estimated_completion.enabled)
+
     # -- full (re)build ----------------------------------------------------
     def _build_from_scratch(self) -> None:
         co, pool = self.coord, self.pool
@@ -278,6 +345,18 @@ class ResidentPool:
         self._share_cache = {}
         self._fill_batch: dict = {}
         self._run_batch: dict = {}
+        self._built_sig = self._feature_sig()
+        plugins = co.plugins
+        self._adjust = (plugins.adjuster.adjust_job
+                        if self._built_sig[0] else None)
+        # data-locality: jobs with datasets own a sparse f32 bonus row
+        # (w * (1 - cost)) the kernel blends into fitness, the resident
+        # form of the DataLocalFitnessCalculator (data_locality.clj:192)
+        self.with_bonus = self._built_sig[1]
+        if self.with_bonus and self.bonus_cap < self._bonus_cap_cfg:
+            self.bonus_cap = self._bonus_cap_cfg
+        elif not self.with_bonus:
+            self.bonus_cap = 1
         # host universe from current offers (one O(H) pass, only at
         # resync; per-cycle host state lives on device)
         offers = []
@@ -319,8 +398,39 @@ class ResidentPool:
             hostd["valid"][i] = True
             hostd["task_slots"][i] = 10_000
             hostd["ports"][i] = sum(hi - lo + 1 for lo, hi in o.ports)
+        # estimated-completion lane (constraints.clj:200-247): host
+        # death times as relative-epoch i32 seconds; the kernel forbids
+        # now_s + est_s >= death_s, so lifetimes decay on device with
+        # no per-cycle re-masking. Active only when configured AND some
+        # host advertises a start time (reference returns None then).
+        self._t0_ms = time.time() * 1000.0
+        ec = co.config.estimated_completion
+        death = np.full(H, EST_NEVER, np.int32)
+        any_start = False
+        if ec.enabled:
+            for i, o in enumerate(offers):
+                start = o.attributes.get("host-start-time")
+                if start is None:
+                    continue
+                try:
+                    start_s = float(start)
+                except (TypeError, ValueError):
+                    continue   # malformed attr = unconstrained host
+                any_start = True
+                rel_s = (start_s * 1000.0
+                         + ec.host_lifetime_mins * 60_000.0
+                         - self._t0_ms) / 1000.0
+                death[i] = int(np.clip(rel_s, -EST_NEVER, EST_NEVER))
+        hostd["death_s"] = death
+        self.with_est = bool(ec.enabled and any_start)
 
         pending = store.pending_jobs(pool)
+        if self._adjust is not None:
+            # job-adjuster plugin (plugins/adjustment.clj): the mirrors
+            # hold ADJUSTED values; a job migrated out of this pool
+            # belongs to the destination pool's cycle
+            pending = [j for j in (self._adjust(j) for j in pending)
+                       if j.pool == pool]
         run_insts = [(i, store.jobs[i.job_uuid])
                      for i in store.running_instances(pool)]
         # 20% slack rows before the next resync-with-growth; the bucket
@@ -329,8 +439,57 @@ class ResidentPool:
         Pcap = bucket(max(len(pending) + len(pending) // 5, 1024))
         Rcap = bucket(max(len(run_insts) + len(run_insts) // 5, 1024))
         self.Pcap, self.Rcap = Pcap, Rcap
+        while True:
+            try:
+                self._init_and_fill_mirrors(pending, run_insts, H)
+                break
+            except _NeedResync as e:
+                # sparse-slot demand exceeded a fixed cap during the
+                # rebuild itself: grow the cap and refill (bounded by
+                # log2 doublings; Pcap/Rcap cannot overflow here — they
+                # were just sized from the store)
+                msg = str(e)
+                if "forbidden" in msg:
+                    self.forb_cap *= 2
+                elif "bonus" in msg:
+                    self.bonus_cap *= 2
+                else:
+                    raise
+                log.info("resident rebuild grew caps (forb=%d bonus=%d)"
+                         ": %s", self.forb_cap, self.bonus_cap, msg)
+        # device state: upload mirrors wholesale (resync only)
+        dev = self.device or jax.devices()[0]
+        self.state = jax.device_put({
+            "pend": {f: self._pend_m[f].copy() for f in PEND_FIELDS},
+            "run": {f: self._run_m[f].copy() for f in RUN_FIELDS},
+            "host": {k: v.copy() for k, v in hostd.items()},
+            "forb": self._forb_rows_m.copy(),
+            "bonus": self._bonus_rows_m.copy(),
+        }, dev)
+        self._host_mirror_avail = {k: hostd[k].copy()
+                                   for k in ("mem", "cpus", "gpus",
+                                             "task_slots", "ports")}
+        self._dirty_pend: set[int] = set()
+        self._dirty_forb: set[int] = set()
+        self._dirty_bonus: set[int] = set()
+        self._dirty_run: set[int] = set()
+        self._host_credit: dict[int, list] = {}
+        self._last_resv: dict[str, str] = dict(co.reservations)
+
+    def _init_and_fill_mirrors(self, pending, run_insts, H: int) -> None:
+        """Allocate fresh host mirrors at the current caps and fill
+        them from the store (the retried section of a rebuild)."""
+        Pcap, Rcap = self.Pcap, self.Rcap
+        # dirty tracking must exist before the fill loops run (they mark
+        # sparse slots dirty); reset again after the wholesale upload
+        self._dirty_pend: set[int] = set()
+        self._dirty_forb: set[int] = set()
+        self._dirty_bonus: set[int] = set()
+        self._dirty_run: set[int] = set()
+        self._host_credit: dict[int, list] = {}
         self._pend_m = {f: np.zeros(Pcap, _dtype(f)) for f in PEND_FIELDS}
         self._pend_m["forb_slot"][:] = -1
+        self._pend_m["bonus_slot"][:] = -1
         self._pend_m["mem_share"][:] = F32_MAX
         self._pend_m["cpus_share"][:] = F32_MAX
         self._pend_m["gpu_share"][:] = F32_MAX
@@ -346,40 +505,32 @@ class ResidentPool:
         self._run_free = list(range(Rcap - 1, -1, -1))
         self._forb_rows_m = np.zeros((self.forb_cap, H), bool)
         self._forb_free = list(range(self.forb_cap - 1, -1, -1))
+        self._bonus_rows_m = np.zeros((self.bonus_cap, H), np.float32)
+        self._bonus_free = list(range(self.bonus_cap - 1, -1, -1))
+        self._dataset_jobs.clear()
+        self._fill_batch = {}
+        self._run_batch = {}
         self._group_ids: dict[str, int] = {}
         self._cooling.clear()
         self._inflight.clear()
         self._consumed_res.clear()
         self.consumed_through = self.cycle_no - 1
-
-        dirty_p, dirty_r = [], []
+        # deferred-launch bookkeeping survives a rebuild (the filter's
+        # cache is coordinator state); prune expired entries so the
+        # fill marks only live deferrals invalid
+        now = time.monotonic()
+        self._deferred = {u: e for u, e in self._deferred.items()
+                          if e > now}
         for job in pending:
-            dirty_p.append(self._alloc_pend(job))
+            self._alloc_pend(job)
         for inst, job in run_insts:
-            row = self._alloc_run(inst, job)
-            dirty_r.append(row)
+            self._alloc_run(inst, job)
             hid = self.host_ids.get(inst.hostname, -1)
             self._consumed_res[inst.task_id] = (
                 hid, self.coord._effective_mem(job), job.cpus, job.gpus,
                 1, job.ports)
         self._flush_fill_batch()
         self._flush_run_batch()
-        # device state: upload mirrors wholesale (resync only)
-        dev = jax.devices()[0]
-        self.state = jax.device_put({
-            "pend": {f: self._pend_m[f].copy() for f in PEND_FIELDS},
-            "run": {f: self._run_m[f].copy() for f in RUN_FIELDS},
-            "host": {k: v.copy() for k, v in hostd.items()},
-            "forb": self._forb_rows_m.copy(),
-        }, dev)
-        self._host_mirror_avail = {k: hostd[k].copy()
-                                   for k in ("mem", "cpus", "gpus",
-                                             "task_slots", "ports")}
-        self._dirty_pend: set[int] = set()
-        self._dirty_forb: set[int] = set()
-        self._dirty_run: set[int] = set()
-        self._host_credit: dict[int, list] = {}
-        self._last_resv: dict[str, str] = dict(co.reservations)
 
     # -- row management ----------------------------------------------------
     def _alloc_pend(self, job) -> int:
@@ -393,13 +544,17 @@ class ResidentPool:
 
     def _fill_pend(self, row: int, job) -> None:
         """Write (or queue) one pending job's mirror row. Unconstrained
-        jobs with no mask slot to release take the BATCH path — a dict
-        of row -> job flushed vectorized at the end of the drain, which
-        is several times cheaper than per-row numpy scalar stores at
-        thousands of churned rows per cycle. Constrained jobs (mask
-        rows) and rows holding a stale mask slot go scalar."""
+        jobs with no mask/bonus slot to manage take the BATCH path — a
+        dict of row -> job flushed vectorized at the end of the drain,
+        which is several times cheaper than per-row numpy scalar stores
+        at thousands of churned rows per cycle. Constrained jobs (mask
+        rows), dataset jobs (bonus rows) and rows holding a stale slot
+        go scalar."""
         m = self._pend_m
-        if m["forb_slot"][row] < 0 and not self._constrained(job):
+        if m["forb_slot"][row] < 0 and m["bonus_slot"][row] < 0 \
+                and not (self.with_bonus
+                         and getattr(job, "datasets", None)) \
+                and not self._constrained(job):
             self._fill_batch[row] = job
             return
         self._fill_batch_pop(row)
@@ -432,6 +587,7 @@ class ResidentPool:
                           and g.host_placement.get("type") == "unique")
         m["group"][row] = gid
         m["unique_group"][row] = unique
+        m["est_s"][row] = self._est_s(job)
         # constraint mask row (sparse): only when the job needs one
         mask = self._mask_for(job)
         slot = int(m["forb_slot"][row])
@@ -449,6 +605,32 @@ class ResidentPool:
             self._forb_rows_m[slot, :len(mask)] = mask
             self._forb_rows_m[slot, len(self.host_names):] = True
             self._dirty_forb.add(slot)
+        # data-locality bonus row (sparse): only dataset jobs own one
+        bslot = int(m["bonus_slot"][row])
+        if self.with_bonus and getattr(job, "datasets", None):
+            self._dataset_jobs.add(job.uuid)
+            if bslot < 0:
+                if not self._bonus_free:
+                    raise _NeedResync("bonus capacity exceeded")
+                bslot = self._bonus_free.pop()
+                m["bonus_slot"][row] = bslot
+            dl = self.coord.data_locality
+            costs = dl.get_costs(job.uuid)
+            brow = self._bonus_rows_m[bslot]
+            brow[:] = 0.0   # unknown host = cost 1.0 = zero bonus
+            for name, c in costs.items():
+                h = self.host_ids.get(name)
+                if h is not None:
+                    brow[h] = dl.weight * (1.0 - c)
+            self._dirty_bonus.add(bslot)
+        elif bslot >= 0:
+            self._bonus_free.append(bslot)
+            m["bonus_slot"][row] = -1
+            self._dataset_jobs.discard(job.uuid)
+        # a launch-filter deferral keeps the row out of the match until
+        # its revalidation time, whatever refilled it meanwhile
+        if job.uuid in self._deferred:
+            m["valid"][row] = False
 
     def _flush_fill_batch(self) -> None:
         batch = self._fill_batch
@@ -478,7 +660,52 @@ class ResidentPool:
             (gids.setdefault(j.group, len(gids)) if j.group is not None
              else -1) for j in jobs]
         m["unique_group"][rows] = False   # batch path = unconstrained
-        # forb_slot already < 0 for every batch row (path precondition)
+        # forb_slot/bonus_slot already < 0 for every batch row (path
+        # precondition; dataset jobs are routed scalar)
+        m["est_s"][rows] = [self._est_s(j) for j in jobs] \
+            if self.with_est else 0
+        # deferred jobs stay invalid whatever refilled them
+        for u in self._deferred:
+            r = self.pend_row.get(u)
+            if r is not None and r in batch:
+                m["valid"][r] = False
+
+    def _adjusted(self, job):
+        """Apply the job-adjuster plugin (when customized) so mirror
+        rows always hold adjusted values; deterministic by contract."""
+        return job if self._adjust is None else self._adjust(job)
+
+    def _est_s(self, job) -> int:
+        """Capped expected-runtime seconds for the estimated-completion
+        lane (the job side of constraints.clj:200-247): max of the
+        scaled expected runtime and prior host-lost runtimes, capped at
+        host-lifetime minus grace. 0 = unconstrained."""
+        if not self.with_est:
+            return 0
+        ec = self.coord.config.estimated_completion
+        scaled = (job.expected_runtime_ms or 0) \
+            * ec.expected_runtime_multiplier
+        lost = [(inst.end_time_ms - inst.start_time_ms)
+                for inst in job.instances
+                if inst.reason_code == 5000
+                and inst.end_time_ms and inst.start_time_ms]
+        expected = max([scaled] + lost)
+        if expected <= 0:
+            return 0
+        cap_ms = (ec.host_lifetime_mins
+                  - ec.agent_start_grace_period_mins) * 60_000.0
+        return max(1, int(min(expected, cap_ms) / 1000.0))
+
+    def defer_job_locked(self, uuid: str, until: float) -> None:
+        """Launch-filter deferral: invalidate the job's row until the
+        monotonic revalidation time (drain re-syncs it after). Caller
+        holds mirror_lock (the consume loop)."""
+        self._deferred[uuid] = until
+        row = self.pend_row.get(uuid)
+        if row is not None:
+            self._fill_batch_pop(row)
+            self._pend_m["valid"][row] = False
+            self._dirty_pend.add(row)
 
     def _constrained(self, job) -> bool:
         co = self.coord
@@ -509,6 +736,8 @@ class ResidentPool:
 
     def _free_pend(self, uuid: str) -> None:
         row = self.pend_row.pop(uuid, None)
+        self._deferred.pop(uuid, None)
+        self._dataset_jobs.discard(uuid)
         if row is None:
             return
         self._fill_batch_pop(row)   # a queued fill must not resurrect it
@@ -519,6 +748,10 @@ class ResidentPool:
         if slot >= 0:
             m["forb_slot"][row] = -1
             self._cooling.append((self.cycle_no, "forb", slot))
+        bslot = int(m["bonus_slot"][row])
+        if bslot >= 0:
+            m["bonus_slot"][row] = -1
+            self._bonus_free.append(bslot)
         self.row_uuid[row] = None
         # rows cool until every in-flight cycle that may reference them
         # is consumed (the consumer maps rows -> uuids at readback)
@@ -624,6 +857,7 @@ class ResidentPool:
 
     def _sync_job(self, job) -> None:
         """Reconcile one job's pend row with its store state."""
+        job = self._adjusted(job)
         if job.pool != self.pool:
             self._free_pend(job.uuid)
             return
@@ -678,15 +912,65 @@ class ResidentPool:
         cycle thread only."""
         with self._ev_lock:
             events, self._events = self._events, []
+        self._maybe_refresh_locality()   # network OFF the mirror lock
         self.mirror_lock.acquire()
         try:
             return self._drain_locked(events)
         finally:
             self.mirror_lock.release()
 
+    def _maybe_refresh_locality(self) -> None:
+        """Kick a BACKGROUND data-locality cost fetch on the refresh
+        cadence (the reference's background cost updater,
+        data_locality.clj:66). Never on the cycle thread and never
+        under mirror_lock — a slow or hung cost service must not stall
+        dispatches or the consumer's launch loop. _drain_locked folds
+        the results in whenever dl.generation moves."""
+        dl = self.coord.data_locality
+        if dl is None or not self._dataset_jobs or self._dl_fetching \
+                or self.cycle_no % self.locality_refresh_cycles:
+            return
+        jobs = [j for u in list(self._dataset_jobs)
+                if (j := self.coord.store.get_job(u)) is not None]
+        if not jobs:
+            return
+        self._dl_fetching = True
+
+        def fetch():
+            try:
+                dl.update(jobs)   # TTL-gated internally; thread-safe
+            except Exception:
+                log.exception("data-locality refresh failed")
+            finally:
+                self._dl_fetching = False
+
+        threading.Thread(target=fetch, daemon=True,
+                         name=f"dl-fetch-{self.pool}").start()
+
     def _drain_locked(self, events) -> dict:
         self._release_cooling()
         self._share_cache: dict = {}
+        # launch-filter deferrals whose revalidation time passed come
+        # back into the match (plugins/launch.clj cache expiry; the
+        # age-out force-accept lands at the next consume check)
+        if self._deferred:
+            now = time.monotonic()
+            expired = [u for u, e in self._deferred.items() if e <= now]
+            for u in expired:
+                self._deferred.pop(u, None)
+                job = self.coord.store.get_job(u)
+                if job is not None:
+                    self._sync_job(job)
+        # fold freshly-fetched data-locality costs in (the background
+        # fetch in _maybe_refresh_locality bumped dl.generation):
+        # re-mask dataset jobs' bonus rows — in-memory work only
+        dl = self.coord.data_locality
+        if dl is not None and dl.generation != self._dl_gen:
+            self._dl_gen = dl.generation
+            for u in list(self._dataset_jobs):
+                job = self.coord.store.get_job(u)
+                if job is not None:
+                    self._sync_job(job)
         # reservation changes re-mask the affected jobs (the rebalancer
         # writes reservations between cycles, rebalancer.clj:413-426)
         resv = dict(self.coord.reservations)
@@ -764,11 +1048,13 @@ class ResidentPool:
             "pend": sorted(self._dirty_pend),
             "run": sorted(self._dirty_run),
             "forb": sorted(self._dirty_forb),
+            "bonus": sorted(self._dirty_bonus),
             "credit": self._host_credit,
         }
         self._dirty_pend = set()
         self._dirty_run = set()
         self._dirty_forb = set()
+        self._dirty_bonus = set()
         self._host_credit = {}
         return deltas
 
@@ -805,6 +1091,17 @@ class ResidentPool:
             rows[:len(slots)] = self._forb_rows_m[slots]
         return idx, rows
 
+    def _pack_bonus(self, slots):
+        # zero-width chunk when data locality is off: the fused cycle
+        # still takes the args (one compile shape) but ships no bytes
+        chunk = BONUS_CHUNK if self.with_bonus else 0
+        idx = np.full(chunk, self.bonus_cap, np.int32)
+        idx[:len(slots)] = slots
+        rows = np.zeros((chunk, self.Hcap), np.float32)
+        if slots:
+            rows[:len(slots)] = self._bonus_rows_m[slots]
+        return idx, rows
+
     def _pack_credit(self, items):
         idx = np.full(CREDIT_CHUNK, self.Hcap, np.int32)
         cf = np.zeros((3, CREDIT_CHUNK), np.float32)
@@ -820,6 +1117,7 @@ class ResidentPool:
         the fused cycle consumes. Changes beyond one chunk per table
         spill into standalone scatter dispatches first (rare)."""
         pend, run, forb = deltas["pend"], deltas["run"], deltas["forb"]
+        bonus = deltas.get("bonus", [])
         credit = list(deltas["credit"].items())
         while len(pend) > DELTA_CHUNK:
             rows, pend = pend[:DELTA_CHUNK], pend[DELTA_CHUNK:]
@@ -830,12 +1128,17 @@ class ResidentPool:
         while len(forb) > FORB_CHUNK:
             slots, forb = forb[:FORB_CHUNK], forb[FORB_CHUNK:]
             self.state = _scatter_forb(self.state, *self._pack_forb(slots))
+        while len(bonus) > BONUS_CHUNK:   # empty when with_bonus is off
+            slots, bonus = bonus[:BONUS_CHUNK], bonus[BONUS_CHUNK:]
+            self.state = _scatter_bonus(self.state,
+                                        *self._pack_bonus(slots))
         while len(credit) > CREDIT_CHUNK:
             part, credit = credit[:CREDIT_CHUNK], credit[CREDIT_CHUNK:]
             self.state = _scatter_credit(self.state,
                                          *self._pack_credit(part))
         bundle = (*self._pack_pend(pend), *self._pack_run(run),
-                  *self._pack_credit(credit), *self._pack_forb(forb))
+                  *self._pack_credit(credit), *self._pack_forb(forb),
+                  *self._pack_bonus(bonus))
         return bundle
 
     def flush(self, deltas: Optional[dict] = None) -> None:
@@ -844,6 +1147,7 @@ class ResidentPool:
         if deltas is None:
             deltas = self.drain()
         pend, run, forb = deltas["pend"], deltas["run"], deltas["forb"]
+        bonus = deltas.get("bonus", [])
         credit = list(deltas["credit"].items())
         for lo in range(0, len(pend), DELTA_CHUNK):
             self.state = _scatter_pend(
@@ -854,6 +1158,9 @@ class ResidentPool:
         for lo in range(0, len(forb), FORB_CHUNK):
             self.state = _scatter_forb(
                 self.state, *self._pack_forb(forb[lo:lo + FORB_CHUNK]))
+        for lo in range(0, len(bonus), BONUS_CHUNK):
+            self.state = _scatter_bonus(
+                self.state, *self._pack_bonus(bonus[lo:lo + BONUS_CHUNK]))
         for lo in range(0, len(credit), CREDIT_CHUNK):
             self.state = _scatter_credit(
                 self.state, *self._pack_credit(credit[lo:lo + CREDIT_CHUNK]))
@@ -867,12 +1174,14 @@ class ResidentPool:
         # stability
         num_groups = (1 if not self._group_ids
                       else bucket(len(self._group_ids)))
+        now_s = np.int32((time.time() * 1000.0 - self._t0_ms) / 1000.0)
         self.state, out = _device_cycle(
             self.state, bundle, qm, qc, qn,
-            np.int32(considerable_limit),
+            np.int32(considerable_limit), now_s,
             num_considerable=num_considerable, sequential=sequential,
             num_groups=int(num_groups), dru_mode=dru_mode,
-            use_pallas=use_pallas, match_kw=match_kw)
+            use_pallas=use_pallas, match_kw=match_kw,
+            with_bonus=self.with_bonus, with_est=self.with_est)
         co = _CycleOut(self.cycle_no, *out, t_dispatch=time.perf_counter())
         self._inflight.append(co)
         self.cycle_no += 1
@@ -891,6 +1200,11 @@ class ResidentPool:
         if self._force_resync:
             return True
         if self.cycle_no - self._last_resync_cycle >= self.resync_interval:
+            return True
+        # a plugin / cost store / est-completion config installed (or
+        # removed) after the last rebuild must fully apply, not
+        # half-apply via the consume path only
+        if self._feature_sig() != self._built_sig:
             return True
         for cluster in self.coord.clusters.all():
             gen = getattr(cluster, "offer_generation", None)

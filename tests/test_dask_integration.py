@@ -45,6 +45,7 @@ def test_scale_up_and_down(server):
     assert all(j is not None and "dask-worker" in j.command for j in jobs)
     # workers get matched and run
     coord.match_cycle()
+    coord.drain_resident()   # async consumer: flush launch writeback
     assert all(store.get_job(u).state.value == "running"
                for u in cluster.worker_uuids())
     # scale down kills the surplus
@@ -86,6 +87,7 @@ def test_cook_job_lifecycle(server):
     job.start()
     assert job.status() == "waiting"
     coord.match_cycle()
+    coord.drain_resident()   # async consumer: flush launch writeback
     assert job.running()
     job.close()
     assert job.status() == "completed"

@@ -267,8 +267,253 @@ def test_resident_host_set_change_resyncs():
     assert sum(j.state == JobState.RUNNING for j in jobs) == 4
 
 
-def test_resident_rejects_plugin_config():
+def test_resident_accepts_plugin_config():
+    """r4: the resident path supports launch plugins (the r3 refusal is
+    gone — fast and full-featured are no longer disjoint modes)."""
+    from cook_tpu.plugins import (CachedLaunchFilter, LaunchFilter,
+                                  PluginRegistry)
     store, cluster, coord = build()
-    coord.plugins = object()
-    with pytest.raises(ValueError):
-        coord.enable_resident()
+    coord.plugins = PluginRegistry(
+        launch=CachedLaunchFilter(LaunchFilter()))
+    coord.enable_resident()
+    j = mkjob()
+    store.create_jobs([j])
+    store.commit_jobs([j.uuid])
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+
+
+def test_resident_launch_filter_defers_then_launches():
+    """Launch-filter parity (plugins/launch.clj:59-121): a deferred job
+    is refused at consume, its row parks until the cache expiry, and it
+    launches once the filter accepts."""
+    import time as _time
+
+    from cook_tpu.plugins import (CachedLaunchFilter, LaunchFilter,
+                                  PluginRegistry, accepted, deferred)
+
+    class Gate(LaunchFilter):
+        def __init__(self):
+            self.open = False
+
+        def check_job_launch(self, job):
+            return accepted() if self.open else deferred(for_s=0.05)
+
+    gate = Gate()
+    store, cluster, coord = build()
+    coord.plugins = PluginRegistry(
+        launch=CachedLaunchFilter(gate, age_out_s=0.2))
+    coord.enable_resident()
+    job = mkjob()
+    store.create_jobs([job])
+    stats = coord.match_cycle()
+    # matched on device but refused at consume; capacity credited back
+    assert stats.matched == 0
+    assert job.state == JobState.WAITING
+    rp = coord._resident["default"]
+    assert job.uuid in rp._deferred
+    gate.open = True
+    _time.sleep(0.3)          # past the defer expiry (age_out_s/4 floor)
+    coord.match_cycle()       # drain revalidates the row
+    stats = coord.match_cycle()
+    assert job.state == JobState.RUNNING
+    assert_state_matches_rebuild(coord)
+
+
+def test_resident_launch_filter_age_out_forces_launch():
+    """A job deferred past age_out_s launches regardless — plugins
+    can't starve a job forever (launch.clj age-out)."""
+    import time as _time
+
+    from cook_tpu.plugins import (CachedLaunchFilter, LaunchFilter,
+                                  PluginRegistry, deferred)
+
+    class Never(LaunchFilter):
+        def check_job_launch(self, job):
+            return deferred(for_s=0.02)
+
+    store, cluster, coord = build()
+    coord.plugins = PluginRegistry(
+        launch=CachedLaunchFilter(Never(), age_out_s=0.1))
+    coord.enable_resident()
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert job.state == JobState.WAITING
+    deadline = _time.monotonic() + 5.0
+    while job.state == JobState.WAITING and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+        coord.match_cycle()
+    assert job.state == JobState.RUNNING
+
+
+def test_resident_adjuster_pool_migration():
+    """Adjuster parity (plugins/adjustment.clj): a per-cycle adjuster
+    migrating a user's jobs out of the pool removes them from this
+    pool's resident state."""
+    from cook_tpu.plugins import JobAdjuster, PluginRegistry
+
+    class Mover(JobAdjuster):
+        def adjust_job(self, job):
+            if job.user == "bob":
+                job.pool = "gpu-pool"
+            return job
+
+    store, cluster, coord = build()
+    coord.plugins = PluginRegistry(adjuster=Mover())
+    coord.enable_resident()
+    a, b = mkjob(user="alice"), mkjob(user="bob")
+    store.create_jobs([a, b])
+    stats = coord.match_cycle()
+    assert stats.matched == 1
+    assert a.state == JobState.RUNNING
+    assert b.state == JobState.WAITING
+    rp = coord._resident["default"]
+    assert b.uuid not in rp.pend_row     # lives in gpu-pool's cycle now
+
+
+def test_resident_data_locality_bonus():
+    """Data-locality parity (data_locality.clj:192): a dataset job's
+    sparse bonus row steers it to the low-cost host."""
+    from cook_tpu.scheduler.data_locality import DataLocalityCosts
+
+    hosts = [MockHost("far", mem=1000, cpus=16),
+             MockHost("near", mem=1000, cpus=16)]
+    store, cluster, coord = build(hosts=hosts)
+    coord.data_locality = DataLocalityCosts(
+        fetcher=lambda uuids: {u: {"near": 0.0, "far": 1.0}
+                               for u in uuids},
+        weight=0.9)
+    coord.enable_resident()
+    job = mkjob(datasets=[{"dataset": {"bucket": "b"}}])
+    # pre-warm the cost cache (the fetch is async on the drain cadence;
+    # a job matched before costs arrive places without the bonus, like
+    # the reference's background cost updater)
+    coord.data_locality.update([job])
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert job.state == JobState.RUNNING
+    assert job.instances[0].hostname == "near"
+
+
+def test_resident_estimated_completion_lane():
+    """Estimated-completion parity (constraints.clj:200-247): a job
+    whose scaled expected runtime outlives a host's remaining lifetime
+    must land elsewhere, via the device time-lane."""
+    import time as _time
+
+    from cook_tpu.scheduler.coordinator import (EstimatedCompletionConfig,
+                                                SchedulerConfig)
+
+    now_s = _time.time()
+    # dying: 29 of 30 lifetime minutes elapsed -> ~60 s left
+    hosts = [MockHost("dying", mem=1000, cpus=16,
+                      attributes={"host-start-time":
+                                  str(now_s - 29 * 60)}),
+             MockHost("fresh", mem=1000, cpus=16,
+                      attributes={"host-start-time": str(now_s)})]
+    cfg = SchedulerConfig(estimated_completion=EstimatedCompletionConfig(
+        expected_runtime_multiplier=1.0, host_lifetime_mins=30.0))
+    store, cluster, coord = build(hosts=hosts, config=cfg)
+    coord.enable_resident()
+    rp = coord._resident["default"]
+    assert rp.with_est
+    long_job = mkjob(expected_runtime_ms=10 * 60 * 1000)   # 10 min
+    store.create_jobs([long_job])
+    coord.match_cycle()
+    assert long_job.state == JobState.RUNNING
+    assert long_job.instances[0].hostname == "fresh"
+    # an unconstrained job may still use the dying host
+    quick = mkjob()
+    store.create_jobs([quick])
+    coord.match_cycle()
+    assert quick.state == JobState.RUNNING
+
+
+def test_resident_rebuild_grows_sparse_caps():
+    """A rebuild whose constrained-job demand exceeds forb_cap grows
+    the cap and retries instead of wedging in a resync loop."""
+    hosts = [MockHost(f"h{i}", mem=1000, cpus=16,
+                      attributes={"rack": "a"}) for i in range(2)]
+    store, cluster, coord = build(hosts=hosts)
+    jobs = [mkjob(cpus=1, constraints=[["rack", "EQUALS", "a"]])
+            for _ in range(12)]
+    store.create_jobs(jobs)
+    coord.enable_resident(forb_cap=2)      # far under the 12 needed
+    rp = coord._resident["default"]
+    assert rp.forb_cap >= 12
+    stats = coord.match_cycle()
+    assert stats.matched > 0
+
+
+def test_resident_pools_pinned_per_device():
+    """SURVEY §2.5.1 per-pool parallel loops: one Coordinator, one
+    resident pool per (virtual) device, full launch/complete flow on
+    each — the production path's multi-chip story (VERDICT r3 #6)."""
+    import jax
+
+    from cook_tpu.state.pools import Pool, PoolRegistry
+
+    devs = jax.devices()
+    n = min(4, len(devs))
+    if n < 2:
+        pytest.skip("needs >=2 devices (conftest forces 8 CPU devices)")
+    store = JobStore()
+    pools = PoolRegistry("pool0")
+    hosts = []
+    for p in range(n):
+        pools.add(Pool(name=f"pool{p}"))
+        hosts += [MockHost(f"p{p}h{i}", mem=1000, cpus=16,
+                           pool=f"pool{p}") for i in range(2)]
+    cluster = MockCluster(hosts, runtime_fn=lambda s: (5.0, True, None))
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, pools=pools)
+    for p in range(n):
+        coord.enable_resident(f"pool{p}", device=devs[p])
+    jobs = [mkjob(pool=f"pool{i % n}") for i in range(4 * n)]
+    store.create_jobs(jobs)
+    launched = 0
+    for p in range(n):
+        launched += coord.match_cycle(f"pool{p}").matched
+    assert launched == 4 * n
+    assert cluster.advance(10.0) == 4 * n
+    placements = {
+        p: next(iter(
+            coord._resident[f"pool{p}"].state["pend"]["mem"].devices()))
+        for p in range(n)}
+    assert len(set(placements.values())) == n
+
+
+def test_resident_late_installed_adjuster_forces_rebuild():
+    """A match-affecting plugin installed AFTER enable_resident must
+    fully apply (rebuild with adjusted mirrors), not half-apply via the
+    consume path only — the mirrors would otherwise bin-pack with
+    unadjusted sizes while launch uses adjusted ones."""
+    from cook_tpu.plugins import JobAdjuster, PluginRegistry
+
+    class ClampMem(JobAdjuster):
+        # idempotent, like every legal in-place adjuster: the reference
+        # re-applies adjusters each cycle to the same store-backed jobs
+        def adjust_job(self, job):
+            job.mem = max(job.mem, 200.0)
+            return job
+
+    store, cluster, coord = build()
+    coord.enable_resident()
+    rp = coord._resident["default"]
+    job = mkjob(mem=100)
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert job.state == JobState.RUNNING
+    # install the adjuster live: the next cycle must detect the config
+    # change and rebuild with adjusted values
+    coord.plugins = PluginRegistry(adjuster=ClampMem())
+    assert rp.resync_due()
+    j2 = mkjob(mem=100)
+    store.create_jobs([j2])
+    coord.match_cycle()
+    assert j2.state == JobState.RUNNING
+    assert j2.mem == 200.0   # adjusted value everywhere (store mutated)
+    coord.match_cycle()      # insts event drains; row freed
+    assert j2.uuid not in rp.pend_row

@@ -315,6 +315,73 @@ def test_user_usage():
     assert usage["alice"]["mem"] == 200.0
 
 
+def _usage_scan_oracle(store, pool=None):
+    """The r3 O(all jobs) implementation, kept as the oracle for the
+    incremental aggregates."""
+    out = {}
+    for j in store.jobs.values():
+        if j.state != JobState.RUNNING or (pool is not None
+                                           and j.pool != pool):
+            continue
+        if not j.active_instances:
+            continue
+        u = out.setdefault(j.user, {"mem": 0.0, "cpus": 0.0, "gpus": 0.0,
+                                    "jobs": 0})
+        u["mem"] += j.mem
+        u["cpus"] += j.cpus
+        u["gpus"] += j.gpus
+        u["jobs"] += 1
+    return out
+
+
+def test_user_usage_incremental_matches_scan_under_churn(tmp_path):
+    """/usage is now O(active users) via aggregates maintained at every
+    transition; random launch/complete/fail/kill/retry churn must keep
+    them equal to the full scan — including across a log replay."""
+    import random
+    rng = random.Random(11)
+    log = str(tmp_path / "ev.log")
+    s = JobStore(log_path=log)
+    jobs = [Job(uuid=new_uuid(), user=f"u{i % 5}", command="true",
+                mem=10.0 * (i % 7 + 1), cpus=float(i % 3 + 1),
+                max_retries=3)
+            for i in range(60)]
+    s.create_jobs(jobs)
+    running = []
+    for step in range(300):
+        op = rng.random()
+        if op < 0.4 and len(running) < 40:
+            j = rng.choice(jobs)
+            try:
+                inst = s.create_instance(j.uuid, f"h{step % 8}", "mock")
+                running.append(inst.task_id)
+            except TransactionError:
+                pass
+        elif op < 0.7 and running:
+            tid = running.pop(rng.randrange(len(running)))
+            s.update_instance(tid, InstanceStatus.SUCCESS
+                              if rng.random() < 0.5
+                              else InstanceStatus.FAILED,
+                              reason_code=1003)
+        elif op < 0.8 and running:
+            tid = running.pop(rng.randrange(len(running)))
+            s.update_instance(tid, InstanceStatus.FAILED,
+                              reason_code=2000, preempted=True)
+        elif op < 0.9:
+            j = rng.choice(jobs)
+            s.kill_job(j.uuid)
+            running = [t for t in running
+                       if s.task_to_job.get(t) != j.uuid]
+        if step % 50 == 0:
+            assert s.user_usage() == _usage_scan_oracle(s)
+            assert s.user_usage("default") == _usage_scan_oracle(
+                s, "default")
+    assert s.user_usage() == _usage_scan_oracle(s)
+    # replay rebuilds the same aggregates
+    r = JobStore.restore(log_path=log)
+    assert r.user_usage() == _usage_scan_oracle(r)
+
+
 # ---------------------------------------------------------------- limits
 def test_share_default_fallback():
     shares = ShareStore()
