@@ -116,6 +116,27 @@ class ApiServer:
         self.httpd.server_close()
 
 
+def apply_gc_discipline() -> None:
+    """Move the store's long-lived object graph out of the cyclic
+    collector's reach. At 100k jobs the store holds ~10^6 live objects
+    and every CPython gen-2 sweep walks them all — multi-hundred-ms
+    pauses landing in the match cycle's p99 (measured, docs/
+    benchmarks.md round 3). Called ONCE at leadership takeover, after
+    the replay materializes the store: gen-2 sweeps afterwards walk
+    only post-takeover objects, whose population is bounded by live
+    churn rather than total store size. Deliberately NOT re-run
+    periodically — freezing transient objects (request state, queue
+    items, exception frames) would leak any of them that later die as
+    part of a reference cycle, and the gc.collect() here is itself the
+    multi-hundred-ms pause we keep off the live match path. Frozen
+    objects still free via refcounting; the native handles use
+    weakref.finalize, which freeze does not break (a __del__-based
+    finalizer would never run — see native/eventlog.py)."""
+    import gc
+    gc.collect()
+    gc.freeze()
+
+
 def build_scheduler(config, read_only=False):
     """Assemble a full single-process scheduler from a Settings tree or
     raw config dict (the components.clj scheduler-server graph
@@ -373,6 +394,10 @@ def main(argv=None) -> None:
         # live tasks the agents report
         if not _still_leader():
             raise RuntimeError("leadership lost during takeover init")
+        # the replayed store is long-lived by definition: freeze it out
+        # of the cyclic collector so gen-2 sweeps can't spike the match
+        # cycle (the same tuning the e2e bench measures with)
+        apply_gc_discipline()
         api.leader_ready.set()
 
         def tick():  # real-time driver for mock virtual clocks + monitor

@@ -395,6 +395,22 @@ class Coordinator:
             self.store.add_listener(self._resident_listener)
         rp = ResidentPool(self, pool, synchronous=synchronous, **kw)
         self._resident[pool] = rp
+        if not synchronous:
+            import queue
+            # per-pool launcher thread: the consumer hands each cycle's
+            # per-cluster specs over and moves straight to the next
+            # readback — the backend hand-off (HTTP posts, mock Python)
+            # must not serialize the consume pipeline. One thread per
+            # pool keeps per-pool launch ordering; the store txn
+            # ALREADY committed before enqueue (kill-lock order), and a
+            # kill racing the short queue delay is caught by the same
+            # reconcile/heartbeat backstops that cover a slow backend.
+            rp._launch_q = queue.Queue(maxsize=4)
+            t = threading.Thread(target=self._launch_loop,
+                                 args=(pool, rp), daemon=True,
+                                 name=f"resident-launcher-{pool}")
+            t.start()
+            self._threads.append(t)
         if not synchronous and not hasattr(self, "_consume_q"):
             import queue
             self._consume_q: "queue.Queue" = queue.Queue(maxsize=2)
@@ -412,6 +428,23 @@ class Coordinator:
         migrations must land in the destination pool's state)."""
         for rp in getattr(self, "_resident", {}).values():
             rp.mark_job_dirty(uuid)
+
+    def _launch_loop(self, pool: str, rp) -> None:
+        while True:
+            item = rp._launch_q.get()
+            try:
+                if item is None:
+                    return
+                cname, specs = item
+                try:
+                    self.clusters.get(cname).launch_tasks(pool, specs)
+                except Exception:
+                    # per backend contract launch_tasks shouldn't raise;
+                    # a transport-level failure surfaces as task
+                    # statuses via reconciliation
+                    log.exception("backend launch to %s failed", cname)
+            finally:
+                rp._launch_q.task_done()
 
     def _consume_loop(self) -> None:
         while True:
@@ -434,25 +467,53 @@ class Coordinator:
                 rp.request_resync()
 
     def drain_resident(self, pool: Optional[str] = None) -> None:
-        """Block until every in-flight resident cycle is consumed (tests
-        and shutdown)."""
+        """Block until every in-flight resident cycle is consumed AND
+        its backend launches handed off (tests and shutdown)."""
         pools = [pool] if pool else list(getattr(self, "_resident", {}))
         for p in pools:
             rp = self._resident.get(p)
             while rp is not None and rp._inflight:
                 time.sleep(0.001)
+            q = getattr(rp, "_launch_q", None)
+            if q is not None:
+                q.join()
 
     def _match_cycle_resident(self, pool: str, rp) -> MatchStats:
         t0 = time.perf_counter()
         stats = MatchStats()
         self._purge_reservations()
-        # a due resync must wait for the in-flight cycles (their row
-        # mappings die with the rebuild); draining them bounds the wait
-        # at the consumer queue depth, so a due resync always runs this
-        # cycle instead of being skipped under sustained load
-        if rp.resync_due():
-            self.drain_resident(pool)
-            rp.resync()
+        # periodic drift backstop: LIGHT membership reconcile (no
+        # in-flight drain, no re-upload). A full rebuild — host-set /
+        # feature-config changes, consumer failures, every Nth periodic
+        # — must wait for the in-flight cycles (their row mappings die
+        # with the rebuild); draining them bounds the wait at the
+        # consumer queue depth, so a due resync always runs this cycle
+        # instead of being skipped under sustained load.
+        reason = rp.resync_reason()
+        if reason is not None:
+            from cook_tpu.scheduler.resident import _NeedResync
+            t_rs = time.perf_counter()
+            if reason == "full":
+                self.drain_resident(pool)
+                rp.resync()
+            else:
+                try:
+                    rp.reconcile_membership()
+                except _NeedResync as e:
+                    # backlog outgrew the row slack between full
+                    # rebuilds: fall back to the full rebuild (which
+                    # re-sizes Pcap/Rcap) instead of wedging —
+                    # reconcile's partial mutations are wiped by it
+                    log.info("light resync overflowed (%s); "
+                             "falling back to full rebuild", e)
+                    reason = "full"
+                    self.drain_resident(pool)
+                    rp.resync()
+            self.metrics[f"match.{pool}.resync_ms"] = \
+                (time.perf_counter() - t_rs) * 1e3
+            metrics_registry.timer(
+                f"match.{pool}.resync_{reason}_ms").update(
+                (time.perf_counter() - t_rs) * 1e3)
         try:
             deltas = rp.drain()
             t_drain = time.perf_counter()
@@ -544,72 +605,97 @@ class Coordinator:
             self.plugins is not None
             and getattr(self.plugins, "affects_match_cycle",
                         lambda: True)()) else None
+        # vectorized pre-pass (r3 weak #5: this loop was 28 ms / 1024
+        # matched of per-item numpy scalar work): mask + gather the
+        # matched slots and the credit columns in bulk, convert to
+        # plain Python lists ONCE, then run the per-job policy loop
+        # over native values only.
+        cons_idx = np.asarray(cons_idx)
+        cons_host = np.asarray(cons_host)
+        ok = (cons_idx >= 0) & (cons_host >= 0) \
+            & (cons_host < len(rp.host_names))
+        sel_rows = cons_idx[ok]
+        candidates = []   # (uuid, h, job, credit)
         with rp.mirror_lock:
             m = rp._pend_m
-            for i in range(len(cons_idx)):
-                row = int(cons_idx[i])
-                h = int(cons_host[i])
-                if row < 0 or h < 0 or h >= len(rp.host_names):
-                    continue
-                uuid = rp.row_uuid[row]
-                job = self.store.get_job(uuid) if uuid else None
-                hostname = rp.host_names[h]
+            rows_l = sel_rows.tolist()
+            hosts_l = cons_host[ok].tolist()
+            mem_l = m["mem"][sel_rows].tolist()
+            cpus_l = m["cpus"][sel_rows].tolist()
+            gpus_l = m["gpus"][sel_rows].tolist()
+            ports_l = m["ports"][sel_rows].tolist()
+            row_uuid = rp.row_uuid
+            get_job = self.store.get_job
+            for row, h, c_mem, c_cpus, c_gpus, c_ports in zip(
+                    rows_l, hosts_l, mem_l, cpus_l, gpus_l, ports_l):
+                uuid = row_uuid[row]
+                job = get_job(uuid) if uuid else None
                 # mirror values are what the device depleted at match
                 # (cooling blocks row reuse), so crediting them back is
                 # exact — for freed rows AND refused launches alike
-                credit = (h, float(m["mem"][row]), float(m["cpus"][row]),
-                          float(m["gpus"][row]), 1, int(m["ports"][row]))
+                credit = (h, c_mem, c_cpus, c_gpus, 1, c_ports)
                 if job is None:
                     # row freed by a racing kill
                     rp.queue_credit(*credit)
                     continue
-
-                def refuse():
+                candidates.append((uuid, h, job, credit))
+        # policy pass OUTSIDE the mirror lock: a slow launch plugin or
+        # port allocator must not block the cycle thread's drain (the
+        # same rule _maybe_refresh_locality follows for cost fetches)
+        host_names = rp.host_names
+        offer_cluster = rp.offer_cluster
+        rl = self.user_launch_rl
+        rl_on = rl.enforce
+        deferrals = []    # (uuid, until) — applied under the lock below
+        for uuid, h, job, credit in candidates:
+            if plug is not None:
+                job = plug.adjuster.adjust_job(job)
+                if job.pool != pool:
+                    # adjuster migrated the job (pool_mover): it
+                    # belongs to the destination pool's cycle
                     rp.queue_credit(*credit)
-
-                if plug is not None:
-                    job = plug.adjuster.adjust_job(job)
-                    if job.pool != pool:
-                        # adjuster migrated the job (pool_mover): it
-                        # belongs to the destination pool's cycle
-                        refuse()
-                        self._mark_dirty_all(uuid)
-                        continue
-                    if not plug.launch.check(job):
-                        refuse()
-                        rp.defer_job_locked(
-                            uuid,
-                            time.monotonic() + plug.launch.defer_for(uuid))
-                        continue
-                if not self.user_launch_rl.try_acquire(job.user):
-                    refuse()
-                    rp.mark_job_dirty(uuid)
+                    self._mark_dirty_all(uuid)
                     continue
-                ports: list[int] = []
-                if job.ports > 0:
-                    cluster = self.clusters.get(rp.offer_cluster[hostname])
-                    alloc = getattr(cluster, "allocate_ports", None)
-                    if alloc is not None:
-                        ports = alloc(hostname, job.ports)
-                        if not ports:
-                            # genuine exhaustion: defer to a later cycle
-                            refuse()
-                            rp.mark_job_dirty(uuid)
-                            continue
-                        ports = list(ports)
-                    else:
-                        # backend advertises no allocator: it matched
-                        # because it advertised port capacity in its
-                        # offers (backends without ports never match a
-                        # ports job — the kernel forbids it). Launch
-                        # without assigned numbers rather than refusing
-                        # forever; the backend owns port binding.
-                        log.warning("cluster %s lacks allocate_ports; "
-                                    "launching %s without assigned "
-                                    "ports", cluster.name, uuid)
-                        ports = []
-                items.append((uuid, hostname, rp.offer_cluster[hostname]))
-                item_jobs.append((job, ports, credit))
+                if not plug.launch.check(job):
+                    rp.queue_credit(*credit)
+                    deferrals.append(
+                        (uuid,
+                         time.monotonic() + plug.launch.defer_for(uuid)))
+                    continue
+            if rl_on and not rl.try_acquire(job.user):
+                rp.queue_credit(*credit)
+                rp.mark_job_dirty(uuid)
+                continue
+            hostname = host_names[h]
+            ports: list[int] = []
+            if job.ports > 0:
+                cluster = self.clusters.get(offer_cluster[hostname])
+                alloc = getattr(cluster, "allocate_ports", None)
+                if alloc is not None:
+                    ports = alloc(hostname, job.ports)
+                    if not ports:
+                        # genuine exhaustion: defer to a later cycle
+                        rp.queue_credit(*credit)
+                        rp.mark_job_dirty(uuid)
+                        continue
+                    ports = list(ports)
+                else:
+                    # backend advertises no allocator: it matched
+                    # because it advertised port capacity in its
+                    # offers (backends without ports never match a
+                    # ports job — the kernel forbids it). Launch
+                    # without assigned numbers rather than refusing
+                    # forever; the backend owns port binding.
+                    log.warning("cluster %s lacks allocate_ports; "
+                                "launching %s without assigned "
+                                "ports", cluster.name, uuid)
+                    ports = []
+            items.append((uuid, hostname, offer_cluster[hostname]))
+            item_jobs.append((job, ports, credit))
+        if deferrals:
+            with rp.mirror_lock:
+                for uuid, until in deferrals:
+                    rp.defer_job_locked(uuid, until)
         t_loop = time.perf_counter()
         self.metrics[f"match.{pool}.launch_loop_ms"] = \
             (t_loop - t_rb1) * 1e3
@@ -652,8 +738,12 @@ class Coordinator:
                 self.heartbeats.track(inst.task_id)
             self.launch_rl.spend("global")
             self.reservations.pop(uuid, None)
+        launch_q = getattr(rp, "_launch_q", None)
         for cname, specs in by_cluster.items():
-            self.clusters.get(cname).launch_tasks(pool, specs)
+            if launch_q is not None:
+                launch_q.put((cname, specs))   # launcher thread, in order
+            else:
+                self.clusters.get(cname).launch_tasks(pool, specs)
         # scaleback feedback (scheduler.clj:1002-1036)
         if head_matched:
             self._num_considerable[pool] = self.config.max_jobs_considered
@@ -1449,6 +1539,10 @@ class Coordinator:
         if hasattr(self, "_consume_q"):
             self.drain_resident()
             self._consume_q.put(None)
+        for rp in getattr(self, "_resident", {}).values():
+            q = getattr(rp, "_launch_q", None)
+            if q is not None:
+                q.put(None)
         for t in self._threads:
             t.join(timeout=2)
         # drain queued status updates before the workers die: a dropped

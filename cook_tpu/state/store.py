@@ -552,9 +552,15 @@ class JobStore:
         return list(self._pending.get(pool, {}).values())
 
     def running_jobs(self, pool: Optional[str] = None) -> list[Job]:
-        return [j for j in self.jobs.values()
-                if j.state == JobState.RUNNING
-                and (pool is None or j.pool == pool)]
+        """O(running), not O(all jobs ever): served from the
+        _usage_jobs index (exactly the RUNNING uuids, maintained at
+        every transition) — a long-lived leader accumulates hundreds of
+        thousands of completed jobs, and this scan sits on the rank/
+        rebalance/reconcile paths."""
+        with self._lock:
+            jobs = [self.jobs[u] for u in self._usage_jobs]
+        return [j for j in jobs
+                if pool is None or j.pool == pool]
 
     def running_instances(self, pool: Optional[str] = None) -> list[Instance]:
         return [i for j in self.running_jobs(pool) for i in j.active_instances]

@@ -517,3 +517,36 @@ def test_resident_late_installed_adjuster_forces_rebuild():
     assert j2.mem == 200.0   # adjusted value everywhere (store mutated)
     coord.match_cycle()      # insts event drains; row freed
     assert j2.uuid not in rp.pend_row
+
+
+def test_resident_light_resync_corrects_membership_drift():
+    """The periodic resync is now a LIGHT membership reconcile (no
+    rebuild, no in-flight drain): simulate missed store events and
+    check the interval backstop repairs both directions — missed
+    creates start matching, missed terminals free rows and credit
+    capacity back."""
+    store, cluster, coord = build(n_hosts=4)
+    coord.enable_resident(resync_interval=8, full_resync_every=1000)
+    rp = coord._resident["default"]
+    jobs = [mkjob() for _ in range(4)]
+    store.create_jobs(jobs)
+    coord.match_cycle()
+    assert all(j.state == JobState.RUNNING for j in jobs)
+
+    # missed CREATE events: drop the listener while submitting
+    store._listeners.remove(coord._resident_listener)
+    missed = [mkjob() for _ in range(3)]
+    store.create_jobs(missed)
+    # missed TERMINAL events too: completions the pool never hears
+    cluster.advance(120.0)
+    assert all(j.state == JobState.COMPLETED for j in jobs)
+    store.add_listener(coord._resident_listener)
+
+    coord.match_cycle()
+    assert all(j.state == JobState.WAITING for j in missed)  # drifted
+    for _ in range(10):     # cross the resync_interval boundary
+        coord.match_cycle()
+    assert rp._light_since_full >= 1
+    assert all(j.state == JobState.RUNNING for j in missed)
+    coord.match_cycle()
+    assert_state_matches_rebuild(coord)

@@ -502,3 +502,27 @@ def test_openapi_covers_every_route(stack):
         "properties"]["jobs"]["items"]["properties"]
     # alias
     assert call(api, "GET", "/swagger-docs").status == 200
+
+
+def test_apply_gc_discipline_freezes_store_objects():
+    """The leader freezes the replayed store out of the cyclic
+    collector (docs/architecture.md GC discipline): the helper must
+    actually move the store's object graph into the permanent
+    generation, and leave collection working for new garbage."""
+    import gc
+
+    from cook_tpu.rest.server import apply_gc_discipline
+    from cook_tpu.state.model import Job, new_uuid
+    from cook_tpu.state.store import JobStore
+
+    store = JobStore()
+    store.create_jobs([Job(uuid=new_uuid(), user="u", command="true",
+                           mem=1, cpus=1) for _ in range(5000)])
+    base = gc.get_freeze_count()
+    try:
+        apply_gc_discipline()
+        assert gc.get_freeze_count() - base > 5000
+        gc.collect()   # collector still runs for post-freeze garbage
+        assert store.get_job(next(iter(store.jobs))) is not None
+    finally:
+        gc.unfreeze()
