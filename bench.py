@@ -105,14 +105,6 @@ def _cycle_setup(R, P, H, U, seed=0, contended=False):
     rng = np.random.default_rng(seed)
     INF = np.float32(3.4e38)
     dev = jax.devices()[0]
-    # contended: wide job-size spread against tight hosts — the mix the
-    # fairness-at-scale tests use, where the window rounds alone leave
-    # head-window inversions and the AdaptiveHead climbs off the bottom
-    # rung (the published head=256 floor's workload)
-    pend_mem = (rng.uniform(1, 180, P) if contended
-                else rng.uniform(1, 10, P))
-    pend_cpus = (rng.uniform(0.5, 14, P) if contended
-                 else rng.uniform(0.5, 4, P))
     args = (
         jnp.asarray(rng.integers(0, U, R), jnp.int32),
         jnp.asarray(rng.uniform(1, 10, R), jnp.float32),
@@ -123,8 +115,15 @@ def _cycle_setup(R, P, H, U, seed=0, contended=False):
         jnp.full(R, 1000.0, jnp.float32),
         jnp.full(R, 200.0, jnp.float32),
         jnp.asarray(rng.integers(0, U, P), jnp.int32),
-        jnp.asarray(pend_mem, jnp.float32),
-        jnp.asarray(pend_cpus, jnp.float32),
+        # contended: wide job-size spread against tight hosts — the mix
+        # the fairness-at-scale tests use, where the window rounds alone
+        # leave head-window inversions and the AdaptiveHead climbs off
+        # the bottom rung. Draws stay IN PLACE so the default workload's
+        # RNG stream is bit-identical to earlier rounds' published runs.
+        jnp.asarray(rng.uniform(1, 180, P) if contended
+                    else rng.uniform(1, 10, P), jnp.float32),
+        jnp.asarray(rng.uniform(0.5, 14, P) if contended
+                    else rng.uniform(0.5, 4, P), jnp.float32),
         jnp.zeros(P, jnp.float32),
         jnp.asarray(rng.integers(0, 3, P), jnp.int32),
         jnp.asarray(rng.integers(100, 200, P), jnp.int32),
@@ -581,9 +580,11 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
     # the seeded baseline is ~10^6 long-lived objects; without freezing
     # them, periodic gen-2 GC scans show up as multi-hundred-ms p99
     # spikes that have nothing to do with the scheduler. This is the
-    # SAME discipline the production server applies at takeover and on
-    # the snapshot cadence (rest/server.py apply_gc_discipline), so the
-    # bench no longer measures tuning a deployment wouldn't have.
+    # SAME discipline the production server applies ONCE at leadership
+    # takeover (rest/server.py apply_gc_discipline — deliberately not
+    # periodic), applied at the same lifecycle point here (after
+    # seeding, before cycling), so the bench no longer measures tuning
+    # a deployment wouldn't have.
     from cook_tpu.rest.server import apply_gc_discipline
     apply_gc_discipline()
 

@@ -122,6 +122,15 @@ class _Interner:
         return i
 
 
+def _destroy_handle(lib, h):
+    """Module-level so the finalizer holds no reference to the builder."""
+    try:
+        if h:
+            lib.mb_destroy(h)
+    except Exception:
+        pass
+
+
 class NativeForbiddenBuilder:
     """Drop-in producer of the forbidden[P, H] mask.
 
@@ -155,13 +164,13 @@ class NativeForbiddenBuilder:
         # in from the match loop, the rebalancer loop, and backend status
         # threads (forget), and ctypes releases the GIL — serialize here
         self._lock = threading.Lock()
-
-    def __del__(self):
-        try:
-            if getattr(self, "_h", 0):
-                self._lib.mb_destroy(self._h)
-        except Exception:
-            pass
+        # weakref.finalize (NOT __del__): the server gc.freeze()s the
+        # coordinator graph at takeover, and a frozen object's __del__
+        # never runs — the native handle must still be destroyed at
+        # interpreter exit (same rule as native/eventlog.py)
+        import weakref
+        self._finalizer = weakref.finalize(
+            self, _destroy_handle, self._lib, self._h)
 
     # -- job state sync ------------------------------------------------
     def _sync_job(self, job) -> int:

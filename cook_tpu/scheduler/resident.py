@@ -41,10 +41,15 @@ Consistency model (matches the reference's):
   * A job killed after dispatch may still be matched by the in-flight
     cycle; the launch transaction refuses it and its capacity is
     credited back next cycle (no leak).
-  * A full resync (rebuild from store + backend offers) runs on host-set
-    changes and every `resync_interval` cycles as a drift backstop,
-    playing the role of the reference's reconciliation pass
-    (scheduler.clj:1041-1104).
+  * Drift backstops are layered (the role of the reference's
+    reconciliation pass, scheduler.clj:1041-1104): every
+    `resync_interval` cycles a LIGHT membership reconcile diffs row
+    membership against store truth (O(P+R) key-view set ops, no
+    in-flight drain, no re-upload — ~167 ms at 100k pending); a FULL
+    rebuild from store + backend offers runs on host-set changes,
+    feature-config changes, consumer failures, capacity overflow, and
+    every `full_resync_every`'th period (resetting f32 host-lane
+    accumulation drift).
 """
 from __future__ import annotations
 

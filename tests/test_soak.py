@@ -288,3 +288,140 @@ def test_soak_rotation_with_follower_and_resident(tmp_path):
         assert converged(), "replica diverged across rotations"
     finally:
         stop()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_resident_full_features(seed):
+    """Chaos soak over the round-4 resident feature surface: a flaky
+    launch filter (random defer/accept), a deterministic idempotent
+    adjuster, data-locality bonus rows on dataset jobs, and the
+    estimated-completion time-lane — all riding the resident path with
+    the async consumer. Every invariant must hold; deferred jobs must
+    eventually run (age-out)."""
+    from cook_tpu.plugins import (CachedLaunchFilter, JobAdjuster,
+                                  LaunchFilter, PluginRegistry, accepted,
+                                  deferred)
+    from cook_tpu.scheduler.coordinator import EstimatedCompletionConfig
+    from cook_tpu.scheduler.data_locality import DataLocalityCosts
+    import time as _time
+
+    rng = np.random.default_rng(3000 + seed)
+    frng = np.random.default_rng(7000 + seed)   # filter's own stream
+
+    class Flaky(LaunchFilter):
+        def check_job_launch(self, job):
+            return (deferred(for_s=0.02) if frng.random() < 0.3
+                    else accepted())
+
+    class Clamp(JobAdjuster):
+        def adjust_job(self, job):
+            job.mem = max(job.mem, 10.0)   # idempotent in-place
+            return job
+
+    now_s = _time.time()
+    hosts = [
+        MockHost(f"h{i}", mem=float(rng.integers(150, 400)),
+                 cpus=float(rng.integers(8, 32)),
+                 # half the hosts are near end-of-life for the
+                 # estimated-completion lane
+                 attributes={"rack": f"r{i % 3}",
+                             **({"host-start-time":
+                                 str(now_s - 25 * 60)} if i % 2 else {})})
+        for i in range(6)
+    ]
+    store = JobStore()
+    cluster = MockCluster(
+        hosts,
+        runtime_fn=lambda spec: (float(rng.uniform(5, 90)),
+                                 bool(rng.random() < 0.85), None),
+        bulk_status=True)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(
+        store, reg,
+        config=SchedulerConfig(
+            estimated_completion=EstimatedCompletionConfig(
+                expected_runtime_multiplier=1.0,
+                host_lifetime_mins=30.0)),
+        plugins=PluginRegistry(
+            launch=CachedLaunchFilter(Flaky(), age_out_s=0.3),
+            adjuster=Clamp()))
+    coord.data_locality = DataLocalityCosts(
+        fetcher=lambda uuids: {u: {"h0": 0.0, "h1": 0.5} for u in uuids},
+        weight=0.5, cache_ttl_s=0.5)
+    coord.enable_resident(synchronous=False, resync_interval=23,
+                          locality_refresh_cycles=4)
+
+    users = ["alice", "bob", "carol"]
+    all_jobs: list[Job] = []
+    try:
+        for step in range(60):
+            op = rng.random()
+            if op < 0.4:
+                batch = []
+                for _ in range(int(rng.integers(1, 6))):
+                    batch.append(Job(
+                        uuid=new_uuid(), user=str(rng.choice(users)),
+                        command="true",
+                        mem=float(rng.integers(5, 60)),
+                        cpus=float(rng.integers(1, 5)),
+                        max_retries=int(rng.integers(1, 3)),
+                        expected_runtime_ms=(int(rng.integers(1, 20))
+                                             * 60_000
+                                             if rng.random() < 0.3
+                                             else None),
+                        datasets=([{"dataset": {"b": "x"}}]
+                                  if rng.random() < 0.2 else []),
+                        constraints=([("rack", "EQUALS",
+                                       f"r{int(rng.integers(3))}")]
+                                     if rng.random() < 0.15 else []),
+                    ))
+                store.create_jobs(batch)
+                all_jobs.extend(batch)
+            elif op < 0.5 and all_jobs:
+                victim = all_jobs[int(rng.integers(len(all_jobs)))]
+                if victim.state != JobState.COMPLETED:
+                    for tid in store.kill_job(victim.uuid):
+                        cluster.kill_task(tid)
+            elif op < 0.7:
+                cluster.advance(float(rng.uniform(1, 45)))
+            elif op < 0.8:
+                coord.watchdog_cycle()
+            coord.match_cycle()
+            if step % 10 == 9:
+                _time.sleep(0.05)   # let deferrals expire / dl fetch land
+                coord.drain_resident()
+                check_invariants(store, cluster)
+
+        # drain to steady state: every live job must EVENTUALLY run or
+        # complete — the flaky filter's age-out must not starve anyone.
+        # Cycle until quiescent (a job the filter parked during the
+        # very last consume needs one more revalidation pass).
+        deadline = _time.monotonic() + 20.0
+        while _time.monotonic() < deadline:
+            cluster.advance(120.0)
+            coord.match_cycle()
+            _time.sleep(0.02)
+            coord.drain_resident()
+            if not any(j.state == JobState.WAITING for j in all_jobs):
+                break
+        check_invariants(store, cluster)
+        # a job can be LEGITIMATELY unschedulable here: rack constraint
+        # x novel-host retry x estimated-completion can intersect to
+        # zero hosts on a 6-host mock (verified by kernel-level
+        # inspection: the mask is exactly right in that state, and the
+        # reference would park the same job in /unscheduled_jobs). What
+        # must never happen is the launch FILTER starving a job: every
+        # WAITING straggler must be explainable by constraints, never
+        # by a stuck deferral.
+        rp = coord._resident["default"]
+        for j in all_jobs:
+            if j.state != JobState.WAITING:
+                continue
+            assert j.uuid not in rp._deferred, \
+                f"job {j.uuid} stuck in filter deferral past age-out"
+            assert j.constraints or j.expected_runtime_ms or \
+                any(i.hostname for i in j.instances), \
+                f"unconstrained job {j.uuid} starved"
+    finally:
+        coord.stop()
