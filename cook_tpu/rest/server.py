@@ -121,15 +121,16 @@ def apply_gc_discipline() -> None:
     collector's reach. At 100k jobs the store holds ~10^6 live objects
     and every CPython gen-2 sweep walks them all — multi-hundred-ms
     pauses landing in the match cycle's p99 (measured, docs/
-    benchmarks.md round 3). Called ONCE at leadership takeover, after
-    the replay materializes the store: gen-2 sweeps afterwards walk
-    only post-takeover objects, whose population is bounded by live
-    churn rather than total store size. Deliberately NOT re-run
-    periodically — freezing transient objects (request state, queue
-    items, exception frames) would leak any of them that later die as
-    part of a reference cycle, and the gc.collect() here is itself the
-    multi-hundred-ms pause we keep off the live match path. Frozen
-    objects still free via refcounting; the native handles use
+    benchmarks.md round 3). Called at leadership takeover, after the
+    replay materializes the store. This is the ARMING half of a
+    two-part discipline: once armed (gc.get_freeze_count() > 0), the
+    coordinator re-collects + re-freezes BETWEEN match cycles on a
+    cadence (Coordinator._maybe_refreeze) — round 4's tail attribution
+    measured 0.9-1.9 s gen-2 sweeps landing inside drain/launch phases
+    as post-takeover churn regrew the tracked population, so the sweep
+    is paid at a controlled point instead. The cyclic transients
+    leaked per re-freeze are a handful of in-flight request frames;
+    store state dies by refcount regardless. Native handles use
     weakref.finalize, which freeze does not break (a __del__-based
     finalizer would never run — see native/eventlog.py)."""
     import gc

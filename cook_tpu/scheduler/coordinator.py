@@ -210,6 +210,22 @@ class Coordinator:
             self.forbidden_builder = NativeForbiddenBuilder.create()
         except Exception:
             self.forbidden_builder = None
+        # controlled gen-2 GC placement: once the server's takeover
+        # freeze is active (gc.get_freeze_count() > 0), re-collect +
+        # re-freeze BETWEEN match cycles on this cadence. Without it,
+        # post-freeze churn regrows the gen-2 population and CPython
+        # sweeps it at uncontrolled points — measured as 0.9-1.9 s
+        # spikes INSIDE drain/launch phases at 100k-job scale
+        # (docs/benchmarks.md round 4 tail attribution). The refreeze
+        # both pays the sweep at a chosen point AND caps every sweep —
+        # controlled or organic (the 25% rule fires between refreezes
+        # too) — at one interval's churn: 60 s of 1k-launch/s churn
+        # sweeps in ~100-300 ms, inside the production cadence's idle
+        # window. Cyclic transients leaked per freeze are a few
+        # in-flight request frames; gc.collect() first reclaims any
+        # dead cycles, so only alive-at-freeze objects can ever leak.
+        self.gc_refreeze_interval_s = 60.0
+        self._next_refreeze = time.monotonic() + self.gc_refreeze_interval_s
         # hash-sharded in-order status executors
         # (async-in-order-processing scheduler.clj:1524-1546): backend
         # callbacks enqueue and return instead of running the store
@@ -787,7 +803,9 @@ class Coordinator:
         pool = pool or self.pools.default_pool
         rp = getattr(self, "_resident", {}).get(pool)
         if rp is not None and rp.enabled:
-            return self._match_cycle_resident(pool, rp)
+            stats = self._match_cycle_resident(pool, rp)
+            self._maybe_refreeze()
+            return stats
         t0 = time.perf_counter()
         stats = MatchStats()
         self._purge_reservations()
@@ -1043,7 +1061,27 @@ class Coordinator:
             stats.cycle_ms)
         metrics_registry.meter(f"match.{pool}.matched").mark(launched)
         metrics_registry.counter(f"match.{pool}.cycles").inc()
+        self._maybe_refreeze()
         return stats
+
+    def _maybe_refreeze(self) -> None:
+        """Controlled gen-2 placement (see __init__ comment): no-op
+        unless the takeover freeze is active and the cadence elapsed;
+        runs BETWEEN cycles so the sweep never lands inside a phase."""
+        now = time.monotonic()
+        if now < self._next_refreeze:
+            return
+        self._next_refreeze = now + self.gc_refreeze_interval_s
+        import gc
+        if gc.get_freeze_count() == 0:
+            return   # GC discipline not active (tests, library use)
+        t_gc = time.perf_counter()
+        gc.collect()
+        gc.freeze()
+        self.metrics["gc.refreeze_ms"] = \
+            (time.perf_counter() - t_gc) * 1e3
+        metrics_registry.timer("gc.refreeze_ms").update(
+            self.metrics["gc.refreeze_ms"])
 
     def _audit_head_window(self, jb, hosts, forbidden, job_host,
                            queue_rank, considerable,
