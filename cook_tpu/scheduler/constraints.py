@@ -58,6 +58,8 @@ def build_forbidden(jobs: list[Job], host_names: list[str],
                     reservations: Optional[dict[str, str]] = None,
                     group_cotask_attr: Optional[dict[str, dict[str, str]]] = None,
                     group_cotask_hosts: Optional[dict[str, set]] = None,
+                    host_index: Optional[dict] = None,
+                    attr_cache: Optional[dict] = None,
                     ) -> np.ndarray:
     """forbidden[j, h] True => job j may not land on host h.
 
@@ -68,6 +70,12 @@ def build_forbidden(jobs: list[Job], host_names: list[str],
     of a *unique* host-placement group (cross-cycle uniqueness; the
     in-cycle half is enforced by the match kernel's group_occ).
 
+    host_index / attr_cache: optional caller-owned caches (name->index
+    and attr->value-array). Per-call rebuilding of these is O(H) —
+    fine for one batch call per cycle, but a caller re-masking many
+    jobs one at a time (the resident pool's per-job sparse rows) MUST
+    share them or the masks cost O(jobs x H) in pure dict building.
+
     Vectorized per job over hosts: the hot dimension H is handled with
     numpy masks, never a Python loop.
     """
@@ -76,7 +84,8 @@ def build_forbidden(jobs: list[Job], host_names: list[str],
     reservations = reservations or {}
     group_cotask_attr = group_cotask_attr or {}
     group_cotask_hosts = group_cotask_hosts or {}
-    host_idx = {h: i for i, h in enumerate(host_names)}
+    host_idx = (host_index if host_index is not None
+                else {h: i for i, h in enumerate(host_names)})
 
     # hosts reserved for some job are forbidden to every other job
     reserved_rows = np.zeros(H, bool)
@@ -88,8 +97,10 @@ def build_forbidden(jobs: list[Job], host_names: list[str],
             reserved_rows[hi] = True
             reserved_owner[hi] = uuid_to_row.get(owner_uuid, -1)
 
-    # per-attribute host value arrays, built lazily once
-    attr_cache: dict[str, np.ndarray] = {}
+    # per-attribute host value arrays, built lazily once (or shared
+    # across calls via the caller's attr_cache)
+    if attr_cache is None:
+        attr_cache = {}
 
     def attr_values(attr: str) -> np.ndarray:
         vals = attr_cache.get(attr)

@@ -512,6 +512,19 @@ class Coordinator:
             if reason == "full":
                 self.drain_resident(pool)
                 rp.resync()
+            elif reason == "hosts":
+                # incremental host-set reconcile; full rebuild only
+                # when it reports impossible (slots exhausted, est
+                # lane must activate) or a sparse cap overflows
+                ok = False
+                try:
+                    ok = rp.reconcile_hosts()
+                except _NeedResync as e:
+                    log.info("host reconcile overflowed (%s)", e)
+                if not ok:
+                    reason = "full"
+                    self.drain_resident(pool)
+                    rp.resync()
             else:
                 try:
                     rp.reconcile_membership()
@@ -1103,10 +1116,15 @@ class Coordinator:
 
     def _group_attr_pins(self, pending: list[Job]) -> dict[str, dict[str, str]]:
         pins: dict[str, dict[str, str]] = {}
-        all_attrs = self._all_host_attributes()
+        # lazy: the attrs map is O(all hosts) to build, and this runs
+        # per job on the resident fill path — group-less jobs (the vast
+        # majority) must not pay it
+        all_attrs = None
         for job in pending:
             if not job.group or job.group in pins:
                 continue
+            if all_attrs is None:
+                all_attrs = self._all_host_attributes()
             group = self.store.groups.get(job.group)
             if group is None:
                 continue

@@ -116,6 +116,11 @@ FORB_CHUNK = 256
 BONUS_CHUNK = 64   # f32 rows are 4x the bool mask bytes; data-locality
 #                    costs refresh on a minutes TTL, so a smaller chunk
 #                    still covers the steady state in one dispatch
+# host-set reconcile scatter (adds/removals ride standalone scatters,
+# not the per-cycle bundle — host churn is occasional)
+HOSTSET_CHUNK = 256
+HOST_F32 = ("mem", "cpus", "gpus", "cap_mem", "cap_cpus", "cap_gpus")
+HOST_I32 = ("task_slots", "ports", "death_s", "valid")
 # one cycle's completions can easily touch >512 distinct hosts at
 # 10k-host scale; the chunk must cover the steady state so the fused
 # dispatch stays the only one per cycle
@@ -181,6 +186,21 @@ def _scatter_credit(state, idx, cf, ci):
 def _scatter_bonus(state, slot_idx, rows):
     return {**state, "bonus": state["bonus"].at[slot_idx].set(
         rows, mode="drop")}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_hostset(state, idx, hf, hi):
+    """Set whole host rows (adds, removals, rejoins) — unlike the
+    additive credit scatter, this REPLACES the row."""
+    host = dict(state["host"])
+    for k, name in enumerate(HOST_F32):
+        host[name] = host[name].at[idx].set(hf[k], mode="drop")
+    for k, name in enumerate(HOST_I32):
+        v = hi[k]
+        if name == "valid":
+            v = v.astype(bool)
+        host[name] = host[name].at[idx].set(v, mode="drop")
+    return {**state, "host": host}
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -388,6 +408,14 @@ class ResidentPool:
         self._host_gens = gens
         self.host_names = [o.hostname for o in offers]
         self.host_ids = {h: i for i, h in enumerate(self.host_names)}
+        # name -> index including tombstoned (removed) hosts: indices
+        # must stay stable for the life of a build (mask columns and
+        # in-flight readbacks address hosts by index), and a rejoining
+        # host reuses its old slot
+        self._host_index_all = dict(self.host_ids)
+        self._host_attr_cache: Optional[dict] = None   # attr -> values
+        self._host_sigs = {o.hostname: self._host_sig(o) for o in offers}
+        self._build_count = getattr(self, "_build_count", 0) + 1
         self.host_attrs = [o.attributes for o in offers]
         H = max(bucket(len(offers)), 64)
         self.Hcap = H
@@ -423,18 +451,10 @@ class ResidentPool:
         any_start = False
         if ec.enabled:
             for i, o in enumerate(offers):
-                start = o.attributes.get("host-start-time")
-                if start is None:
-                    continue
-                try:
-                    start_s = float(start)
-                except (TypeError, ValueError):
-                    continue   # malformed attr = unconstrained host
-                any_start = True
-                rel_s = (start_s * 1000.0
-                         + ec.host_lifetime_mins * 60_000.0
-                         - self._t0_ms) / 1000.0
-                death[i] = int(np.clip(rel_s, -EST_NEVER, EST_NEVER))
+                d = self._death_s_for(o.attributes)
+                if d != EST_NEVER:
+                    any_start = True
+                    death[i] = d
         hostd["death_s"] = death
         self.with_est = bool(ec.enabled and any_start)
 
@@ -449,9 +469,14 @@ class ResidentPool:
                      for i in store.running_instances(pool)]
         # 20% slack rows before the next resync-with-growth; the bucket
         # is the jit shape, so slack costs compile-shape stability, not
-        # per-cycle work
+        # per-cycle work. Rcap additionally floors at a fraction of the
+        # pending backlog: a pool enabled before anything runs would
+        # otherwise start at 1024 running rows and cascade through
+        # growth rebuilds as the first cycles launch (rows are ~40
+        # bytes each — slack is cheap, rebuilds are seconds).
         Pcap = bucket(max(len(pending) + len(pending) // 5, 1024))
-        Rcap = bucket(max(len(run_insts) + len(run_insts) // 5, 1024))
+        Rcap = bucket(max(len(run_insts) + len(run_insts) // 5,
+                          len(pending) // 8, 1024))
         self.Pcap, self.Rcap = Pcap, Rcap
         while True:
             try:
@@ -480,9 +505,6 @@ class ResidentPool:
             "forb": self._forb_rows_m.copy(),
             "bonus": self._bonus_rows_m.copy(),
         }, dev)
-        self._host_mirror_avail = {k: hostd[k].copy()
-                                   for k in ("mem", "cpus", "gpus",
-                                             "task_slots", "ports")}
         self._dirty_pend: set[int] = set()
         self._dirty_forb: set[int] = set()
         self._dirty_bonus: set[int] = set()
@@ -689,6 +711,33 @@ class ResidentPool:
         rows always hold adjusted values; deterministic by contract."""
         return job if self._adjust is None else self._adjust(job)
 
+    @staticmethod
+    def _host_sig(offer) -> tuple:
+        """STABLE identity of a host's offer: total capacity +
+        attributes. Availability is excluded on purpose — the device
+        chains that per cycle; only a capacity/attr change (restart,
+        relabel) forces a row re-base."""
+        return (offer.cap_mem or offer.mem, offer.cap_cpus or offer.cpus,
+                offer.cap_gpus or offer.gpus,
+                tuple(sorted(offer.attributes.items())))
+
+    def _death_s_for(self, attrs) -> int:
+        """Relative-epoch death seconds for one host's attributes
+        (EST_NEVER = no advertised/parsable start time)."""
+        ec = self.coord.config.estimated_completion
+        if not ec.enabled:
+            return EST_NEVER
+        start = attrs.get("host-start-time")
+        if start is None:
+            return EST_NEVER
+        try:
+            start_s = float(start)
+        except (TypeError, ValueError):
+            return EST_NEVER   # malformed attr = unconstrained host
+        rel_s = (start_s * 1000.0 + ec.host_lifetime_mins * 60_000.0
+                 - self._t0_ms) / 1000.0
+        return int(np.clip(rel_s, -EST_NEVER, EST_NEVER))
+
     def _est_s(self, job) -> int:
         """Capped expected-runtime seconds for the estimated-completion
         lane (the job side of constraints.clj:200-247): max of the
@@ -736,16 +785,22 @@ class ResidentPool:
 
     def _mask_for(self, job) -> Optional[np.ndarray]:
         """(H_real,) bool forbidden mask for one job, or None when the
-        job is unconstrained (ships no mask bytes)."""
+        job is unconstrained (ships no mask bytes). Shares the pool's
+        host-index/attr caches: this runs once per constrained-row
+        fill, and per-call cache rebuilding is O(H) — at 10k hosts that
+        turned a 2k-row mask refresh into seconds (measured)."""
         if not self._constrained(job):
             return None
         co = self.coord
         pins = co._group_attr_pins([job])
         uhosts = co._group_unique_hosts([job], self.host_names,
                                         self.host_attrs)
+        if self._host_attr_cache is None:
+            self._host_attr_cache = {}
         forb = constraints_mod.build_forbidden(
             [job], self.host_names, self.host_attrs, co.reservations,
-            pins, uhosts)
+            pins, uhosts, host_index=self._host_index_all,
+            attr_cache=self._host_attr_cache)
         return np.asarray(forb[0], bool)
 
     def _free_pend(self, uuid: str) -> None:
@@ -1242,7 +1297,10 @@ class ResidentPool:
             gen = getattr(cluster, "offer_generation", None)
             if gen is not None and \
                     self._host_gens.get(cluster.name) != gen(self.pool):
-                return "full"
+                # host adds/removals reconcile INCREMENTALLY
+                # (reconcile_hosts); the coordinator falls back to a
+                # full rebuild only when that reports impossible
+                return "hosts"
         # built before any backend registered hosts (the server enables
         # the resident path at build time): an empty host universe while
         # a cluster has offers means we'd schedule nothing until the
@@ -1253,11 +1311,125 @@ class ResidentPool:
         if not self.host_names and self.cycle_no % 8 == 0:
             for cluster in self.coord.clusters.all():
                 if cluster.pending_offers(self.pool):
-                    return "full"
+                    return "hosts"
         if self.cycle_no - self._last_resync_cycle >= self.resync_interval:
             return ("full" if self._light_since_full + 1
                     >= self.full_resync_every else "light")
         return None
+
+    def reconcile_hosts(self) -> bool:
+        """Incremental host-set reconcile (agent joins/leaves, kube
+        node events): removed hosts tombstone in place (valid=False,
+        zero capacity — indices stay stable for mask columns and
+        in-flight readbacks), added hosts take fresh or reused slots,
+        and constrained/bonus rows refresh their columns. A 2.1-2.7 s
+        full rebuild at 100k pending (measured) becomes an O(changes)
+        scatter. Returns False when only a full rebuild can cope (host
+        slots exhausted, or the est-completion lane must activate).
+        No in-flight drain is needed: indices never shift, and a match
+        already in flight to a removed host simply fails at the backend
+        like any offer that raced a host death."""
+        co = self.coord
+        gens = {}
+        offers = []
+        cluster_of = {}
+        for cluster in co.clusters.all():
+            gens[cluster.name] = getattr(cluster, "offer_generation",
+                                         lambda p: 0)(self.pool)
+            for o in cluster.pending_offers(self.pool):
+                offers.append(o)
+                cluster_of[o.hostname] = cluster.name
+        offer_by_name = {o.hostname: o for o in offers}
+        live = set(self.host_ids)
+        added = offer_by_name.keys() - live
+        removed = live - offer_by_name.keys()
+        # a host whose STABLE signature (total capacity + attributes)
+        # changed left and rejoined between cycles (or was relabeled):
+        # its row must re-base from the fresh offer — availability
+        # (o.mem etc.) is deliberately NOT in the signature, the device
+        # chains that itself
+        changed = {
+            h for h in (live & offer_by_name.keys())
+            if self._host_sig(offer_by_name[h])
+            != self._host_sigs.get(h)}
+        n_fresh = len([h for h in added if h not in self._host_index_all])
+        if len(self.host_names) + n_fresh > self.Hcap:
+            return False   # out of host slots: full rebuild grows Hcap
+        ec = co.config.estimated_completion
+        if ec.enabled and not self.with_est and any(
+                self._death_s_for(offer_by_name[h].attributes) != EST_NEVER
+                for h in (added | changed)):
+            # first host with a start time: the est lane must turn on,
+            # which is a jit-static flag — rebuild
+            return False
+        with self.mirror_lock:
+            idxs, hfs, his = [], [], []
+            for h in removed:
+                i = self.host_ids.pop(h)
+                self._host_sigs.pop(h, None)
+                idxs.append(i)
+                hfs.append((0.0,) * len(HOST_F32))
+                his.append((0, 0, EST_NEVER, 0))
+            for h in added | changed:
+                o = offer_by_name[h]
+                i = self._host_index_all.get(h)
+                if i is None:
+                    i = len(self.host_names)
+                    self.host_names.append(h)
+                    self.host_attrs.append(dict(o.attributes))
+                    self._host_index_all[h] = i
+                else:
+                    self.host_attrs[i] = dict(o.attributes)   # rejoin
+                self.host_ids[h] = i
+                self.offer_cluster[h] = cluster_of[h]
+                self._host_sigs[h] = self._host_sig(o)
+                self._host_attr_cache = None   # attr arrays are stale
+                # re-basing this row from the offer makes any STALE
+                # consumption record for it double-count when its task
+                # later terminates (the offer already reflects current
+                # usage): null those records' host so their credits
+                # drop — the lane was just set from backend truth
+                for tid, rec in self._consumed_res.items():
+                    if rec[0] == i:
+                        self._consumed_res[tid] = (-1,) + rec[1:]
+                idxs.append(i)
+                hfs.append((o.mem, o.cpus, o.gpus,
+                            o.cap_mem or o.mem, o.cap_cpus or o.cpus,
+                            o.cap_gpus or o.gpus))
+                his.append((10_000,
+                            sum(hi - lo + 1 for lo, hi in o.ports),
+                            self._death_s_for(o.attributes), 1))
+            for lo in range(0, len(idxs), HOSTSET_CHUNK):
+                sl = slice(lo, lo + HOSTSET_CHUNK)
+                n = len(idxs[sl])
+                idx = np.full(HOSTSET_CHUNK, self.Hcap, np.int32)
+                idx[:n] = idxs[sl]
+                hf = np.zeros((len(HOST_F32), HOSTSET_CHUNK), np.float32)
+                hi_arr = np.zeros((len(HOST_I32), HOSTSET_CHUNK), np.int32)
+                hf[:, :n] = np.asarray(hfs[sl], np.float32).T
+                hi_arr[:, :n] = np.asarray(his[sl], np.int32).T
+                self.state = _scatter_hostset(self.state, idx, hf, hi_arr)
+            if added or changed:
+                # constrained rows gain/refresh columns for the new or
+                # relabeled hosts: recompute their masks against the
+                # updated universe (bonus rows via the dataset re-sync).
+                # Occupancy test vectorized — at 100k pending only the
+                # constrained minority pays Python work.
+                m = self._pend_m
+                slotted = np.nonzero(m["forb_slot"] >= 0)[0]
+                for row in slotted.tolist():
+                    uuid = self.row_uuid[row]
+                    job = co.store.get_job(uuid) if uuid else None
+                    if job is None:
+                        continue
+                    self._fill_pend_scalar(row, self._adjusted(job))
+                    self._dirty_pend.add(row)
+                for u in list(self._dataset_jobs):
+                    job = co.store.get_job(u)
+                    if job is not None:
+                        self._sync_job(job)
+        self._host_gens = gens
+        return True
 
     def resync(self) -> None:
         with self._ev_lock:
