@@ -451,14 +451,22 @@ class Coordinator:
             try:
                 if item is None:
                     return
-                cname, specs = item
+                kind = item[0]
                 try:
-                    self.clusters.get(cname).launch_tasks(pool, specs)
+                    if kind == "launch":
+                        _, cname, specs = item
+                        self.clusters.get(cname).launch_tasks(pool, specs)
+                    else:   # ("kill", task_id): serialized BEHIND any
+                        # queued launch of the same task, so a kill of a
+                        # just-matched job can never be a no-op that the
+                        # later launch resurrects as a zombie
+                        for cluster in self.clusters.all():
+                            cluster.kill_task(item[1])
                 except Exception:
                     # per backend contract launch_tasks shouldn't raise;
                     # a transport-level failure surfaces as task
                     # statuses via reconciliation
-                    log.exception("backend launch to %s failed", cname)
+                    log.exception("backend %s via launcher failed", kind)
             finally:
                 rp._launch_q.task_done()
 
@@ -665,7 +673,7 @@ class Coordinator:
                 credit = (h, c_mem, c_cpus, c_gpus, 1, c_ports)
                 if job is None:
                     # row freed by a racing kill
-                    rp.queue_credit(*credit)
+                    rp.queue_credit(*credit, as_of=out.cycle_no)
                     continue
                 candidates.append((uuid, h, job, credit))
         # policy pass OUTSIDE the mirror lock: a slow launch plugin or
@@ -682,17 +690,17 @@ class Coordinator:
                 if job.pool != pool:
                     # adjuster migrated the job (pool_mover): it
                     # belongs to the destination pool's cycle
-                    rp.queue_credit(*credit)
+                    rp.queue_credit(*credit, as_of=out.cycle_no)
                     self._mark_dirty_all(uuid)
                     continue
                 if not plug.launch.check(job):
-                    rp.queue_credit(*credit)
+                    rp.queue_credit(*credit, as_of=out.cycle_no)
                     deferrals.append(
                         (uuid,
                          time.monotonic() + plug.launch.defer_for(uuid)))
                     continue
             if rl_on and not rl.try_acquire(job.user):
-                rp.queue_credit(*credit)
+                rp.queue_credit(*credit, as_of=out.cycle_no)
                 rp.mark_job_dirty(uuid)
                 continue
             hostname = host_names[h]
@@ -704,7 +712,7 @@ class Coordinator:
                     ports = alloc(hostname, job.ports)
                     if not ports:
                         # genuine exhaustion: defer to a later cycle
-                        rp.queue_credit(*credit)
+                        rp.queue_credit(*credit, as_of=out.cycle_no)
                         rp.mark_job_dirty(uuid)
                         continue
                     ports = list(ports)
@@ -729,7 +737,7 @@ class Coordinator:
         self.metrics[f"match.{pool}.launch_loop_ms"] = \
             (t_loop - t_rb1) * 1e3
         insts = self.store.create_instances_bulk(
-            items, origin=("resident", pool)) if items else []
+            items, origin=("resident", pool, out.cycle_no)) if items else []
         self.metrics[f"match.{pool}.launch_txn_ms"] = \
             (time.perf_counter() - t_loop) * 1e3
         by_cluster: dict[str, list[LaunchSpec]] = {}
@@ -740,7 +748,7 @@ class Coordinator:
                 # killed/launched since matching: restore the capacity
                 # the device already depleted (the mirror snapshot taken
                 # under the lock, so a concurrent re-fill can't skew it)
-                rp.queue_credit(*credit)
+                rp.queue_credit(*credit, as_of=out.cycle_no)
                 rp.mark_job_dirty(uuid)
                 if ports:
                     rel = getattr(self.clusters.get(cname),
@@ -770,9 +778,21 @@ class Coordinator:
         launch_q = getattr(rp, "_launch_q", None)
         for cname, specs in by_cluster.items():
             if launch_q is not None:
-                launch_q.put((cname, specs))   # launcher thread, in order
+                launch_q.put(("launch", cname, specs))  # in order
             else:
                 self.clusters.get(cname).launch_tasks(pool, specs)
+        if launch_q is not None and by_cluster:
+            # close the enqueue race: a kill that ran between our store
+            # transaction and the put above was enqueued BEFORE the
+            # launch — re-kill anything already terminal so the queued
+            # launch can't resurrect it as a zombie
+            for (uuid, hostname, cname), _ij, inst in zip(
+                    items, item_jobs, insts):
+                if inst is None:
+                    continue
+                cur = self.store.get_instance(inst.task_id)
+                if cur is not None and not cur.active:
+                    launch_q.put(("kill", inst.task_id))
         # scaleback feedback (scheduler.clj:1002-1036)
         if head_matched:
             self._num_considerable[pool] = self.config.max_jobs_considered
@@ -1522,6 +1542,21 @@ class Coordinator:
                 "uncommitted_gced": gced}
 
     def _backend_kill(self, task_id: str) -> None:
+        """Idempotent backend kill. When async launchers run, the kill
+        rides EVERY pool's launch queue — a kill arriving between a
+        launch transaction and its backend hand-off must execute AFTER
+        the launch, or the no-op kill plus the later launch would leave
+        a zombie task the store believes dead. Broadcasting (rather
+        than routing by the job's pool) keeps the ordering correct even
+        when an adjuster migrated the launch onto another pool's queue;
+        the extra kills are no-ops by backend contract."""
+        for rp in getattr(self, "_resident", {}).values():
+            q = getattr(rp, "_launch_q", None)
+            if q is not None:
+                q.put(("kill", task_id))
+        # and directly: covers sync pools / legacy paths immediately;
+        # the queued copies re-kill after any in-queue launch (all
+        # idempotent by backend contract)
         for cluster in self.clusters.all():
             cluster.kill_task(task_id)
 

@@ -415,6 +415,7 @@ class ResidentPool:
         self._host_index_all = dict(self.host_ids)
         self._host_attr_cache: Optional[dict] = None   # attr -> values
         self._host_sigs = {o.hostname: self._host_sig(o) for o in offers}
+        self._host_rebase_cycle: dict[int, int] = {}
         self._build_count = getattr(self, "_build_count", 0) + 1
         self.host_attrs = [o.attributes for o in offers]
         H = max(bucket(len(offers)), 64)
@@ -716,7 +717,12 @@ class ResidentPool:
         """STABLE identity of a host's offer: total capacity +
         attributes. Availability is excluded on purpose — the device
         chains that per cycle; only a capacity/attr change (restart,
-        relabel) forces a row re-base."""
+        relabel) forces a row re-base. Known limitation: a live host's
+        port-RANGE reconfiguration is also availability-shaped (free
+        ranges vary with running tasks) and so is not in the signature;
+        it lands at the next periodic full rebuild, and until then port
+        launches that lost capacity refuse at allocate_ports and retry
+        (degraded, never corrupt)."""
         return (offer.cap_mem or offer.mem, offer.cap_cpus or offer.cpus,
                 offer.cap_gpus or offer.gpus,
                 tuple(sorted(offer.attributes.items())))
@@ -906,12 +912,17 @@ class ResidentPool:
             self._events.append(("_dirty", {"job": uuid}))
 
     def queue_credit(self, hid: int, mem: float, cpus: float, gpus: float,
-                     slots: int, ports: int) -> None:
+                     slots: int, ports: int,
+                     as_of: Optional[int] = None) -> None:
         """Thread-safe capacity credit (the consumer returns resources
-        of refused launches through the same event funnel)."""
+        of refused launches through the same event funnel). as_of: the
+        cycle whose device state the credit corrects — a credit for a
+        host row RE-BASED after that cycle is dropped at drain (the
+        re-base already restored the capacity from backend truth)."""
         with self._ev_lock:
             self._events.append(
-                ("_credit", {"c": (hid, mem, cpus, gpus, slots, ports)}))
+                ("_credit", {"c": (hid, mem, cpus, gpus, slots, ports),
+                             "as_of": as_of}))
 
     # -- drain: events -> mirrors -> deltas -------------------------------
     def _release_cooling(self) -> None:
@@ -942,8 +953,14 @@ class ResidentPool:
             self._free_pend(job.uuid)
 
     def _credit(self, hid: int, mem: float, cpus: float, gpus: float,
-                slots: int, ports: int) -> None:
+                slots: int, ports: int,
+                as_of: Optional[int] = None) -> None:
         if hid < 0:
+            return
+        if as_of is not None and \
+                self._host_rebase_cycle.get(hid, -1) > as_of:
+            # the row was re-based from backend truth after the cycle
+            # this credit corrects: applying it would double-restore
             return
         c = self._host_credit.setdefault(hid, [0.0, 0.0, 0.0, 0, 0])
         c[0] += mem
@@ -958,7 +975,8 @@ class ResidentPool:
         if res is not None:
             self._credit(*res)
 
-    def _handle_inst(self, job, inst, ours: bool) -> None:
+    def _handle_inst(self, job, inst, ours: bool,
+                     match_cycle: Optional[int] = None) -> None:
         if job.pool != self.pool:
             return
         self._sync_job(job)   # frees the pend row (job left WAITING)
@@ -966,6 +984,15 @@ class ResidentPool:
             self._dirty_run.add(self._alloc_run(inst, job))
         if inst.task_id not in self._consumed_res:
             hid = self.host_ids.get(inst.hostname, -1)
+            if ours and match_cycle is not None and \
+                    self._host_rebase_cycle.get(hid, -1) > match_cycle:
+                # the host row was RE-BASED from backend truth after
+                # this launch's match cycle: the depletion this record
+                # would credit back at terminal lived on the wiped
+                # lane — record no host so the credit drops (the
+                # re-based row already reflects the launch once the
+                # backend saw it; see reconcile_hosts)
+                hid = -1
             mem = self.coord._effective_mem(job)
             self._consumed_res[inst.task_id] = (hid, mem, job.cpus,
                                                 job.gpus, 1, job.ports)
@@ -1062,13 +1089,17 @@ class ResidentPool:
                 if data["obj"].group:
                     group_dirty.add(data["obj"].group)
             elif kind == "insts":
-                ours = data.get("origin") == ("resident", self.pool)
+                origin = data.get("origin") or ()
+                ours = (len(origin) >= 2 and origin[0] == "resident"
+                        and origin[1] == self.pool)
+                m_cycle = origin[2] if ours and len(origin) > 2 else None
                 for job, inst in data["items"]:
-                    self._handle_inst(job, inst, ours=ours)
+                    self._handle_inst(job, inst, ours=ours,
+                                      match_cycle=m_cycle)
                     if job.group:
                         group_dirty.add(job.group)
             elif kind == "_credit":
-                self._credit(*data["c"])
+                self._credit(*data["c"], as_of=data.get("as_of"))
             elif kind in ("status", "statuses"):
                 items = (data["items"] if kind == "statuses"
                          else [(data["obj"], data["inst"], data["was"])])
@@ -1364,6 +1395,7 @@ class ResidentPool:
             return False
         with self.mirror_lock:
             idxs, hfs, his = [], [], []
+            rebased: set[int] = set()
             for h in removed:
                 i = self.host_ids.pop(h)
                 self._host_sigs.pop(h, None)
@@ -1383,15 +1415,7 @@ class ResidentPool:
                 self.host_ids[h] = i
                 self.offer_cluster[h] = cluster_of[h]
                 self._host_sigs[h] = self._host_sig(o)
-                self._host_attr_cache = None   # attr arrays are stale
-                # re-basing this row from the offer makes any STALE
-                # consumption record for it double-count when its task
-                # later terminates (the offer already reflects current
-                # usage): null those records' host so their credits
-                # drop — the lane was just set from backend truth
-                for tid, rec in self._consumed_res.items():
-                    if rec[0] == i:
-                        self._consumed_res[tid] = (-1,) + rec[1:]
+                rebased.add(i)
                 idxs.append(i)
                 hfs.append((o.mem, o.cpus, o.gpus,
                             o.cap_mem or o.mem, o.cap_cpus or o.cpus,
@@ -1399,6 +1423,25 @@ class ResidentPool:
                 his.append((10_000,
                             sum(hi - lo + 1 for lo, hi in o.ports),
                             self._death_s_for(o.attributes), 1))
+            if rebased:
+                self._host_attr_cache = None   # attr arrays are stale
+                # a re-based row's capacity comes from backend truth:
+                # every OLDER correction targeting it must drop or it
+                # double-restores (overcommit). Three funnels: stale
+                # consumption records (null their host), credits queued
+                # but undrained (purge), and credits still to be queued
+                # by consumes of pre-rebase cycles (the rebase-cycle
+                # stamp + queue_credit's as_of drops them at drain).
+                for tid, rec in self._consumed_res.items():
+                    if rec[0] in rebased:
+                        self._consumed_res[tid] = (-1,) + rec[1:]
+                for i in rebased:
+                    self._host_credit.pop(i, None)
+                    self._host_rebase_cycle[i] = self.cycle_no
+                with self._ev_lock:
+                    self._events = [
+                        (k, d) for k, d in self._events
+                        if not (k == "_credit" and d["c"][0] in rebased)]
             for lo in range(0, len(idxs), HOSTSET_CHUNK):
                 sl = slice(lo, lo + HOSTSET_CHUNK)
                 n = len(idxs[sl])

@@ -719,3 +719,31 @@ def test_resident_host_relabel_refreshes_masks():
     assert job.state == JobState.RUNNING
     assert job.instances[0].hostname == "h1"
     assert rp._build_count == builds       # incremental, no rebuild
+
+
+def test_resident_queued_credit_dropped_after_rebase():
+    """A credit queued against a cycle BEFORE a host re-base must drop
+    at drain: the re-base already restored the row from backend truth,
+    so applying it would overcommit the host (review r4 finding)."""
+    hosts = [MockHost("h0", mem=100, cpus=8,
+                      attributes={"zone": "z1"})]
+    store, cluster, coord = build(hosts=hosts)
+    coord.enable_resident()
+    rp = coord._resident["default"]
+    idx = rp.host_ids["h0"]
+    # a stale credit from an old cycle (e.g. a refused launch whose
+    # consume raced the re-base)
+    rp.queue_credit(idx, 40.0, 4.0, 0.0, 1, 0, as_of=rp.cycle_no - 1)
+    # relabel -> sig change -> re-base from the fresh offer
+    with cluster._lock:
+        cluster.hosts["h0"].attributes["zone"] = "z2"
+        cluster.bump_offer_generation()
+    coord.match_cycle()
+    st = fetch_state(rp)
+    assert st["host"]["mem"][idx] <= 100 + 1e-3
+    assert st["host"]["cpus"][idx] <= 8 + 1e-3
+    # sanity: a POST-rebase credit still applies
+    rp.queue_credit(idx, -10.0, -1.0, 0.0, -1, 0, as_of=rp.cycle_no)
+    coord.match_cycle()
+    st = fetch_state(rp)
+    assert st["host"]["mem"][idx] <= 90 + 1e-3

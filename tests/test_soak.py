@@ -202,8 +202,14 @@ def test_soak_random_ops_resident(seed):
             elif op < 0.5 and all_jobs:
                 victim = all_jobs[int(rng.integers(len(all_jobs)))]
                 if victim.state != JobState.COMPLETED:
+                    # the production kill sequence (rest/api.py
+                    # destroy_jobs): store-terminal first, then the
+                    # backend kill ROUTED through the coordinator so it
+                    # serializes behind any queued launch of the task
                     for tid in store.kill_job(victim.uuid):
-                        cluster.kill_task(tid)
+                        store.update_instance(
+                            tid, InstanceStatus.FAILED, reason_code=1004)
+                        coord._backend_kill(tid)
             elif op < 0.65:
                 cluster.advance(float(rng.uniform(1, 60)))
             elif op < 0.8:
@@ -381,12 +387,31 @@ def test_soak_resident_full_features(seed):
             elif op < 0.5 and all_jobs:
                 victim = all_jobs[int(rng.integers(len(all_jobs)))]
                 if victim.state != JobState.COMPLETED:
+                    # the production kill sequence (rest/api.py
+                    # destroy_jobs): store-terminal first, then the
+                    # backend kill ROUTED through the coordinator so it
+                    # serializes behind any queued launch of the task
                     for tid in store.kill_job(victim.uuid):
-                        cluster.kill_task(tid)
+                        store.update_instance(
+                            tid, InstanceStatus.FAILED, reason_code=1004)
+                        coord._backend_kill(tid)
             elif op < 0.7:
                 cluster.advance(float(rng.uniform(1, 45)))
-            elif op < 0.8:
+            elif op < 0.78:
                 coord.watchdog_cycle()
+            elif op < 0.85:
+                # host churn: joins/leaves ride the incremental
+                # host-set reconcile, never a full rebuild
+                if rng.random() < 0.5 and len(cluster.hosts) > 3:
+                    victim_h = str(rng.choice(
+                        [h for h in cluster.hosts]))
+                    cluster.remove_host(victim_h)
+                else:
+                    i = int(rng.integers(100, 1000))
+                    cluster.add_host(MockHost(
+                        f"hx{i}", mem=float(rng.integers(150, 400)),
+                        cpus=float(rng.integers(8, 32)),
+                        attributes={"rack": f"r{i % 3}"}))
             coord.match_cycle()
             if step % 10 == 9:
                 _time.sleep(0.05)   # let deferrals expire / dl fetch land
