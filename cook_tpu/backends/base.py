@@ -94,10 +94,22 @@ class ComputeCluster(abc.ABC):
         self._status_cb = cb
 
     def set_bulk_status_callback(self, cb) -> None:
-        """Optional batched channel: cb([(task_id, status, reason), ...])
-        writes the whole batch in one store transaction. Backends that
-        complete many tasks at once (mock clock ticks, kube relists)
-        should prefer emit_status_bulk."""
+        """Optional batched channel: cb([(task_id, status, reason), ...]).
+        Backends that complete many tasks at once (mock clock ticks,
+        kube relists) should prefer emit_status_bulk.
+
+        ASYNC CONTRACT: when the coordinator runs sharded status
+        executors (the production server config), cb returns BEFORE the
+        statuses reach the store — the batch is partitioned onto the
+        same hash shards the per-item channel uses (per-task ordering
+        holds across both channels) and applied as one store
+        transaction per shard sub-batch, so cross-task atomicity within
+        one batch is NOT guaranteed. Backends must not read store state
+        right after cb and assume the batch applied; anything needing
+        the applied state should go through the store's own listeners.
+        Coordinator.stop() drains the shards before the store closes;
+        external callers flushing mid-run must drain status_shards
+        themselves."""
         self._bulk_status_cb = cb
 
     def emit_status(self, task_id: str, status: InstanceStatus,

@@ -277,9 +277,12 @@ def build_scheduler(config, read_only=False):
         checkpoint_defaults=config.checkpoint or None,
         status_shards=s.status_shards)
 
-    # device-resident match path (scheduler/resident.py): per-pool
-    # opt-in via config; incompatible configs (plugins, data locality,
-    # estimated completion) fail fast at startup rather than per cycle
+    # device-resident match path (scheduler/resident.py): the
+    # production DEFAULT, with full feature parity — plugins, data
+    # locality and estimated completion all run on the resident path
+    # (launch filters + adjusters against the compact readback, bonus
+    # rows, the est-completion device lane). resident_match: false
+    # falls back to the legacy host-side cycle.
     if s.resident_match:
         for p in pools.active():
             coord.enable_resident(p.name, synchronous=False)

@@ -409,6 +409,18 @@ class Coordinator:
         if not hasattr(self, "_resident"):
             self._resident: dict[str, "ResidentPool"] = {}
             self.store.add_listener(self._resident_listener)
+        # re-enabling a pool must retire the previous launcher thread
+        # first: replacing the ResidentPool while its thread still
+        # blocks on the orphaned _launch_q would leak the thread AND
+        # silently drop any launches queued on it
+        prev = self._resident.get(pool)
+        if prev is not None:
+            prev.enabled = False
+            self.drain_resident(pool)   # in-flight consumed, queue empty
+            q = getattr(prev, "_launch_q", None)
+            if q is not None:
+                q.put(None)    # retire the thread
+            self._resident.pop(pool, None)
         rp = ResidentPool(self, pool, synchronous=synchronous, **kw)
         self._resident[pool] = rp
         if not synchronous:
@@ -436,13 +448,15 @@ class Coordinator:
             self._threads.append(t)
 
     def _resident_listener(self, kind: str, data: dict) -> None:
-        for rp in self._resident.values():
+        # snapshot: enable_resident pops/re-inserts entries from the
+        # cycle thread while store threads deliver events here
+        for rp in list(self._resident.values()):
             rp.on_event(kind, data)
 
     def _mark_dirty_all(self, uuid: str) -> None:
         """Re-sync one job on every resident pool next drain (pool
         migrations must land in the destination pool's state)."""
-        for rp in getattr(self, "_resident", {}).values():
+        for rp in list(getattr(self, "_resident", {}).values()):
             rp.mark_job_dirty(uuid)
 
     def _launch_loop(self, pool: str, rp) -> None:
@@ -456,12 +470,12 @@ class Coordinator:
                     if kind == "launch":
                         _, cname, specs = item
                         self.clusters.get(cname).launch_tasks(pool, specs)
-                    else:   # ("kill", task_id): serialized BEHIND any
-                        # queued launch of the same task, so a kill of a
-                        # just-matched job can never be a no-op that the
-                        # later launch resurrects as a zombie
-                        for cluster in self.clusters.all():
-                            cluster.kill_task(item[1])
+                    else:   # ("kill", task_id, preempt): serialized
+                        # BEHIND any queued launch of the same task, so
+                        # a kill of a just-matched job can never be a
+                        # no-op that the later launch resurrects as a
+                        # zombie
+                        self._kill_on_all(item[1], item[2])
                 except Exception:
                     # per backend contract launch_tasks shouldn't raise;
                     # a transport-level failure surfaces as task
@@ -536,6 +550,16 @@ class Coordinator:
             else:
                 try:
                     rp.reconcile_membership()
+                    # O(H) offer re-read: live-host attribute relabels
+                    # and port-range reconfigurations don't bump
+                    # offer_generation, so without this probe the light
+                    # rung would leave constraint masks / the
+                    # est-completion lane stale until the next FULL
+                    # rebuild (resync_interval * full_resync_every
+                    # cycles — hours at production cadence)
+                    if not rp.reconcile_hosts():
+                        raise _NeedResync(
+                            "host drift needs capacity growth")
                 except _NeedResync as e:
                     # backlog outgrew the row slack between full
                     # rebuilds: fall back to the full rebuild (which
@@ -792,7 +816,7 @@ class Coordinator:
                     continue
                 cur = self.store.get_instance(inst.task_id)
                 if cur is not None and not cur.active:
-                    launch_q.put(("kill", inst.task_id))
+                    launch_q.put(("kill", inst.task_id, False))
         # scaleback feedback (scheduler.clj:1002-1036)
         if head_matched:
             self._num_considerable[pool] = self.config.max_jobs_considered
@@ -1449,17 +1473,18 @@ class Coordinator:
         placed = np.asarray(res.job_placed)
         job_hosts = np.asarray(res.job_host)
 
-        # kill victims (transact then kill: rebalancer.clj:498-518)
+        # kill victims (transact then kill: rebalancer.clj:498-518).
+        # Routed through _backend_kill so the kill rides every pool's
+        # async launch queue: a victim whose launch transaction committed
+        # but whose backend hand-off is still queued would otherwise get
+        # a no-op direct kill and then run as a zombie the store believes
+        # preempted (the exact race the queue broadcast closes).
         n_killed = 0
         for row in preempted_rows:
             task_id = tb.task_ids[row]
             self.store.update_instance(task_id, InstanceStatus.FAILED,
                                        reason_code=2000, preempted=True)
-            for cluster in self.clusters.all():
-                if hasattr(cluster, "preempt_task"):
-                    cluster.preempt_task(task_id)
-                else:
-                    cluster.kill_task(task_id)
+            self._backend_kill(task_id, preempt=True)
             n_killed += 1
 
         # reserve hosts for jobs whose decision preempted >1 task
@@ -1541,7 +1566,7 @@ class Coordinator:
                 "stragglers": killed_straggler,
                 "uncommitted_gced": gced}
 
-    def _backend_kill(self, task_id: str) -> None:
+    def _backend_kill(self, task_id: str, preempt: bool = False) -> None:
         """Idempotent backend kill. When async launchers run, the kill
         rides EVERY pool's launch queue — a kill arriving between a
         launch transaction and its backend hand-off must execute AFTER
@@ -1549,16 +1574,26 @@ class Coordinator:
         a zombie task the store believes dead. Broadcasting (rather
         than routing by the job's pool) keeps the ordering correct even
         when an adjuster migrated the launch onto another pool's queue;
-        the extra kills are no-ops by backend contract."""
-        for rp in getattr(self, "_resident", {}).values():
+        the extra kills are no-ops by backend contract. preempt=True
+        uses the per-cluster preempt primitive where one exists
+        (rebalancer victims). Snapshot the dict: enable_resident
+        pops/re-inserts entries concurrently with kill callers (REST
+        handler threads)."""
+        for rp in list(getattr(self, "_resident", {}).values()):
             q = getattr(rp, "_launch_q", None)
             if q is not None:
-                q.put(("kill", task_id))
+                q.put(("kill", task_id, preempt))
         # and directly: covers sync pools / legacy paths immediately;
         # the queued copies re-kill after any in-queue launch (all
         # idempotent by backend contract)
+        self._kill_on_all(task_id, preempt)
+
+    def _kill_on_all(self, task_id: str, preempt: bool = False) -> None:
         for cluster in self.clusters.all():
-            cluster.kill_task(task_id)
+            if preempt and hasattr(cluster, "preempt_task"):
+                cluster.preempt_task(task_id)
+            else:
+                cluster.kill_task(task_id)
 
     # ------------------------------------------------------------------
     # reconciliation (scheduler.clj:1041-1104): store vs backend resync
@@ -1630,7 +1665,7 @@ class Coordinator:
         if hasattr(self, "_consume_q"):
             self.drain_resident()
             self._consume_q.put(None)
-        for rp in getattr(self, "_resident", {}).values():
+        for rp in list(getattr(self, "_resident", {}).values()):
             q = getattr(rp, "_launch_q", None)
             if q is not None:
                 q.put(None)

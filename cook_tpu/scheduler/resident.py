@@ -720,9 +720,11 @@ class ResidentPool:
         relabel) forces a row re-base. Known limitation: a live host's
         port-RANGE reconfiguration is also availability-shaped (free
         ranges vary with running tasks) and so is not in the signature;
-        it lands at the next periodic full rebuild, and until then port
-        launches that lost capacity refuse at allocate_ports and retry
-        (degraded, never corrupt)."""
+        it lands at the next periodic resync (the LIGHT rung follows
+        its membership reconcile with an O(H) reconcile_hosts probe, so
+        the window is resync_interval cycles, not the full-rebuild
+        period), and until then port launches that lost capacity refuse
+        at allocate_ports and retry (degraded, never corrupt)."""
         return (offer.cap_mem or offer.mem, offer.cap_cpus or offer.cpus,
                 offer.cap_gpus or offer.gpus,
                 tuple(sorted(offer.attributes.items())))

@@ -747,3 +747,107 @@ def test_resident_queued_credit_dropped_after_rebase():
     coord.match_cycle()
     st = fetch_state(rp)
     assert st["host"]["mem"][idx] <= 90 + 1e-3
+
+
+def test_preempt_kill_ordered_behind_queued_launch():
+    """Rebalancer preemption kills must ride the async launch queue
+    (advisor r4 medium): a victim whose launch transaction committed
+    but whose backend hand-off is still queued would otherwise get a
+    no-op direct kill and run as a zombie the store believes dead."""
+    import threading
+    import time as _time
+
+    store, cluster, coord = build()
+    coord.enable_resident(synchronous=False)
+    rp = coord._resident["default"]
+    events = []
+    gate = threading.Event()
+    orig_launch = cluster.launch_tasks
+    orig_kill = cluster.kill_task
+    orig_preempt = cluster.preempt_task
+
+    def slow_launch(pool, specs):
+        gate.wait(5.0)   # hold the launcher so the kill enqueues behind
+        events.append(("launch", [s.task_id for s in specs]))
+        orig_launch(pool, specs)
+
+    def rec_kill(tid):
+        events.append(("kill", tid))
+        orig_kill(tid)
+
+    def rec_preempt(tid):
+        events.append(("preempt", tid))
+        orig_preempt(tid)
+
+    cluster.launch_tasks = slow_launch
+    cluster.kill_task = rec_kill
+    cluster.preempt_task = rec_preempt
+    job = mkjob()
+    store.create_jobs([job])
+    coord.match_cycle()
+    # wait for the launch transaction to commit (txn BEFORE enqueue)
+    for _ in range(500):
+        if job.instances:
+            break
+        _time.sleep(0.01)
+    tid = job.instances[0].task_id
+    # the rebalancer's kill path while the launch sits in the queue
+    coord._backend_kill(tid, preempt=True)
+    gate.set()
+    coord.drain_resident()
+    # the backend must have seen a (re)kill AFTER the launch posted:
+    # the task cannot survive as a zombie
+    kinds = [k for k, _ in events]
+    launch_at = kinds.index("launch")
+    assert any(k in ("kill", "preempt") for k in kinds[launch_at + 1:]), \
+        events
+    assert tid not in cluster.known_task_ids()
+    coord.stop()
+
+
+def test_enable_resident_twice_retires_old_launcher():
+    """Re-enabling a pool (advisor r4): the previous launcher thread
+    must exit and nothing queued on it may be dropped."""
+    store, cluster, coord = build()
+    coord.enable_resident(synchronous=False)
+    old_threads = [t for t in coord._threads
+                   if t.name == "resident-launcher-default"]
+    assert len(old_threads) == 1
+    jobs = [mkjob() for _ in range(3)]
+    store.create_jobs(jobs)
+    coord.match_cycle()
+    # re-enable while launches may still be in flight: the old queue
+    # drains first, then the thread retires
+    coord.enable_resident(synchronous=False)
+    coord.drain_resident()
+    assert all(j.state == JobState.RUNNING for j in jobs)
+    old_threads[0].join(timeout=5)
+    assert not old_threads[0].is_alive()
+    # the replacement pool still schedules
+    more = [mkjob() for _ in range(2)]
+    store.create_jobs(more)
+    coord.match_cycle()
+    coord.drain_resident()
+    assert all(j.state == JobState.RUNNING for j in more)
+    coord.stop()
+
+
+def test_light_resync_probes_host_signatures():
+    """Live-host attribute relabels that don't bump offer_generation
+    (advisor r4): the LIGHT rung follows its membership reconcile with
+    an O(H) reconcile_hosts, so the stale window is resync_interval
+    cycles, not the full-rebuild period."""
+    hosts = [MockHost("h0", mem=1000, cpus=16, attributes={"zone": "z1"}),
+             MockHost("h1", mem=1000, cpus=16, attributes={"zone": "z2"})]
+    store, cluster, coord = build(hosts=hosts)
+    coord.enable_resident(resync_interval=4)
+    job = mkjob(constraints=[("zone", "EQUALS", "z3")])
+    store.create_jobs([job])
+    coord.match_cycle()
+    assert job.state == JobState.WAITING
+    # relabel WITHOUT an offer_generation bump (in-place attr change)
+    with cluster._lock:
+        cluster.hosts["h0"].attributes["zone"] = "z3"
+    for _ in range(6):   # cross the light-resync boundary
+        coord.match_cycle()
+    assert job.state == JobState.RUNNING
