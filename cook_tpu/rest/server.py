@@ -384,7 +384,7 @@ def main(argv=None) -> None:
         elector = getattr(api, "leader_elector", None)
         epoch = getattr(elector, "epoch", 0)
         if epoch:
-            store.epoch = max(epoch, store._replay_max_epoch + 1)
+            store.adopt_epoch(epoch)
         if not _still_leader():
             raise RuntimeError("leadership lost during takeover replay")
         for cluster in coord.clusters.all():
@@ -437,7 +437,7 @@ def main(argv=None) -> None:
                     if not _still_leader():
                         continue
                     try:
-                        lines = store._log.lines() if store._log else 0
+                        lines = store.log_lines()
                         if lines >= settings.log_rotate_lines > 0:
                             store.rotate_log(settings.snapshot_path)
                             log.info("rotated event log at %d lines",

@@ -1500,34 +1500,33 @@ class ResidentPool:
         co, pool = self.coord, self.pool
         store = co.store
         with self.mirror_lock:
-            # store truth and the event queue snapshot must be taken
-            # under the store lock: an instance becomes visible in
-            # job.instances and its event enqueues inside one store
-            # transaction, so this pairing can never see a fresh launch
-            # as a "missed" event (which would double-deplete a host).
-            with store._lock:
+            # store truth and the event queue snapshot pair through
+            # snapshot_view: the store emits events inside the same
+            # critical section that mutates state (the invariant
+            # snapshot_view owns and documents), so this pairing can
+            # never see a fresh launch as a "missed" event (which
+            # would double-deplete a host).
+            with store.snapshot_view(pool) as sv:
                 if self._adjust is None:
-                    # fast path: the store's pending-by-pool index IS
-                    # the truth dict — key-view set differences (C
-                    # level) instead of rebuilding a P-sized dict
-                    pend_index = store._pending.get(pool, {})
-                    pend_missing = pend_index.keys() - self.pend_row.keys()
-                    pend_extra = self.pend_row.keys() - pend_index.keys()
-                    add_jobs = [pend_index[u] for u in pend_missing]
+                    # fast path: the live pending index IS the truth
+                    # dict — key-view set differences (C level)
+                    # instead of rebuilding a P-sized dict
+                    pend_missing = sv.pending.keys() - self.pend_row.keys()
+                    pend_extra = self.pend_row.keys() - sv.pending.keys()
+                    add_jobs = [sv.pending[u] for u in pend_missing]
                 else:
                     # keep the RAW job: _sync_job applies the adjuster
                     # internally, and a second application here would
                     # compound a copy-returning non-idempotent adjuster
                     # (the adjusted view is only for the pool filter)
                     store_pend = {}
-                    for j in store.pending_jobs(pool):
+                    for j in sv.pending.values():
                         if self._adjusted(j).pool == pool:
                             store_pend[j.uuid] = j
                     pend_missing = store_pend.keys() - self.pend_row.keys()
                     pend_extra = self.pend_row.keys() - store_pend.keys()
                     add_jobs = [store_pend[u] for u in pend_missing]
-                run_truth = {i.task_id: (i, store.jobs[i.job_uuid])
-                             for i in store.running_instances(pool)}
+                run_truth = {i.task_id: (i, j) for i, j in sv.running}
                 with self._ev_lock:
                     queued = list(self._events)
             # rows mentioned by a queued event are the normal path's

