@@ -528,10 +528,37 @@ class Coordinator:
         # consumer queue depth, so a due resync always runs this cycle
         # instead of being skipped under sustained load.
         reason = rp.resync_reason()
+        if reason == "full" and rp.background_rebuild:
+            # double-buffered full rebuild (VERDICT r4 #1): never stall
+            # the cycle thread on the multi-second build. Kick it on a
+            # builder thread, keep cycling on the old state, install at
+            # a later cycle boundary — the only cycle-thread cost is
+            # the in-flight drain plus the O(changes) catch-up.
+            from cook_tpu.scheduler.resident import _NeedResync
+            if rp.rebuild_ready():
+                t_rs = time.perf_counter()
+                self.drain_resident(pool)
+                swapped = False
+                try:
+                    swapped = rp.swap_in_shadow()
+                except _NeedResync as e:
+                    log.info("rebuild swap overflowed (%s); falling "
+                             "back to sync rebuild", e)
+                if not swapped:
+                    rp.resync()
+                swap_ms = (time.perf_counter() - t_rs) * 1e3
+                self.metrics[f"match.{pool}.resync_ms"] = swap_ms
+                self.metrics[f"match.{pool}.rebuild_build_ms"] = \
+                    getattr(rp, "last_build_ms", 0.0)
+                metrics_registry.timer(
+                    f"match.{pool}.resync_swap_ms").update(swap_ms)
+            elif not rp.rebuilding():
+                rp.start_background_rebuild()
+            reason = None   # handled (or deferred until the build lands)
         if reason is not None:
             from cook_tpu.scheduler.resident import _NeedResync
             t_rs = time.perf_counter()
-            if reason == "full":
+            if reason in ("full", "full-urgent"):
                 self.drain_resident(pool)
                 rp.resync()
             elif reason == "hosts":
@@ -595,7 +622,7 @@ class Coordinator:
         # per-user launch rate limit folds into the count quota; the
         # global limiter gates the whole cycle (scheduler.clj:627-657)
         if self.user_launch_rl.enforce:
-            for user, uid in self.interner.ids.items():
+            for user, uid in self.interner.items():
                 if uid < qn.shape[0] and \
                         not self.user_launch_rl.would_allow(user):
                     qn[uid] = 0

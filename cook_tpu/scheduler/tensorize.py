@@ -8,6 +8,7 @@ sizes so the jitted kernels compile once per bucket, not per cycle
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -27,16 +28,30 @@ def bucket(n: int) -> int:
 
 
 class UserInterner:
-    """Stable user-name -> dense id mapping for one coordinator."""
+    """Stable user-name -> dense id mapping for one coordinator.
+    Thread-safe: the background rebuild interns from its builder thread
+    while the cycle thread fills rows (two racing first-sightings of a
+    user must not mint two ids)."""
 
     def __init__(self):
         self.ids: dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def id(self, user: str) -> int:
         i = self.ids.get(user)
         if i is None:
-            i = self.ids[user] = len(self.ids)
+            with self._lock:
+                i = self.ids.get(user)
+                if i is None:
+                    i = self.ids[user] = len(self.ids)
         return i
+
+    def items(self) -> list:
+        """Snapshot for iteration: the builder thread may insert while
+        the cycle thread walks the mapping (quota arrays, rate-limit
+        lanes) — iterating the live dict would raise mid-insert."""
+        with self._lock:
+            return list(self.ids.items())
 
     def size_bucket(self) -> int:
         return bucket(len(self.ids) + 1)
@@ -183,7 +198,7 @@ def quota_arrays(quotas: QuotaStore, interner: UserInterner, pool: str,
     qm = np.full(size, F32_MAX, np.float32)
     qc = np.full(size, F32_MAX, np.float32)
     qn = np.full(size, 1e9, np.float32)
-    for user, uid in interner.ids.items():
+    for user, uid in interner.items():
         if uid >= size:
             continue
         q = quotas.get(user, pool)

@@ -56,8 +56,10 @@ class SnapshotView:
     running: [(Instance, Job), ...] for the pool's RUNNING instances
       (this list IS a copy and survives the block).
     seq: the store's event cursor (count of listener emissions) at
-      snapshot time — a background rebuild records it to know which
-      events its basis already reflects.
+      snapshot time — lets a consumer totally order views against its
+      own event stream. The resident swap catch-up itself is
+      truth-driven and does not consult it; the atomicity test pins
+      the cursor's ordering guarantee.
     """
 
     pending: dict
@@ -572,9 +574,14 @@ class JobStore:
     # ------------------------------------------------------------------
     # queries (tools.clj:298-582 equivalents)
     def pending_jobs(self, pool: Optional[str] = None) -> list[Job]:
-        if pool is None:
-            return [j for d in self._pending.values() for j in d.values()]
-        return list(self._pending.get(pool, {}).values())
+        # under the lock: a concurrent submission mutating the index
+        # mid-iteration would raise (background rebuilds read this from
+        # a non-cycle thread)
+        with self._lock:
+            if pool is None:
+                return [j for d in self._pending.values()
+                        for j in d.values()]
+            return list(self._pending.get(pool, {}).values())
 
     def running_jobs(self, pool: Optional[str] = None) -> list[Job]:
         """O(running), not O(all jobs ever): served from the
