@@ -114,6 +114,13 @@ class SchedulerSettings:
     # data locality, estimated completion all supported); set false to
     # force the legacy per-cycle re-tensorize path.
     resident_match: bool = True
+    # shard ONE pool's resident host tensors over this many devices
+    # (0/1 = single device). Opt in when a pool's host count or HBM
+    # footprint exceeds one chip: the match runs the distributed scan
+    # (parallel/sharded_match — shard-local scoring, pmax/pmin argmax
+    # over ICI), unique host-placement groups included. Applies to
+    # every resident pool the server enables.
+    resident_shard_devices: int = 0
     # hash-sharded in-order status executors (scheduler.clj:1524-1546);
     # 0 = inline on the backend callback thread
     status_shards: int = 19

@@ -55,7 +55,8 @@ class CycleResult(NamedTuple):
 
 @functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
                                              "sequential", "use_pallas",
-                                             "dru_mode", "match_kw"))
+                                             "dru_mode", "match_kw",
+                                             "matcher"))
 def rank_and_match(
     # running tasks (R slots)
     run_user, run_mem, run_cpus, run_prio, run_start, run_valid,
@@ -110,6 +111,13 @@ def rank_and_match(
                                # compact mask as a pure time-lane
                                # comparison, so host lifetimes decay on
                                # device without any per-cycle re-masking
+    matcher=None,              # match-step override: callable
+                               # (jobs, hosts, forb, bonus)->MatchResult.
+                               # STATIC under jit (keep the callable's
+                               # identity stable across cycles). The
+                               # host-sharded resident pool passes the
+                               # mesh-bound distributed scan here
+                               # (parallel/sharded_match.resident_matcher)
 ) -> CycleResult:
     R = run_user.shape[0]
     P = pend_user.shape[0]
@@ -234,7 +242,9 @@ def rank_and_match(
             * in_use[:, None]
     else:
         bonusc = bonus[pend_idx] * in_use[:, None]
-    if sequential:
+    if matcher is not None:
+        res = matcher(jobs, hosts, forb, bonusc)
+    elif sequential:
         res = match_ops.match_scan(jobs, hosts, forb, num_groups=num_groups,
                                    bonus=bonusc,
                                    use_pallas=use_pallas and bonus is None)

@@ -3,20 +3,24 @@
 For a single huge pool (the 100k-pending x 10k-offer headline config) one
 device's HBM comfortably holds the tensors, but sharding the *host* axis
 lets the per-job feasibility/fitness sweep run on D devices at once and
-extends to multi-host meshes over ICI/DCN.
+extends to multi-host meshes over ICI/DCN — the "psum over pool shards"
+north star (BASELINE.md, SURVEY.md §2.5.1).
 
 Per scan step (one job):
-  1. every device scores its local host shard (feasibility + fitness),
+  1. every device scores its local host shard (feasibility + fitness +
+     same-cycle group occupancy + optional data-locality bonus),
   2. one pmax reduces the best local fitness to the global best,
   3. one pmin picks the lowest global host index among devices tying at
      that fitness (identical tie-break to the single-device argmax),
-  4. the winning device subtracts the job's resources from its shard.
+  4. the winning device subtracts the job's resources from its shard and
+     marks its group-occupancy row.
 
-Semantically identical to ops/match.match_scan for group-free batches —
-the equivalence test runs both on an 8-device CPU mesh. LIMITATION
-(enforced): this path does not model same-cycle group coupling, so the
-wrapper REFUSES batches containing unique-host groups (ValueError);
-route those through match_scan / match_rounds, which enforce it.
+Unique host-placement groups (constraints.clj:411-423) are first-class:
+occupancy is per-host state, so each device keeps a (num_groups, H_local)
+bool of its own shard and only the winning device marks it — no gather or
+exchange is needed, feasibility tests are purely shard-local. Semantics
+are identical to ops/match.match_scan (the equivalence tests run both on
+an 8-device CPU mesh, groups included).
 """
 from __future__ import annotations
 
@@ -38,32 +42,49 @@ def make_host_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(devs[:n], (HOST_AXIS,))
 
 
-def sharded_match_scan(mesh: Mesh):
+def sharded_match_scan(mesh: Mesh, num_groups: int = 1,
+                       with_bonus: bool = False):
     """Build the jitted host-sharded greedy matcher for `mesh`.
 
-    fn(jobs: Jobs, hosts: Hosts, forbidden[N, H]) -> job_host[N]
-    H must be divisible by the mesh size.
+    fn(jobs: Jobs, hosts: Hosts, forbidden[N, H][, bonus[N, H]])
+        -> MatchResult
+    H must be divisible by the mesh size. jobs fields are replicated;
+    hosts/forbidden/bonus are sharded on the host axis. The returned
+    job_host is replicated, the *_left lanes stay host-sharded.
+    num_groups bounds the same-cycle group-occupancy table exactly like
+    match_scan's static num_groups.
     """
+
+    bonus_spec = (P(None, HOST_AXIS),) if with_bonus else ()
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(), P(HOST_AXIS), P(None, HOST_AXIS)),
-        out_specs=P())
-    def run(jobs: match_ops.Jobs, hosts: match_ops.Hosts, forbidden):
+        in_specs=(P(), P(HOST_AXIS), P(None, HOST_AXIS)) + bonus_spec,
+        out_specs=(P(), P(HOST_AXIS), P(HOST_AXIS), P(HOST_AXIS),
+                   P(HOST_AXIS)))
+    def run(jobs: match_ops.Jobs, hosts: match_ops.Hosts, forbidden,
+            *maybe_bonus):
         Hl = hosts.mem.shape[0]  # local shard size
         shard = jax.lax.axis_index(HOST_AXIS)
         base = shard * Hl  # global index of this shard's first host
+        bonus = maybe_bonus[0] if maybe_bonus else \
+            match_ops.varying_full(forbidden, 0.0, forbidden.shape,
+                                   jnp.float32)
 
         def step(carry, xs):
-            mem_left, cpus_left, gpus_left, slots_left = carry
-            j_mem, j_cpus, j_gpus, j_valid, forb = xs
+            mem_left, cpus_left, gpus_left, slots_left, occ = carry
+            j_mem, j_cpus, j_gpus, j_valid, j_group, j_unique, forb, bon = xs
 
             ok = match_ops._feasible(
                 j_mem, j_cpus, j_gpus, mem_left, cpus_left, gpus_left,
                 hosts.cap_gpus, hosts.valid, slots_left, forb)
+            # unique host-placement, same-cycle coupling: this shard's
+            # hosts already holding a cotask are occupied in OUR rows
+            g = jnp.clip(j_group, 0, num_groups - 1)
+            ok &= ~(j_unique & occ[g])
             ok &= j_valid
             fit = match_ops._fitness(j_mem, j_cpus, mem_left, cpus_left,
-                                     hosts.cap_mem, hosts.cap_cpus)
+                                     hosts.cap_mem, hosts.cap_cpus) + bon
             fit = jnp.where(ok, fit, -1.0)
             lbest = jnp.argmax(fit)
             lfit = fit[lbest]
@@ -82,34 +103,44 @@ def sharded_match_scan(mesh: Mesh):
             cpus_left = cpus_left - jnp.where(onehot, j_cpus, 0.0)
             gpus_left = gpus_left - jnp.where(onehot, j_gpus, 0.0)
             slots_left = slots_left - onehot.astype(jnp.int32)
+            occ = occ.at[g].set(occ[g] | (onehot & j_unique))
             host = jnp.where(assigned, gwin, match_ops.NO_HOST)
-            return (mem_left, cpus_left, gpus_left, slots_left), host
+            return (mem_left, cpus_left, gpus_left, slots_left, occ), host
 
-        carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots)
-        xs = (jobs.mem, jobs.cpus, jobs.gpus, jobs.valid, forbidden)
-        _, job_host = jax.lax.scan(step, carry, xs)
-        return job_host
+        occ0 = match_ops.varying_full(hosts.valid, False,
+                                      (num_groups, Hl), bool)
+        carry = (hosts.mem, hosts.cpus, hosts.gpus, hosts.task_slots, occ0)
+        xs = (jobs.mem, jobs.cpus, jobs.gpus, jobs.valid, jobs.group,
+              jobs.unique_group, forbidden, bonus)
+        (mem_left, cpus_left, gpus_left, slots_left, _), job_host = \
+            jax.lax.scan(step, carry, xs)
+        return job_host, mem_left, cpus_left, gpus_left, slots_left
 
     jitted = jax.jit(run)
 
-    def guarded(jobs: match_ops.Jobs, hosts: match_ops.Hosts, forbidden):
-        # ENFORCED limitation (not just documented): same-cycle group
-        # coupling is not modeled on the sharded path — a grouped batch
-        # slipping through would silently violate unique host-placement,
-        # so refuse and let the caller route it through
-        # match_scan/match_rounds, which enforce it. Tracers can't be
-        # inspected, so composition under an outer jit skips the guard;
-        # concrete inputs (how callers hand batches over) are checked —
-        # the N-bool readback is negligible for host-built batches and
-        # accepted for device-resident ones (correctness over one RTT).
-        import numpy as _np
-        ug = jobs.unique_group
-        if not isinstance(ug, jax.core.Tracer) and \
-                bool(_np.asarray(ug).any()):
+    def wrapped(jobs, hosts, forbidden, bonus=None):
+        if bonus is not None and not with_bonus:
             raise ValueError(
-                "sharded_match_scan does not support unique-host group "
-                "coupling; route grouped batches through "
-                "ops.match.match_scan / match_rounds")
-        return jitted(jobs, hosts, forbidden)
+                "bonus passed to a matcher built with with_bonus=False; "
+                "build sharded_match_scan(mesh, with_bonus=True)")
+        args = (jobs, hosts, forbidden)
+        if with_bonus:
+            args += (bonus if bonus is not None
+                     else jnp.zeros_like(forbidden, jnp.float32),)
+        job_host, mem_left, cpus_left, gpus_left, slots_left = jitted(*args)
+        return match_ops.MatchResult(
+            job_host=job_host, mem_left=mem_left, cpus_left=cpus_left,
+            gpus_left=gpus_left, slots_left=slots_left)
 
-    return guarded
+    return wrapped
+
+
+@functools.lru_cache(maxsize=32)
+def resident_matcher(mesh: Mesh, num_groups: int, with_bonus: bool):
+    """Cached factory for the resident pool's dispatch path: a matcher
+    with the (jobs, hosts, forb, bonus) -> MatchResult signature
+    cycle_ops.rank_and_match accepts via its `matcher` override. Cached
+    so the jit-static matcher identity is stable across cycles (a fresh
+    closure per cycle would recompile the fused device program)."""
+    return sharded_match_scan(mesh, num_groups=num_groups,
+                              with_bonus=with_bonus)

@@ -284,8 +284,20 @@ def build_scheduler(config, read_only=False):
     # rows, the est-completion device lane). resident_match: false
     # falls back to the legacy host-side cycle.
     if s.resident_match:
+        shard_n = getattr(s, "resident_shard_devices", 0)
+        shard_devs = None
+        if shard_n and shard_n > 1:
+            import jax
+            devs = jax.devices()
+            if len(devs) >= shard_n:
+                shard_devs = devs[:shard_n]
+            else:
+                log.warning(
+                    "resident_shard_devices=%d but only %d devices "
+                    "visible; running single-device", shard_n, len(devs))
         for p in pools.active():
-            coord.enable_resident(p.name, synchronous=False)
+            coord.enable_resident(p.name, synchronous=False,
+                                  devices=shard_devs)
 
     # optimizer cycle (start-optimizer-cycles! mesos.clj:216,
     # optimizer.clj:115): config {"optimizer": {"optimizer": "pkg:fn",

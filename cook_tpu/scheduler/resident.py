@@ -205,11 +205,12 @@ def _scatter_hostset(state, idx, hf, hi):
 
 @functools.partial(jax.jit, static_argnames=(
     "num_considerable", "sequential", "num_groups", "dru_mode",
-    "use_pallas", "match_kw", "with_bonus", "with_est"),
+    "use_pallas", "match_kw", "with_bonus", "with_est", "matcher"),
     donate_argnums=(0,))
 def _device_cycle(state, deltas, qm, qc, qn, considerable_limit, now_s,
                   num_considerable, sequential, num_groups, dru_mode,
-                  use_pallas, match_kw, with_bonus, with_est):
+                  use_pallas, match_kw, with_bonus, with_est,
+                  matcher=None):
     (p_idx, pf, pi, r_idx, rf, ri, c_idx, cf, ci, f_idx, frows,
      b_idx, brows) = deltas
     p = _apply_pend(state["pend"], p_idx, pf, pi)
@@ -241,7 +242,8 @@ def _device_cycle(state, deltas, qm, qc, qn, considerable_limit, now_s,
         bonus=(state["bonus"], p["bonus_slot"]) if with_bonus else None,
         pend_est_s=p["est_s"] if with_est else None,
         host_death_s=h["death_s"] if with_est else None,
-        now_s=now_s if with_est else None)
+        now_s=now_s if with_est else None,
+        matcher=matcher)
     Pcap = p["valid"].shape[0]
     # matched rows leave the pending set ON DEVICE, immediately: the
     # readback lag can then never double-launch (see module docstring)
@@ -319,7 +321,7 @@ class ResidentPool:
                  locality_refresh_cycles: int = 16,
                  synchronous: bool = True,
                  background_rebuild: Optional[bool] = None,
-                 device=None):
+                 device=None, devices=None):
         self.coord = coordinator
         self.pool = pool
         self.forb_cap = forb_cap
@@ -338,6 +340,20 @@ class ResidentPool:
         # — pools are independent scheduling problems; N pools across N
         # chips scale the leader horizontally). None = default device.
         self.device = device
+        # ONE pool spanning MANY chips (VERDICT r5 #2): `devices` shards
+        # the pool's HOST axis over a mesh — host/forb/bonus tensors
+        # live sharded, pend/run replicate, and the match runs the
+        # distributed scan (parallel/sharded_match: shard-local
+        # score + pmax/pmin argmax + shard-local depletion, unique
+        # host-placement groups included). Opt in for pools whose host
+        # count or HBM footprint exceeds one chip.
+        self.mesh = None
+        if devices is not None and len(devices) > 1:
+            if device is not None:
+                raise ValueError("pass device= or devices=, not both")
+            from jax.sharding import Mesh
+            import numpy as _np
+            self.mesh = Mesh(_np.asarray(devices), ("hosts",))
         # per-cycle launch plugins run against the COMPACT readback at
         # consume time (the reference filters considerables,
         # plugins/launch.clj:59-121 — the readback loop is the same
@@ -452,6 +468,10 @@ class ResidentPool:
         self._build_count = getattr(self, "_build_count", 0) + 1
         self.host_attrs = [o.attributes for o in offers]
         H = max(bucket(len(offers)), 64)
+        if self.mesh is not None:
+            # the host axis shards evenly over the mesh
+            D = self.mesh.devices.size
+            H = ((H + D - 1) // D) * D
         self.Hcap = H
         hostd = {
             "mem": np.zeros(H, np.float32),
@@ -535,14 +555,32 @@ class ResidentPool:
                 log.info("resident rebuild grew caps (forb=%d bonus=%d)"
                          ": %s", self.forb_cap, self.bonus_cap, msg)
         # device state: upload mirrors wholesale (resync only)
-        dev = self.device or jax.devices()[0]
-        self.state = jax.device_put({
-            "pend": {f: self._pend_m[f].copy() for f in PEND_FIELDS},
-            "run": {f: self._run_m[f].copy() for f in RUN_FIELDS},
-            "host": {k: v.copy() for k, v in hostd.items()},
-            "forb": self._forb_rows_m.copy(),
-            "bonus": self._bonus_rows_m.copy(),
-        }, dev)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+            rep = NamedSharding(self.mesh, _P())
+            sh_host = NamedSharding(self.mesh, _P("hosts"))
+            sh_rows = NamedSharding(self.mesh, _P(None, "hosts"))
+            self.state = {
+                "pend": jax.device_put(
+                    {f: self._pend_m[f].copy() for f in PEND_FIELDS}, rep),
+                "run": jax.device_put(
+                    {f: self._run_m[f].copy() for f in RUN_FIELDS}, rep),
+                "host": jax.device_put(
+                    {k: v.copy() for k, v in hostd.items()}, sh_host),
+                "forb": jax.device_put(self._forb_rows_m.copy(), sh_rows),
+                "bonus": jax.device_put(self._bonus_rows_m.copy(),
+                                        sh_rows),
+            }
+        else:
+            dev = self.device or jax.devices()[0]
+            self.state = jax.device_put({
+                "pend": {f: self._pend_m[f].copy() for f in PEND_FIELDS},
+                "run": {f: self._run_m[f].copy() for f in RUN_FIELDS},
+                "host": {k: v.copy() for k, v in hostd.items()},
+                "forb": self._forb_rows_m.copy(),
+                "bonus": self._bonus_rows_m.copy(),
+            }, dev)
         self._dirty_pend: set[int] = set()
         self._dirty_forb: set[int] = set()
         self._dirty_bonus: set[int] = set()
@@ -1314,13 +1352,22 @@ class ResidentPool:
         num_groups = (1 if not self._group_ids
                       else bucket(len(self._group_ids)))
         now_s = np.int32((time.time() * 1000.0 - self._t0_ms) / 1000.0)
+        matcher = None
+        if self.mesh is not None:
+            # host-sharded distributed scan; the factory is lru_cached
+            # so the jit-static matcher identity is stable per
+            # (mesh, num_groups, bonus) and cycles never recompile
+            from cook_tpu.parallel.sharded_match import resident_matcher
+            matcher = resident_matcher(self.mesh, int(num_groups),
+                                       self.with_bonus)
         self.state, out = _device_cycle(
             self.state, bundle, qm, qc, qn,
             np.int32(considerable_limit), now_s,
             num_considerable=num_considerable, sequential=sequential,
             num_groups=int(num_groups), dru_mode=dru_mode,
             use_pallas=use_pallas, match_kw=match_kw,
-            with_bonus=self.with_bonus, with_est=self.with_est)
+            with_bonus=self.with_bonus, with_est=self.with_est,
+            matcher=matcher)
         co = _CycleOut(self.cycle_no, *out, t_dispatch=time.perf_counter())
         # ASYNC mode only: start the device->host copy of the compact
         # outputs NOW, so by the time the consumer (one or two cycles
@@ -1574,7 +1621,9 @@ class ResidentPool:
                     resync_interval=self.resync_interval,
                     full_resync_every=self.full_resync_every,
                     locality_refresh_cycles=self.locality_refresh_cycles,
-                    device=self.device)
+                    device=self.device,
+                    devices=(list(self.mesh.devices.flat)
+                             if self.mesh is not None else None))
                 hook = self._bg_build_hook
                 if hook is not None:   # test seam: hold the build open
                     hook(shadow)

@@ -151,9 +151,46 @@ def test_sharded_match_equals_single_device():
     forb = jnp.zeros((N, H), bool)
     mesh = sharded_match.make_host_mesh()
     fn = sharded_match.sharded_match_scan(mesh)
-    sharded = np.asarray(fn(jobs, hosts, forb))
-    single = np.asarray(match_ops.match_scan(jobs, hosts, forb).job_host)
-    np.testing.assert_array_equal(sharded, single)
+    sharded = fn(jobs, hosts, forb)
+    single = match_ops.match_scan(jobs, hosts, forb)
+    np.testing.assert_array_equal(np.asarray(sharded.job_host),
+                                  np.asarray(single.job_host))
+    for f in ("mem_left", "cpus_left", "gpus_left", "slots_left"):
+        np.testing.assert_allclose(np.asarray(getattr(sharded, f)),
+                                   np.asarray(getattr(single, f)),
+                                   atol=1e-5)
+
+
+def test_sharded_match_unique_groups_equals_single_device():
+    """The r4 semantics hole is closed: unique host-placement groups run
+    ON the sharded path (per-shard occupancy rows, no gather) with
+    results identical to the single-device scan."""
+    rng = np.random.default_rng(11)
+    N, H, G = 48, 16, 4
+    group = rng.integers(-1, G, N).astype(np.int32)
+    unique = group >= 0
+    jobs = match_ops.Jobs(
+        mem=jnp.asarray(rng.uniform(1, 20, N), jnp.float32),
+        cpus=jnp.asarray(rng.uniform(0.5, 8, N), jnp.float32),
+        gpus=jnp.zeros(N, jnp.float32),
+        valid=jnp.asarray(rng.random(N) < 0.9),
+        group=jnp.asarray(group),
+        unique_group=jnp.asarray(unique))
+    hosts = match_ops.make_hosts(
+        mem=rng.uniform(40, 120, H).astype(np.float32),
+        cpus=rng.uniform(8, 32, H).astype(np.float32))
+    forb = jnp.asarray(rng.random((N, H)) < 0.1)
+    mesh = sharded_match.make_host_mesh()
+    fn = sharded_match.sharded_match_scan(mesh, num_groups=G)
+    sharded = fn(jobs, hosts, forb)
+    single = match_ops.match_scan(jobs, hosts, forb, num_groups=G)
+    np.testing.assert_array_equal(np.asarray(sharded.job_host),
+                                  np.asarray(single.job_host))
+    # no two cotasks of a unique group share a host
+    jh = np.asarray(sharded.job_host)
+    for g in range(G):
+        used = jh[(group == g) & (jh >= 0)]
+        assert len(used) == len(set(used.tolist()))
 
 
 def test_federated_cycle_2d_mesh():

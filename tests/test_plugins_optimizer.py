@@ -333,20 +333,28 @@ def test_http_cost_fetcher_wire_shape():
         srv.shutdown()
 
 
-def test_sharded_match_refuses_unique_groups():
+def test_sharded_match_places_unique_groups():
+    """The r4 refusal is gone: unique host-placement groups run ON the
+    sharded path (per-shard occupancy rows) — two cotasks land on two
+    distinct hosts, same as the single-device scan."""
     import jax.numpy as jnp
-    import pytest as _pytest
+    import numpy as _np
 
     from cook_tpu.ops import match as match_ops
     from cook_tpu.parallel import sharded_match
 
     mesh = sharded_match.make_host_mesh(2)
-    fn = sharded_match.sharded_match_scan(mesh)
+    fn = sharded_match.sharded_match_scan(mesh, num_groups=1)
     jobs = match_ops.make_jobs(mem=[1.0, 1.0], cpus=[1.0, 1.0],
                                group=[0, 0], unique_group=[True, True])
     hosts = match_ops.make_hosts(mem=[10.0] * 4, cpus=[10.0] * 4)
-    with _pytest.raises(ValueError, match="group"):
-        fn(jobs, hosts, jnp.zeros((2, 4), bool))
+    res = fn(jobs, hosts, jnp.zeros((2, 4), bool))
+    jh = _np.asarray(res.job_host)
+    assert (jh >= 0).all()
+    assert jh[0] != jh[1]
+    single = match_ops.match_scan(jobs, hosts, jnp.zeros((2, 4), bool),
+                                  num_groups=1)
+    _np.testing.assert_array_equal(jh, _np.asarray(single.job_host))
 
 
 def test_capacity_planning_optimizer_covers_unmet_demand():

@@ -953,3 +953,46 @@ def test_background_rebuild_urgent_stays_inline():
     assert rp._build_count == builds + 1   # rebuilt inline, this cycle
     assert rp._bg is None
     assert_state_matches_rebuild(coord)
+
+
+def test_sharded_resident_pool_equals_single_device_oracle():
+    """VERDICT r5 #2: ONE production pool spans devices — host/forb
+    tensors shard over the mesh, dispatch runs the distributed scan —
+    and the launch set equals the single-device oracle, unique-host
+    groups included."""
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    def scenario(coord, store):
+        g = Group(uuid=new_uuid(), name="g",
+                  host_placement={"type": "unique"})
+        jobs = [mkjob(user=f"u{i % 3}", mem=50 + 10 * (i % 5),
+                      cpus=1 + (i % 3)) for i in range(24)]
+        gjobs = [mkjob(group=g.uuid) for _ in range(4)]
+        store.create_jobs(jobs + gjobs, groups=[g])
+        coord.match_cycle()
+        return jobs + gjobs, gjobs
+
+    store_a, _, coord_a = build(n_hosts=6)
+    coord_a.enable_resident()
+    all_a, _ = scenario(coord_a, store_a)
+    hosts_a = sorted(j.instances[0].hostname for j in all_a if j.instances)
+
+    store_b, cluster_b, coord_b = build(n_hosts=6)
+    coord_b.enable_resident(devices=devs[:8])
+    all_b, gjobs_b = scenario(coord_b, store_b)
+    hosts_b = sorted(j.instances[0].hostname for j in all_b if j.instances)
+
+    assert hosts_a == hosts_b
+    gh = [j.instances[0].hostname for j in gjobs_b if j.instances]
+    assert len(gh) == len(set(gh)), gh   # unique placement held
+    # the sharded pool keeps scheduling across completions and churn
+    cluster_b.advance(200.0)
+    coord_b.match_cycle()
+    assert_state_matches_rebuild(coord_b)
+    # tensors really shard: host lanes live across all 8 devices
+    assert len(coord_b._resident["default"]
+               .state["host"]["mem"].sharding.device_set) == 8
