@@ -106,8 +106,13 @@ class SchedulerSettings:
     rebalancer_max_preemption: int = 64
     rebalancer_candidate_cap: int = 0   # 0 = exact; >0 = top-K victims
     sequential_match_threshold: int = 2048
-    use_pallas: bool = False            # fused TPU kernels (measured
-    #                                     parity on v5e; see benchmarks)
+    # fused Pallas TPU matcher kernels: true | false | "auto".
+    # "auto" races BOTH lowerings on the actual device at startup and
+    # takes the winner (ops/pallas_probe — rounds 2-4 measured parity
+    # on a v5e, and the winner can differ by device generation, so the
+    # empirical probe replaces a hardcoded guess). Non-TPU platforms
+    # resolve "auto" to false.
+    use_pallas: object = False
     # device-resident match path (scheduler/resident.py): tensors stay
     # on device, the host ships store-event deltas. THE production
     # default — full feature parity with the legacy cycle (plugins,
@@ -133,6 +138,11 @@ class SchedulerSettings:
         if self.rebalancer_candidate_cap < 0:
             raise ConfigError("rebalancer_candidate_cap must be >= 0 "
                               "(0 = exact sweep)")
+        if not isinstance(self.use_pallas, bool) \
+                and str(self.use_pallas).lower() != "auto":
+            raise ConfigError(
+                f"use_pallas must be true, false or 'auto'; "
+                f"got {self.use_pallas!r}")
 
 
 @dataclass

@@ -138,6 +138,15 @@ def apply_gc_discipline() -> None:
     gc.freeze()
 
 
+def _resolve_use_pallas(setting) -> bool:
+    """true/false pass through; "auto" races both matcher lowerings on
+    the actual device at boot and takes the winner (ops/pallas_probe)."""
+    if isinstance(setting, bool):
+        return setting
+    from cook_tpu.ops.pallas_probe import resolve_use_pallas
+    return resolve_use_pallas(setting)
+
+
 def build_scheduler(config, read_only=False):
     """Assemble a full single-process scheduler from a Settings tree or
     raw config dict (the components.clj scheduler-server graph
@@ -269,7 +278,7 @@ def build_scheduler(config, read_only=False):
                 max_preemption=s.rebalancer_max_preemption,
                 candidate_cap=s.rebalancer_candidate_cap),
             sequential_match_threshold=s.sequential_match_threshold,
-            use_pallas=s.use_pallas),
+            use_pallas=_resolve_use_pallas(s.use_pallas)),
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,

@@ -191,3 +191,33 @@ def test_exact_scan_kernel_equals_xla_scan(seed):
     np.testing.assert_array_equal(
         np.asarray(hout[pallas_match.H_OCC0] > 0),
         np.asarray(c_ref[4][0]))
+
+
+def test_use_pallas_auto_resolution():
+    """use_pallas="auto" (r5 #8): booleans pass through, auto resolves
+    to False off-TPU (Mosaic-only lowering), junk is rejected — and
+    the config tree validates/builds with it."""
+    from cook_tpu.ops.pallas_probe import resolve_use_pallas
+
+    assert resolve_use_pallas(True) is True
+    assert resolve_use_pallas(False) is False
+    # CPU platform: no probe dispatches, straight to the XLA matcher
+    assert resolve_use_pallas("auto") is False
+    assert resolve_use_pallas("AUTO") is False
+    with pytest.raises(ValueError):
+        resolve_use_pallas("maybe")
+
+    from cook_tpu.config import ConfigError, Settings
+    s = Settings.from_dict({"scheduler": {"use_pallas": "auto"}})
+    s.validate()
+    with pytest.raises(ConfigError):
+        Settings.from_dict(
+            {"scheduler": {"use_pallas": "sometimes"}}).validate()
+
+    from cook_tpu.rest.server import build_scheduler
+    _store, coord, _api = build_scheduler(
+        {"scheduler": {"use_pallas": "auto", "resident_match": False}})
+    try:
+        assert coord.config.use_pallas is False
+    finally:
+        coord.stop()
