@@ -21,10 +21,11 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 
-def _measure_ms(fn, k1: int = 5, k2: int = 10, repeats: int = 3) -> float:
+def _measure_ms(fn, k1: int = 5, k2: int = 10, repeats: int = 5) -> float:
     """Marginal per-dispatch milliseconds of `fn` via the two-point
-    pipelined method; min over repeats (noise is one-sided on a
-    tunneled link)."""
+    pipelined method; median of CLAMPED samples — a single noise event
+    where t2 < t1 must not hand the win to whichever lowering it hit
+    (an unclamped min kept such a negative forever)."""
     def run(k):
         t0 = time.perf_counter()
         out = None
@@ -35,22 +36,32 @@ def _measure_ms(fn, k1: int = 5, k2: int = 10, repeats: int = 3) -> float:
         np.asarray(out.job_host[:1])
         return time.perf_counter() - t0
 
-    best = float("inf")
+    samples = []
     for _ in range(repeats):
         t1 = run(k1)
         t2 = run(k2)
-        best = min(best, (t2 - t1) / (k2 - k1) * 1e3)
-    return best
+        samples.append(max(t2 - t1, 0.0) / (k2 - k1) * 1e3)
+    return float(np.median(samples))
 
 
-def resolve_use_pallas(setting, num_jobs: int = 1024,
-                       num_hosts: int = 1024) -> bool:
+def resolve_use_pallas(setting, num_jobs: int = 8192,
+                       num_hosts: int = 10_240) -> bool:
     """Resolve the config value to the jit-static boolean.
 
     true/false pass through. "auto" probes: non-TPU platforms resolve
     to False (the kernel is a Mosaic lowering; interpret mode would
-    always lose), TPU platforms race the two lowerings on the
-    production dense-round shape and take the winner.
+    always lose), TPU platforms race the two lowerings and take the
+    winner. The default probe shape is the HEADLINE production
+    dense-round shape (8192 considerable x 10k hosts — the scale
+    bench.py measures and BASELINE.md targets), not a toy size: the
+    winner can differ by shape, so probing small would let a
+    1024x1024 result silently misdecide the real workload. The server
+    passes its configured considerable bucket for the jobs axis; the
+    HOSTS axis stays at the 10k default because the host universe is
+    not known at leader takeover (offers arrive after boot) — a
+    deployment far from 10k hosts that cares should pin use_pallas
+    explicitly from a bench.py pallas run at its own scale. The probe
+    costs two full compiles at the probed shape, once, at takeover.
     """
     if isinstance(setting, bool):
         return setting

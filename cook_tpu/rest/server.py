@@ -138,12 +138,20 @@ def apply_gc_discipline() -> None:
     gc.freeze()
 
 
-def _resolve_use_pallas(setting) -> bool:
+def _resolve_use_pallas(setting, max_jobs_considered=None) -> bool:
     """true/false pass through; "auto" races both matcher lowerings on
-    the actual device at boot and takes the winner (ops/pallas_probe)."""
+    the actual device at boot and takes the winner (ops/pallas_probe).
+    Only the JOBS axis is deployment-scaled (the configured
+    considerable bucket); the hosts axis uses the probe's 10k default
+    because the host universe is unknown until offers arrive — see
+    resolve_use_pallas's docstring for the trade-off."""
     if isinstance(setting, bool):
         return setting
     from cook_tpu.ops.pallas_probe import resolve_use_pallas
+    from cook_tpu.scheduler.tensorize import bucket
+    if max_jobs_considered:
+        return resolve_use_pallas(setting,
+                                  num_jobs=bucket(max_jobs_considered))
     return resolve_use_pallas(setting)
 
 
@@ -278,7 +286,8 @@ def build_scheduler(config, read_only=False):
                 max_preemption=s.rebalancer_max_preemption,
                 candidate_cap=s.rebalancer_candidate_cap),
             sequential_match_threshold=s.sequential_match_threshold,
-            use_pallas=_resolve_use_pallas(s.use_pallas)),
+            use_pallas=_resolve_use_pallas(s.use_pallas,
+                                           s.max_jobs_considered)),
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,

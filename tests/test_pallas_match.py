@@ -197,10 +197,18 @@ def test_use_pallas_auto_resolution():
     """use_pallas="auto" (r5 #8): booleans pass through, auto resolves
     to False off-TPU (Mosaic-only lowering), junk is rejected — and
     the config tree validates/builds with it."""
+    import jax
+
     from cook_tpu.ops.pallas_probe import resolve_use_pallas
 
     assert resolve_use_pallas(True) is True
     assert resolve_use_pallas(False) is False
+    # the auto assertions below hold only off-TPU (conftest forces the
+    # CPU platform); on a real TPU the probe runs and may legitimately
+    # pick the Pallas matcher — guard so a bare TPU invocation of this
+    # file skips instead of spending two production-shape compiles
+    if jax.devices()[0].platform == "tpu":
+        pytest.skip("auto-resolution probe is platform-dependent on TPU")
     # CPU platform: no probe dispatches, straight to the XLA matcher
     assert resolve_use_pallas("auto") is False
     assert resolve_use_pallas("AUTO") is False

@@ -262,6 +262,43 @@ def test_resident_async_consumer():
     coord.stop()
 
 
+def test_consume_trace_and_queue_wait_metrics():
+    """Per-consume phase records (coordinator.consume_trace) are the
+    raw material for the bench's MEASURED co-located histogram: every
+    consumed cycle appends one record whose phases sum ≈ its total,
+    keyed by the dispatch cycle number; async mode also publishes the
+    producer's queue-backpressure wait."""
+    store, cluster, coord = build(n_hosts=4)
+    coord.enable_resident()
+    store.create_jobs([mkjob() for _ in range(8)])
+    for _ in range(3):
+        coord.match_cycle()
+    trace = list(coord.consume_trace)
+    assert len(trace) == 3
+    assert [r["cycle"] for r in trace] == [0, 1, 2]
+    for r in trace:
+        assert r["pool"] == "default"
+        for k in ("total_ms", "readback_ms", "loop_ms", "txn_ms",
+                  "backend_ms"):
+            assert r[k] >= 0.0, (k, r)
+        phase_sum = (r["readback_ms"] + r["loop_ms"] + r["txn_ms"]
+                     + r["backend_ms"])
+        assert phase_sum <= r["total_ms"] + 1.0, r
+    assert trace[0]["matched"] == 8
+
+    # async mode: the producer's put on the depth-2 consume queue is
+    # timed — the bench subtracts it as consumer backpressure
+    store2, cluster2, coord2 = build(n_hosts=4)
+    coord2.enable_resident(synchronous=False)
+    store2.create_jobs([mkjob() for _ in range(4)])
+    coord2.match_cycle()
+    assert coord2.metrics["match.default.queue_wait_ms"] >= 0.0
+    coord2.drain_resident()
+    assert any(r["matched"] == 4 for r in coord2.consume_trace)
+    coord2.stop()
+    coord.stop()
+
+
 def test_resident_ports_assignment():
     hosts = [MockHost("h0", mem=1000, cpus=16, port_range=(31000, 31003))]
     store, cluster, coord = build(hosts=hosts)

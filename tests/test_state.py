@@ -597,6 +597,82 @@ def test_rotate_log_compaction_roundtrip(tmp_path):
     assert r2.get_instance(inst.task_id).status == InstanceStatus.RUNNING
 
 
+def test_rotate_log_carries_snapshot_overlapped_tail(tmp_path):
+    """rotate_log's snapshot runs OUTSIDE the exclusive window, so
+    transactions can commit while it serializes; they land in the OLD
+    segment past the snapshot position and the old segment is
+    discarded — the swap must carry exactly those lines into the fresh
+    segment or acked submissions vanish on restore."""
+    log = str(tmp_path / "log")
+    snap = str(tmp_path / "snap")
+    s = JobStore(log_path=log)
+    s.create_jobs([mkjob() for _ in range(20)])
+    mid: list[str] = []
+    orig = s.snapshot
+
+    def snapshot_then_append(path):
+        lines0 = orig(path)
+        jobs = [mkjob() for _ in range(5)]
+        s.create_jobs(jobs)         # past lines0, old segment only
+        mid.extend(j.uuid for j in jobs)
+        return lines0
+
+    s.snapshot = snapshot_then_append
+    try:
+        s.rotate_log(snap)
+    finally:
+        s.snapshot = orig
+    after = mkjob()
+    s.create_jobs([after])
+    s._log.close()
+
+    r = JobStore.restore(snap, log_path=log)
+    for u in mid:
+        assert u in r.jobs, "snapshot-overlapped txn lost by rotation"
+    assert after.uuid in r.jobs
+    assert set(r.jobs) == set(s.jobs)
+
+
+def test_rotate_log_under_concurrent_writers(tmp_path):
+    """Hammer: writer threads submit throughout repeated rotations;
+    every acked job must survive a restore from the final snapshot +
+    segment, and no rotation may deadlock against the chunked
+    snapshot's lock interleaving."""
+    import threading
+
+    log = str(tmp_path / "log")
+    snap = str(tmp_path / "snap")
+    s = JobStore(log_path=log)
+    acked: list[str] = []
+    acked_lock = threading.Lock()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            js = [mkjob() for _ in range(3)]
+            s.create_jobs(js)
+            with acked_lock:
+                acked.extend(j.uuid for j in js)
+
+    threads = [threading.Thread(target=writer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(5):
+            s.rotate_log(snap)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    s.snapshot(snap)   # the snapshot loop's next pass, deployment-shaped
+    s._log.close()
+
+    r = JobStore.restore(snap, log_path=log)
+    with acked_lock:
+        missing = [u for u in acked if u not in r.jobs]
+    assert not missing, f"{len(missing)} acked jobs lost across rotations"
+
+
 def test_snapshot_view_atomicity():
     """THE invariant snapshot_view owns (and reconcile_membership and
     the background rebuild rely on): every instance visible in the
