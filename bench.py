@@ -570,8 +570,10 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
 
     Deployment shape (VERDICT r4 weak #4): a background thread runs the
     production server's snapshot-loop policy — rotate the event log at
-    `log_rotate_lines` — so long runs never accumulate the multi-GB
-    segment whose fsyncs polluted the r4 longevity histogram.
+    `rotate_lines` (the bench's knob for the server's
+    `log_rotate_lines` setting, same 1M default) — so long runs never
+    accumulate the multi-GB segment whose fsyncs polluted the r4
+    longevity histogram.
 
     Co-located histogram (VERDICT r4 weak #2): each cycle is followed
     by a transfer-only RTT probe (a fresh tiny device computation +

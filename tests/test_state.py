@@ -597,40 +597,141 @@ def test_rotate_log_compaction_roundtrip(tmp_path):
     assert r2.get_instance(inst.task_id).status == InstanceStatus.RUNNING
 
 
-def test_rotate_log_carries_snapshot_overlapped_tail(tmp_path):
-    """rotate_log's snapshot runs OUTSIDE the exclusive window, so
-    transactions can commit while it serializes; they land in the OLD
-    segment past the snapshot position and the old segment is
-    discarded — the swap must carry exactly those lines into the fresh
-    segment or acked submissions vanish on restore."""
+def test_rotate_log_crash_before_checkpoint_replays_chain(tmp_path):
+    """Segment-chain crash window: a rotation that dies between the
+    segment swap and its covering checkpoint leaves the old segment
+    parked at .pre-<genesis>, a fresh new segment, and only a STALE
+    snapshot on disk. restore() must replay the chain - stale snapshot
+    + pre-segment (by offset) + new segment - or every transaction
+    between the stale snapshot and the swap is lost."""
+    import glob
+
     log = str(tmp_path / "log")
     snap = str(tmp_path / "snap")
     s = JobStore(log_path=log)
-    s.create_jobs([mkjob() for _ in range(20)])
-    mid: list[str] = []
+    early = [mkjob() for _ in range(5)]
+    s.create_jobs(early)
+    s.snapshot(snap)                     # stale-but-genesis-matching
+    mid = [mkjob() for _ in range(7)]    # in the old segment ONLY
+    s.create_jobs(mid)
+
     orig = s.snapshot
 
-    def snapshot_then_append(path):
-        lines0 = orig(path)
-        jobs = [mkjob() for _ in range(5)]
-        s.create_jobs(jobs)         # past lines0, old segment only
-        mid.extend(j.uuid for j in jobs)
-        return lines0
+    def boom(path):
+        raise RuntimeError("crash between swap and checkpoint")
 
-    s.snapshot = snapshot_then_append
+    s.snapshot = boom
+    with pytest.raises(RuntimeError):
+        s.rotate_log(snap)
+    s.snapshot = orig
+    # the swap itself completed: the store must still be writable and
+    # appending to the NEW segment
+    after = mkjob()
+    s.create_jobs([after])
+    s._log.close()
+    assert glob.glob(log + ".pre-*"), "pre-segment missing"
+
+    r = JobStore.restore(snap, log_path=log)
+    assert set(r.jobs) == set(s.jobs)
+    for j in early + mid + [after]:
+        assert j.uuid in r.jobs
+
+    # recovery completes on the next rotation: the sweep checkpoints
+    # the chain state and drops the pre-segment
+    s2 = JobStore.restore(snap, log_path=log)
+    s2.rotate_log(snap)
+    assert not glob.glob(log + ".pre-*")
+    s2.create_jobs([mkjob()])
+    s2._log.close()
+    r2 = JobStore.restore(snap, log_path=log)
+    assert set(r2.jobs) == set(s2.jobs)
+
+
+def test_rotate_log_checkpoint_covers_follower_window(tmp_path):
+    """While a rotation's checkpoint is still serializing, a follower
+    that resyncs sees: old snapshot + pre-segment + new segment - the
+    chain restore must give it the complete state (this is the live
+    window every rotation passes through, not just the crash case)."""
+    log = str(tmp_path / "log")
+    snap = str(tmp_path / "snap")
+    s = JobStore(log_path=log)
+    jobs = [mkjob() for _ in range(10)]
+    s.create_jobs(jobs)
+    s.snapshot(snap)
+    more = [mkjob() for _ in range(4)]
+    s.create_jobs(more)
+
+    seen_mid_rotation = {}
+    orig = s.snapshot
+
+    def snapshot_with_follower(path):
+        # a follower resyncs NOW: swap done, checkpoint not yet
+        f = JobStore.restore(snap, log_path=log, trim_tail=False,
+                             open_writer=False)
+        seen_mid_rotation.update({u: True for u in f.jobs})
+        return orig(path)
+
+    s.snapshot = snapshot_with_follower
     try:
         s.rotate_log(snap)
     finally:
         s.snapshot = orig
-    after = mkjob()
-    s.create_jobs([after])
-    s._log.close()
+    for j in jobs + more:
+        assert j.uuid in seen_mid_rotation, \
+            "follower lost state during the rotation checkpoint window"
 
-    r = JobStore.restore(snap, log_path=log)
-    for u in mid:
-        assert u in r.jobs, "snapshot-overlapped txn lost by rotation"
-    assert after.uuid in r.jobs
-    assert set(r.jobs) == set(s.jobs)
+
+def test_restore_retries_when_rotation_completes_mid_restore(tmp_path):
+    """TOCTOU chain window: a restore loads the (stale) snapshot, then
+    the leader's rotation completes — checkpoint replaced the snapshot
+    and unlinked the pre-segment — before the restore checks for it.
+    Replaying only the new segment over the stale base would drop the
+    old segment's tail; restore must notice the snapshot changed under
+    it and restart from the fresh one."""
+    log = str(tmp_path / "log")
+    snap = str(tmp_path / "snap")
+    s = JobStore(log_path=log)
+    early = [mkjob() for _ in range(5)]
+    s.create_jobs(early)
+    s.snapshot(snap)
+    mid = [mkjob() for _ in range(7)]   # old-segment tail past the snap
+    s.create_jobs(mid)
+
+    # freeze the stale snapshot bytes, complete a full rotation (which
+    # rewrites `snap` and sweeps the pre-segment), then simulate the
+    # unlucky restore by handing it the STALE bytes at a path whose
+    # re-read yields the FRESH content — exactly what a reader that
+    # json.load'ed before the os.replace sees.
+    import json as _json
+    import shutil
+    stale = str(tmp_path / "stale")
+    shutil.copy(snap, stale)
+    s.rotate_log(snap)
+    s._log.close()
+    import glob
+    assert not glob.glob(log + ".pre-*")
+
+    # interleaving harness: first load returns the stale document,
+    # every later read sees the fresh file (as os.replace guarantees)
+    real_load = _json.load
+    loads = {"n": 0}
+
+    def racy_load(f):
+        loads["n"] += 1
+        if loads["n"] == 1 and getattr(f, "name", "") == snap:
+            with open(stale) as sf:
+                return real_load(sf)
+        return real_load(f)
+
+    _json.load = racy_load
+    try:
+        r = JobStore.restore(snap, log_path=log, open_writer=False)
+    finally:
+        _json.load = real_load
+    assert set(r.jobs) == set(s.jobs), \
+        "restore dropped the old segment's tail in the TOCTOU window"
+    for j in early + mid:
+        assert j.uuid in r.jobs
 
 
 def test_rotate_log_under_concurrent_writers(tmp_path):
