@@ -645,7 +645,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
         # the production snapshot loop's rotation policy, on a thread
         # (rest/server.py snapshot_loop): the log never outgrows
         # rotate_lines, so no fsync ever pays for a multi-GB segment
-        rotations = []   # (cycle, ms)
+        rotations = []   # (start cycle, end cycle, ms)
         rot_stop = threading.Event()
         cycle_box = [0]
 
@@ -653,10 +653,14 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             while not rot_stop.wait(2.0):
                 try:
                     if store.log_lines() >= rotate_lines > 0:
+                        c0 = cycle_box[0]
                         t_r = time.perf_counter()
                         store.rotate_log(snap_path)
+                        # (start cycle, end cycle, ms): the span makes
+                        # worst-cycle txn/drain spikes attributable to
+                        # the concurrent checkpoint's disk/lock load
                         rotations.append(
-                            (cycle_box[0],
+                            (c0, cycle_box[0],
                              round((time.perf_counter() - t_r) * 1e3, 1)))
                 except Exception as e:
                     print(f"# rotation failed: {e!r}", file=sys.stderr)
@@ -788,6 +792,22 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
                             [r[k] for r in trace], 99)), 2)
                         for k in ("readback_ms", "loop_ms", "txn_ms",
                                   "backend_ms")},
+                    # per-cycle sum of the consumer's HOST phases only
+                    # (no readback term): the measured lower bound for
+                    # a co-located consume, where the async copy has
+                    # landed by consume time and readback ~ 0. The
+                    # colocated_* fields above are the conservative
+                    # upper bound (they keep readback minus the rtt
+                    # floor, which folds uncompensated tunnel spikes
+                    # in). Truth lives between the two; both measured.
+                    "consumer_host_phases_p99_ms": round(float(
+                        np.percentile([r["loop_ms"] + r["txn_ms"]
+                                       + r["backend_ms"]
+                                       for r in trace], 99)), 2),
+                    "consumer_host_phases_p50_ms": round(float(
+                        np.percentile([r["loop_ms"] + r["txn_ms"]
+                                       + r["backend_ms"]
+                                       for r in trace], 50)), 2),
                 }
             else:
                 colocated = producer_col
@@ -840,8 +860,9 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             **colocated_extra,
             "rotations": rotations,
             "rotation_note": "production snapshot-loop rotation at "
-                             f"{rotate_lines} lines (cycle, ms); "
-                             "exclusive window is O(tail)",
+                             f"{rotate_lines} lines (start cycle, end "
+                             "cycle, ms); exclusive window is O(ms) — "
+                             "the span is the background checkpoint",
             "p99_minus_rtt_ms": round(float(np.percentile(compute_wall, 99)), 2),
             "tunnel_rtt_ms": round(rtt_ms, 2),
             "tunnel_rtt_p99_ms": round(float(np.percentile(

@@ -687,27 +687,45 @@ class JobStore:
             items = list(self.jobs.items())
             groups = {u: asdict(g) for u, g in self.groups.items()}
             rcfg = dict(self.rebalancer_config)
-        jobs_ser: dict = {}
-        CHUNK = 2000
-        for lo in range(0, len(items), CHUNK):
-            with self._lock:
-                for u, j in items[lo:lo + CHUNK]:
-                    jobs_ser[u] = _job_dict(j)
-        data = {
-            "log_lines": lines0,
-            "log_genesis": genesis,
-            "jobs": jobs_ser,
-            "groups": groups,
-            "rebalancer_config": rcfg,
-        }
+        # chunk sizing is a lock-convoy trade-off measured on the e2e
+        # bench: every chunk boundary re-acquires self._lock behind
+        # live transactions (which hold it across their fsync), so 55
+        # small chunks at 110k jobs convoyed a background checkpoint to
+        # ~45 s under full-rate cycling. 8k-job chunks cut the acquires
+        # 4x while each hold stays ~30 ms — invisible next to a launch
+        # txn. The per-chunk fsync below spreads the 76 MB dirty-page
+        # flush so the event log's group-commit fdatasync never queues
+        # behind one giant ordered-journal commit.
+        CHUNK = 8000
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            # dumps + one write, NOT json.dump: dump() streams through
-            # the pure-Python iterencode (measured 4.0 s / 87M calls at
-            # 110k jobs); dumps() takes the C encoder (~6x faster) and
-            # matters doubly under GIL contention with a live cycle
-            # thread during rotation checkpoints
-            f.write(json.dumps(data))
+            # streamed per-chunk C-encoder writes, NOT one json.dump or
+            # one giant json.dumps: dump() goes through the pure-Python
+            # iterencode (measured 4.0 s / 87M calls at 110k jobs), and
+            # a single dumps() holds the GIL for its whole ~0.7 s run —
+            # observed as a phase spike INSIDE live match cycles during
+            # rotation checkpoints. Chunked dumps keeps the C encoder's
+            # speed with ~ms GIL holds, so a checkpoint never starves
+            # (or gets starved by) the cycle/consumer threads.
+            # Key order matters: log_lines/log_genesis lead so
+            # _read_snapshot_genesis can header-sniff the file.
+            f.write('{"log_lines": %d, "log_genesis": %s, "jobs": {'
+                    % (lines0, json.dumps(genesis)))
+            first = True
+            for lo in range(0, len(items), CHUNK):
+                with self._lock:
+                    part = {u: _job_dict(j)
+                            for u, j in items[lo:lo + CHUNK]}
+                blob = json.dumps(part)
+                if blob != "{}":
+                    if not first:
+                        f.write(",")
+                    f.write(blob[1:-1])
+                    first = False
+                    f.flush()
+                    os.fsync(f.fileno())   # spread the flush (see above)
+            f.write('}, "groups": %s, "rebalancer_config": %s}'
+                    % (json.dumps(groups), json.dumps(rcfg)))
             f.flush()
             # durable before visible: rotate_log DESTROYS the old log
             # segment on the strength of this snapshot, so it must hit
@@ -781,10 +799,17 @@ class JobStore:
                 _fsync_dir(d)
                 self._log = _make_log_writer(self._log_path, trim=False)
             except Exception:
-                # never leave the store wedged on a closed writer: the
-                # live log is whichever complete segment the rename
-                # left at log_path
-                self._log = _make_log_writer(self._log_path, trim=False)
+                # never leave the store on a silently-closed writer:
+                # reopen against whichever complete segment the rename
+                # left at log_path; if even the reopen fails, install
+                # the loud sentinel so every transaction errors
+                # explicitly instead of acking writes that will never
+                # reach disk
+                try:
+                    self._log = _make_log_writer(self._log_path,
+                                                 trim=False)
+                except Exception:
+                    self._log = _FailedLogWriter(self._log_path)
                 raise
             self._log_genesis = genesis
         # 2) checkpoint against the fresh incarnation (chunked lock;
@@ -804,7 +829,7 @@ class JobStore:
         in-memory state includes their events (boot-time restore
         replays the chain), so one snapshot covers them all."""
         import glob
-        pres = glob.glob(self._log_path + ".pre-*")
+        pres = glob.glob(glob.escape(self._log_path) + ".pre-*")
         if not pres:
             return
         self.snapshot(snapshot_path)
@@ -872,19 +897,31 @@ class JobStore:
                 # it, it was never acked.
                 pre = (f"{log_path}.pre-{log_genesis}"
                        if log_genesis else None)
+                pre_replayed = False
                 if pre and os.path.exists(pre):
-                    pre_off = (offset if snap_genesis
-                               == _read_log_genesis(pre) else 0)
-                    store._replay(pre, pre_off, allow_partial_tail=True)
-                elif path and _retries > 0 and \
+                    try:
+                        pre_off = (offset if snap_genesis
+                                   == _read_log_genesis(pre) else 0)
+                        store._replay(pre, pre_off,
+                                      allow_partial_tail=True)
+                        pre_replayed = True
+                    except FileNotFoundError:
+                        # the leader's rotation step 3 unlinked the
+                        # pre-segment between our exists() check and
+                        # the open — same completion race as the
+                        # snapshot TOCTOU below (any partially-applied
+                        # pre events are discarded with this store
+                        # object on the retry)
+                        pass
+                if not pre_replayed and path and _retries > 0 and \
                         _read_snapshot_genesis(path) != snap_genesis:
                     # TOCTOU: the rotation COMPLETED between our
                     # snapshot load (seconds at 100k jobs) and the pre
-                    # check — the pre-segment is gone because the
-                    # fresh checkpoint now covers it. Replaying only
-                    # the new segment over the STALE snapshot would
-                    # silently drop the old segment's tail; restart
-                    # from the fresh snapshot instead.
+                    # read — the pre-segment is gone because the fresh
+                    # checkpoint now covers it. Replaying only the new
+                    # segment over the STALE snapshot would silently
+                    # drop the old segment's tail; restart from the
+                    # fresh snapshot instead.
                     return cls.restore(path, log_path,
                                        trim_tail=trim_tail,
                                        open_writer=open_writer,
@@ -1288,6 +1325,32 @@ def _make_log_writer(path: str, trim: bool = True):
         return NativeLogWriter(path)
     except Exception:
         return _PyLogWriter(path)
+
+
+class _FailedLogWriter:
+    """Installed when a failed rotation cannot reopen ANY log writer:
+    a durable store must fail transactions loudly, not degrade into an
+    in-memory one (self._log = None would do exactly that). Process
+    restart recovers through the normal restore path."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def _die(self):
+        raise OSError(f"event log writer lost after a failed rotation "
+                      f"of {self._path}; restart to recover")
+
+    def append(self, line: str) -> None:
+        self._die()
+
+    def sync(self) -> None:
+        self._die()
+
+    def lines(self) -> int:
+        self._die()
+
+    def close(self) -> None:
+        pass
 
 
 class _PyLogWriter:
