@@ -966,8 +966,14 @@ class CookApi:
 
     def get_debug(self, req: Request) -> Response:
         """Health + live backend summary (components.clj:140-151 health
-        handler role): per-cluster host and tracked-task counts."""
+        handler role): per-cluster host and tracked-task counts, plus
+        percentiles over the coordinator's per-consume phase trace —
+        the same measured distribution the e2e bench publishes as the
+        co-located histogram, served live so an operator sees MEASURED
+        p50/p99 consume latency (and which phase owns the tail) instead
+        of phase-mean arithmetic."""
         clusters = {}
+        consume: dict = {}
         if self.coord is not None:
             for cluster in self.coord.clusters.all():
                 try:
@@ -981,8 +987,25 @@ class CookApi:
                 clusters[cluster.name] = {
                     "kind": type(cluster).__name__,
                     "hosts": hosts, "tasks": tasks}
+            trace = list(self.coord.consume_trace)
+            by_pool: dict[str, list] = {}
+            for r in trace:
+                by_pool.setdefault(r["pool"], []).append(r)
+            for pool, rows in by_pool.items():
+                stats = {"cycles": len(rows)}
+                for k in ("total_ms", "readback_ms", "loop_ms",
+                          "txn_ms", "backend_ms"):
+                    vals = sorted(r[k] for r in rows)
+                    n = len(vals)
+                    stats[k] = {
+                        "p50": round(vals[n // 2], 2),
+                        "p99": round(vals[min(n - 1,
+                                              (n * 99) // 100)], 2),
+                        "max": round(vals[-1], 2)}
+                consume[pool] = stats
         return Response(200, {"healthy": True, "version": VERSION,
-                              "clusters": clusters})
+                              "clusters": clusters,
+                              "consume_trace": consume})
 
     # -- data-locality debug endpoints (data_locality.clj debug REST,
     # rest/api.clj data-local routes) ----------------------------------

@@ -291,6 +291,27 @@ def test_progress_endpoint(stack):
     assert inst.progress == 50 and inst.progress_message == "halfway"
 
 
+def test_debug_serves_measured_consume_percentiles(stack):
+    """/debug exposes p50/p99/max over the coordinator's per-consume
+    phase trace — the live-production form of the bench's measured
+    co-located histogram (r5: consume_trace observability)."""
+    store, cluster, coord, api = stack
+    coord.enable_resident()
+    submit(api, n=6)
+    for _ in range(3):
+        coord.match_cycle()
+    resp = call(api, "GET", "/debug")
+    assert resp.status == 200
+    ct = resp.body["consume_trace"]
+    assert ct["default"]["cycles"] == 3
+    for k in ("total_ms", "readback_ms", "loop_ms", "txn_ms",
+              "backend_ms"):
+        st = ct["default"][k]
+        assert st["p50"] >= 0 and st["p99"] >= st["p50"] >= 0
+        assert st["max"] >= st["p99"]
+    coord.drain_resident()
+
+
 def test_stats_instances(stack):
     store, cluster, coord, api = stack
     submit(api, n=2)
