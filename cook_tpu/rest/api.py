@@ -999,8 +999,11 @@ class CookApi:
                     n = len(vals)
                     stats[k] = {
                         "p50": round(vals[n // 2], 2),
-                        "p99": round(vals[min(n - 1,
-                                              (n * 99) // 100)], 2),
+                        # nearest-rank p99: ceil(0.99 n) as a 1-based
+                        # rank ((n*99)//100 lands one rank high when n
+                        # is a multiple of 100 — p99 would read as max)
+                        "p99": round(vals[max(0, -(-n * 99 // 100) - 1)],
+                                     2),
                         "max": round(vals[-1], 2)}
                 consume[pool] = stats
         return Response(200, {"healthy": True, "version": VERSION,
