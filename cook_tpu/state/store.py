@@ -213,10 +213,42 @@ class JobStore:
         transaction: block until everything appended so far is
         fdatasync'd (the transactor ack the reference relies on before
         HTTP 201-ing a submission). The native writer group-commits;
-        the Python fallback fsyncs per transaction."""
-        if self._log is not None and hasattr(self._log, "sync") \
-                and not getattr(self, "_replaying", False):
-            self._log.sync()
+        the Python fallback fsyncs per transaction.
+
+        Runs OUTSIDE the store lock (r5): every public transaction
+        still calls it before RETURNING, so acks (HTTP 201, backend
+        launch hand-off) wait for durability exactly as before — but
+        concurrent committers now overlap their fsyncs into one group
+        commit instead of serializing the whole store on disk latency
+        (measured: the launch-txn p99 tail and the rotation-checkpoint
+        lock convoy both rode this). A read may observe a transaction
+        for the few ms before its fsync completes; the only store
+        listener is the in-process resident mirror, which dies with
+        the process, so no externally-visible effect can precede
+        durability. Rotation's segment swap keeps its own barrier
+        UNDER the lock and syncs the old segment before swapping, so
+        an in-flight committer whose barrier lands on the new writer
+        is still covered.
+
+        Writer-swap safety: every path that closes or replaces the
+        writer (rotate_log, reload_from, follow_log) syncs it UNDER
+        the store lock first, so a straggler whose captured handle
+        turns out closed knows its appends are already durable — a
+        sync failure is only re-raised when the handle is still the
+        live writer (checked under the lock, so a mid-swap window
+        resolves before the verdict)."""
+        if getattr(self, "_replaying", False):
+            return
+        w = self._log
+        if w is None or not hasattr(w, "sync"):
+            return
+        try:
+            w.sync()
+        except Exception:
+            with self._lock:
+                still_live = w is self._log
+            if still_live:
+                raise
 
     def add_listener(self, fn: Callable[[str, dict], None]) -> None:
         """tx-report-queue equivalent: fn(kind, data) after each commit."""
@@ -257,10 +289,11 @@ class JobStore:
                 self.jobs[job.uuid] = job
                 self._append("job", _job_event(job))
                 self._reindex(job)
-            self._barrier()
             for job in jobs:
                 self._emit("job", {"obj": job})
-            return [j.uuid for j in jobs]
+            out = [j.uuid for j in jobs]
+        self._barrier()
+        return out
 
     def commit_jobs(self, uuids: Iterable[str]) -> None:
         """Flip the commit latch (metatransaction commit)."""
@@ -274,9 +307,9 @@ class JobStore:
                     self._append("commit", {"job": u})
                     self._reindex(job)
                     flipped.append(job)
-            self._barrier()
             for job in flipped:
                 self._emit("commit", {"obj": job})
+        self._barrier()
 
     def set_rebalancer_config(self, cfg: dict, merge: bool = False) -> None:
         """Durably update the live rebalancer params (the Datomic-stored
@@ -289,7 +322,7 @@ class JobStore:
                 else dict(cfg)
             self.rebalancer_config = merged
             self._append("rebalancer_config", {"cfg": dict(merged)})
-            self._barrier()
+        self._barrier()
 
     def gc_uncommitted(self, older_than_ms: int) -> list[str]:
         """Drop uncommitted jobs older than the cutoff
@@ -303,10 +336,10 @@ class JobStore:
                 self._deindex(self.jobs[u])
                 del self.jobs[u]
                 self._append("gc", {"job": u})
-            self._barrier()
             for u in dead:
                 self._emit("gc", {"job": u})
-            return dead
+        self._barrier()
+        return dead
 
     def allowed_to_start(self, job_uuid: str) -> bool:
         """Guard evaluated inside the launch transaction
@@ -335,9 +368,9 @@ class JobStore:
             self._reindex(job)
             self._append("inst", {"job": job_uuid, "task": inst.task_id,
                                   "host": hostname, "backend": backend})
-            self._barrier()
             self._emit("inst", {"obj": job, "inst": inst})
-            return inst
+        self._barrier()
+        return inst
 
     def create_instances_bulk(self, items, origin=None) -> list:
         """Launch transaction for a whole match cycle in ONE store
@@ -377,10 +410,10 @@ class JobStore:
                     f'{{"t":{t_ms},"k":"insts","items":['
                     + ",".join(log_items)
                     + f']{self._epoch_suffix()}}}')
-            self._barrier()
             if created:
                 self._emit("insts", {"items": created, "origin": origin})
-            return out
+        self._barrier()
+        return out
 
     def update_instance(self, task_id: str, status: InstanceStatus,
                         reason_code: Optional[int] = None,
@@ -423,11 +456,11 @@ class JobStore:
             self._append("status", {"task": task_id, "s": status.value,
                                     "r": reason_code, "p": preempted,
                                     "e": exit_code})
-            self._barrier()
             self._emit("status", {"obj": job, "inst": inst, "was": was})
             if job.state == JobState.COMPLETED and was != JobState.COMPLETED:
                 self._emit("job-completed", {"job": job_uuid})
-            return job
+        self._barrier()
+        return job
 
     def update_instances_bulk(self, updates) -> int:
         """Batched status writeback: updates is [(task_id, status,
@@ -486,14 +519,14 @@ class JobStore:
                     f'"e":{int(exit_code) if exit_code is not None else "null"}'
                     f'{self._epoch_suffix()}}}')
                 applied.append((job, inst, was))
-            self._barrier()
             if applied:
                 self._emit("statuses", {"items": applied})
             for job, inst, was in applied:
                 if job.state == JobState.COMPLETED \
                         and was != JobState.COMPLETED:
                     self._emit("job-completed", {"job": job.uuid})
-            return len(applied)
+        self._barrier()
+        return len(applied)
 
     def update_progress(self, task_id: str, sequence: int, percent: int,
                         message: str) -> bool:
@@ -514,8 +547,8 @@ class JobStore:
                 inst.progress_message = message
             self._append("progress", {"task": task_id, "q": sequence,
                                       "pc": percent, "m": message})
-            self._barrier()
-            return True
+        self._barrier()
+        return True
 
     def retry_job(self, job_uuid: str, retries: int,
                   failed_only: bool = True) -> None:
@@ -532,8 +565,8 @@ class JobStore:
                 job.success = None
             self._reindex(job)
             self._append("retry", {"job": job_uuid, "n": retries})
-            self._barrier()
             self._emit("retry", {"obj": job})
+        self._barrier()
 
     def kill_job(self, job_uuid: str) -> list[str]:
         """Mark a job killed: complete it and return active task ids the
@@ -548,10 +581,10 @@ class JobStore:
             job.success = False
             self._reindex(job)
             self._append("kill", {"job": job_uuid})
-            self._barrier()
             self._emit("kill", {"obj": job, "to_kill": list(to_kill)})
             self._emit("job-completed", {"job": job_uuid})
-            return to_kill
+        self._barrier()
+        return to_kill
 
     # ------------------------------------------------------------------
     def _update_job_state(self, job: Job) -> None:
@@ -961,6 +994,17 @@ class JobStore:
         fresh = JobStore.restore(snapshot_path, log_path=self._log_path)
         with self._lock:
             old_log = self._log
+            # sync the outgoing writer UNDER the lock before swapping:
+            # a committer that appended to it and released the lock may
+            # still be on its way to _barrier — its handle will be
+            # closed, and the barrier's swapped-writer tolerance relies
+            # on the closer having made those appends durable first
+            if old_log is not None and hasattr(old_log, "sync"):
+                try:
+                    old_log.sync()
+                except Exception:
+                    log.warning("reload_from: outgoing writer sync "
+                                "failed", exc_info=True)
             self.jobs = fresh.jobs
             self.groups = fresh.groups
             self.task_to_job = fresh.task_to_job
@@ -1021,13 +1065,22 @@ class JobStore:
         seeked back to and retried next tick."""
         if not self._log_path:
             raise ValueError("follow_log needs a log_path")
-        # a follower must never append: drop any writer handle
-        if self._log is not None:
+        # a follower must never append: drop any writer handle. Sync
+        # it first UNDER the lock — an in-flight committer between its
+        # append and its (post-lock) barrier must find its lines
+        # already durable when its barrier sees the writer gone,
+        # otherwise its ack covers page-cache-only data.
+        with self._lock:
+            old = self._log
+            if old is not None:
+                if hasattr(old, "sync"):
+                    old.sync()
+                self._log = None
+        if old is not None:
             try:
-                self._log.close()
+                old.close()
             except Exception:
                 pass
-            self._log = None
         stop = threading.Event()
         state = {"applied": getattr(self, "_replayed_offset", 0),
                  "f": None,

@@ -296,6 +296,88 @@ def test_soak_rotation_with_follower_and_resident(tmp_path):
         stop()
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_soak_concurrent_rotation_races_cycles(seed, tmp_path):
+    """Segment-chain rotation (r5) runs on the production snapshot
+    loop's own THREAD while match cycles, kills and status writebacks
+    mutate the store — the race the between-cycles rotation soak above
+    cannot reach. Asserts the invariants live, mid-rotation follower
+    restores (the chain window), and exact restore equality at the
+    end."""
+    import threading
+
+    rng = np.random.default_rng(seed)
+    log = str(tmp_path / "log")
+    snap = str(tmp_path / "snap")
+    store = JobStore(log_path=log)
+    cluster = MockCluster(
+        [MockHost(f"h{i}", mem=400, cpus=12) for i in range(6)],
+        runtime_fn=lambda s: (float(rng.uniform(5, 30)), True, None),
+        bulk_status=True)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    coord.enable_resident()
+
+    rot_stop = threading.Event()
+    rot_errors: list = []
+    rotations = [0]
+
+    def rotate_loop():
+        while not rot_stop.wait(0.01):
+            try:
+                if store.log_lines() >= 40:
+                    store.rotate_log(snap)
+                    rotations[0] += 1
+                    # the chain window: a restore taken right here
+                    # (fresh segment, checkpoint just landed or still
+                    # racing the next txns) must never lose state
+                    r = JobStore.restore(snap, log_path=log,
+                                         trim_tail=False,
+                                         open_writer=False)
+                    missing = set(r.jobs) - set(store.jobs)
+                    assert not missing
+            except AssertionError as e:
+                rot_errors.append(e)
+            except Exception as e:      # pragma: no cover - surface it
+                rot_errors.append(e)
+
+    t = threading.Thread(target=rotate_loop, daemon=True)
+    t.start()
+    all_jobs = []
+    try:
+        for step in range(60):
+            batch = [Job(uuid=new_uuid(),
+                         user=f"u{int(rng.integers(4))}",
+                         command="true", mem=float(rng.integers(10, 60)),
+                         cpus=float(rng.integers(1, 4)), max_retries=2)
+                     for _ in range(int(rng.integers(1, 6)))]
+            store.create_jobs(batch)
+            all_jobs.extend(batch)
+            if rng.random() < 0.35 and all_jobs:
+                victim = all_jobs[int(rng.integers(len(all_jobs)))]
+                for tid in store.kill_job(victim.uuid):
+                    cluster.kill_task(tid)
+            coord.match_cycle()
+            cluster.advance(float(rng.uniform(5, 40)))
+            check_invariants(store, cluster)
+    finally:
+        rot_stop.set()
+        t.join(timeout=30)
+        coord.stop()
+    assert not rot_errors, rot_errors[:3]
+    assert rotations[0] >= 3, f"only {rotations[0]} rotations raced"
+
+    # exact end-state equality through the final snapshot + segment
+    store.snapshot(snap)
+    store._log.close()
+    r = JobStore.restore(snap, log_path=log, open_writer=False)
+    assert set(r.jobs) == set(store.jobs)
+    for u, j in store.jobs.items():
+        assert r.jobs[u].state == j.state, (u, j.state, r.jobs[u].state)
+        assert len(r.jobs[u].instances) == len(j.instances)
+
+
 @pytest.mark.parametrize("seed", [0, 1])
 def test_soak_resident_full_features(seed):
     """Chaos soak over the round-4 resident feature surface: a flaky

@@ -681,6 +681,38 @@ def test_rotate_log_checkpoint_covers_follower_window(tmp_path):
             "follower lost state during the rotation checkpoint window"
 
 
+def test_barrier_tolerates_swapped_writer_only(tmp_path):
+    """_barrier runs outside the store lock (r5), so a committer's
+    captured writer can be closed by a concurrent rotation/takeover.
+    Contract: sync failure on a writer that is NO LONGER the live one
+    is swallowed (its closer synced it under the lock first); failure
+    on the still-live writer must propagate — that is a real
+    durability failure."""
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    s.create_jobs([mkjob()])
+    real = s._log
+
+    class SwappedMidSync:
+        def sync(self):
+            s._log = real          # "rotation" completes mid-barrier
+            raise OSError("sync on closed writer")
+
+    s._log = SwappedMidSync()
+    s._barrier()                   # must not raise
+    assert s._log is real
+
+    class Dead:
+        def sync(self):
+            raise OSError("disk gone")
+
+    s._log = Dead()
+    with pytest.raises(OSError):
+        s._barrier()
+    s._log = real
+    s._log.close()
+
+
 def test_restore_retries_when_rotation_completes_mid_restore(tmp_path):
     """TOCTOU chain window: a restore loads the (stale) snapshot, then
     the leader's rotation completes — checkpoint replaced the snapshot
