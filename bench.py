@@ -554,6 +554,7 @@ def _drain_trace(coord, into: list) -> None:
 def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
               runtime_s=10.0, sequential_threshold=2048,
               async_consumer=False, rotate_lines=1_000_000,
+              retention_s=120.0,
               label="e2e coordinator @ 100k-pending x 10k-offers"):
     """END-TO-END production path: Coordinator.match_cycle itself — the
     durable store (100k pending + ~10k running), device-resident
@@ -568,12 +569,17 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
     including the consume (synchronous mode: dispatch + device + compact
     readback + bulk launch txn).
 
-    Deployment shape (VERDICT r4 weak #4): a background thread runs the
+    Deployment shape (VERDICT r4 weak #4): background threads run the
     production server's snapshot-loop policy — rotate the event log at
     `rotate_lines` (the bench's knob for the server's
-    `log_rotate_lines` setting, same 1M default) — so long runs never
-    accumulate the multi-GB segment whose fsyncs polluted the r4
-    longevity histogram.
+    `log_rotate_lines` setting, same 1M default) — and its retention
+    GC (`gc_completed` at `retention_s`; the server's
+    completed_retention_hours scaled to the bench's compressed
+    timescale, where a 2-hour run processes a reference-month of
+    jobs). Without retention, the first deployment-shaped longevity
+    run measured 34 GB RSS and 4.8 GB per-rotation checkpoints at ~7M
+    processed jobs — exactly the unbounded-history failure the
+    reference avoids by excising old Datomic history out-of-process.
 
     Co-located histogram (VERDICT r4 weak #2): each cycle is followed
     by a transfer-only RTT probe (a fresh tiny device computation +
@@ -667,6 +673,21 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
 
         rot_thread = threading.Thread(target=rotate_loop, daemon=True)
         rot_thread.start()
+
+        retired_total = [0]
+
+        def retention_loop():
+            while not rot_stop.wait(15.0):
+                try:
+                    if retention_s > 0:
+                        retired_total[0] += store.gc_completed(
+                            int(retention_s * 1e3))
+                except Exception as e:
+                    print(f"# retention gc failed: {e!r}",
+                          file=sys.stderr)
+
+        ret_thread = threading.Thread(target=retention_loop, daemon=True)
+        ret_thread.start()
 
         # transfer-only RTT probe: a fresh tiny device computation +
         # fetch — never cached host-side, so every call pays one real
@@ -863,6 +884,13 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
                              f"{rotate_lines} lines (start cycle, end "
                              "cycle, ms); exclusive window is O(ms) — "
                              "the span is the background checkpoint",
+            "retired_total": retired_total[0],
+            "retention_note": f"gc_completed at {retention_s}s "
+                              "retention (production "
+                              "completed_retention_hours scaled to "
+                              "the bench's compressed timescale); "
+                              "bounds store memory and checkpoint "
+                              "size",
             "p99_minus_rtt_ms": round(float(np.percentile(compute_wall, 99)), 2),
             "tunnel_rtt_ms": round(rtt_ms, 2),
             "tunnel_rtt_p99_ms": round(float(np.percentile(
@@ -893,8 +921,9 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
         try:
             rot_stop.set()
             rot_thread.join(timeout=30)
+            ret_thread.join(timeout=30)
         except NameError:
-            pass   # failed before the thread existed
+            pass   # failed before the threads existed
         coord.stop()
         for p in (log_path, snap_path):
             try:

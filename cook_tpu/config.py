@@ -175,6 +175,13 @@ class Settings:
     # (JobStore.rotate_log) instead of snapshotting alongside.
     snapshot_interval_s: float = 300.0
     log_rotate_lines: int = 1_000_000
+    # retention GC for completed jobs (leader-only; the role Datomic
+    # excision plays for the reference — without it completed jobs
+    # live forever in memory and in every checkpoint). 0 disables.
+    # Uncommitted-job GC is separate: the coordinator watchdog's
+    # uncommitted_gc_age_ms owns that.
+    completed_gc_interval_s: float = 300.0
+    completed_retention_hours: float = 72.0
     leader_lock_path: Optional[str] = None   # None = standalone leader
     # distributed HA via Kubernetes Lease objects (no shared FS): point
     # at an apiserver and every candidate races for the named lease

@@ -479,6 +479,33 @@ def main(argv=None) -> None:
 
             threading.Thread(target=snapshot_loop, daemon=True).start()
 
+        if settings.completed_gc_interval_s > 0 \
+                and settings.completed_retention_hours > 0:
+            # retention GC for COMPLETED jobs (the role Datomic
+            # excision plays for the reference, run out-of-process
+            # there): without it, store memory and checkpoint size
+            # grow with total jobs ever processed. Leader-only; writes
+            # are append-gate fenced. Uncommitted-job GC is NOT here —
+            # the coordinator watchdog already owns it
+            # (uncommitted_gc_age_ms, clear-uncommitted-jobs
+            # tools.clj:757); one knob, one mechanism.
+            def retention_loop():
+                while True:
+                    time.sleep(settings.completed_gc_interval_s)
+                    if not _still_leader():
+                        continue
+                    try:
+                        n = store.gc_completed(int(
+                            settings.completed_retention_hours
+                            * 3_600_000))
+                        if n:
+                            log.info("retention: retired %d completed "
+                                     "jobs", n)
+                    except Exception:
+                        log.exception("retention gc failed")
+
+            threading.Thread(target=retention_loop, daemon=True).start()
+
     if args.no_cycles:
         # API-only read replica (the reference's api-only config role,
         # minus live writes: the reference's api-only nodes share

@@ -193,6 +193,9 @@ class Job:
     instances: list[Instance] = field(default_factory=list)
     # user-facing success/failure of the terminal state
     success: Optional[bool] = None
+    # when the job reached COMPLETED (retention GC measures its window
+    # from here; kill-while-waiting leaves no instance end time)
+    end_time_ms: Optional[int] = None
     # why the job can't be scheduled right now (for /unscheduled_jobs)
     last_placement_failure: Optional[dict[str, Any]] = None
     datasets: list[dict[str, Any]] = field(default_factory=list)

@@ -341,6 +341,98 @@ class JobStore:
         self._barrier()
         return dead
 
+    def gc_completed(self, older_than_ms: int,
+                     limit: int = 200_000) -> int:
+        """Retention GC for COMPLETED jobs — the role the reference
+        delegates to the Datomic layer (deployments excise old
+        history out-of-process; in-repo Cook only clears uncommitted
+        jobs). This store is both the transactor and the database, so
+        it must own the retention role itself: without it, every
+        completed job lives forever in memory and in every checkpoint
+        — the deployment-shaped longevity bench measured 34 GB RSS and
+        4.8 GB snapshots after ~7M tasks (docs/benchmarks.md §Round 5
+        longevity).
+
+        Drops completed jobs whose last activity (latest instance end
+        time, else submit time) is older than the cutoff: removed from
+        memory, the live indexes, and task_to_job; their groups'
+        member lists are pruned (an emptied group retires with its
+        last job). One compact batch event per locked chunk (2k
+        retirees) keeps replay and followers identical. Queries for a
+        retired uuid
+        return not-found — the same observable behavior Datomic
+        excision gives the reference's API."""
+        cutoff = now_ms() - older_than_ms
+
+        def expired(j: Job) -> bool:
+            if j.state != JobState.COMPLETED:
+                return False
+            if any(i.active for i in j.instances):
+                # zombie window: a killed job whose backend kill is
+                # still queued — retiring it would drop the eventual
+                # terminal status on the floor (task_to_job gone)
+                return False
+            end = j.end_time_ms or 0
+            for inst in j.instances:
+                if inst.end_time_ms:
+                    end = max(end, inst.end_time_ms)
+            if end == 0:   # legacy records predating end_time_ms
+                end = j.submit_time_ms or 0
+            return end < cutoff
+
+        # Phase A: collect candidates from a pointer-copy of the job
+        # map — the O(all jobs) field scan runs with NO lock held (the
+        # first pass after enabling retention on a grown store walks
+        # millions of entries; holding the lock across it would be the
+        # exact stop-the-world convoy the r5 rotation redesign
+        # removed). Racy reads are fine: every candidate is
+        # re-validated under the lock before it is retired.
+        with self._lock:
+            self._check_writable()
+            items = list(self.jobs.items())
+        candidates = [u for u, j in items if expired(j)]
+        del items
+        # Phase B: retire in small locked chunks, re-validating each
+        # candidate (retry_job can reopen a completed job between the
+        # scan and its chunk; a reopened or re-activated job must not
+        # be retired).
+        retired_total = 0
+        CHUNK = 2000
+        cap = min(len(candidates), limit)
+        for lo in range(0, cap, CHUNK):
+            with self._lock:
+                self._check_writable()
+                chunk = [u for u in candidates[lo:min(lo + CHUNK, cap)]
+                         if (j := self.jobs.get(u)) is not None
+                         and expired(j)]
+                for u in chunk:
+                    self._retire_job(u)
+                if chunk:
+                    self._append("retire", {"jobs": chunk})
+                    self._emit("retire", {"jobs": chunk})
+            retired_total += len(chunk)
+        self._barrier()
+        return retired_total
+
+    def _retire_job(self, uuid: str) -> None:
+        """Remove one job and its references from live state (caller
+        holds the lock; shared by gc_completed and replay)."""
+        job = self.jobs.pop(uuid, None)
+        if job is None:
+            return
+        self._deindex(job)
+        for inst in job.instances:
+            self.task_to_job.pop(inst.task_id, None)
+        if job.group:
+            g = self.groups.get(job.group)
+            if g is not None:
+                try:
+                    g.jobs.remove(uuid)
+                except ValueError:
+                    pass
+                if not g.jobs:
+                    self.groups.pop(job.group, None)
+
     def allowed_to_start(self, job_uuid: str) -> bool:
         """Guard evaluated inside the launch transaction
         (:job/allowed-to-start? schema.clj:1170): job must exist, be
@@ -563,6 +655,7 @@ class JobStore:
                     and job.retries_remaining() > 0):
                 job.state = JobState.WAITING
                 job.success = None
+                job.end_time_ms = None
             self._reindex(job)
             self._append("retry", {"job": job_uuid, "n": retries})
             self._emit("retry", {"obj": job})
@@ -579,6 +672,8 @@ class JobStore:
             to_kill = [i.task_id for i in job.active_instances]
             job.state = JobState.COMPLETED
             job.success = False
+            if job.end_time_ms is None:
+                job.end_time_ms = now_ms()
             self._reindex(job)
             self._append("kill", {"job": job_uuid})
             self._emit("kill", {"obj": job, "to_kill": list(to_kill)})
@@ -598,10 +693,14 @@ class JobStore:
         if any(i.status == InstanceStatus.SUCCESS for i in job.instances):
             job.state = JobState.COMPLETED
             job.success = True
+            if job.end_time_ms is None:
+                job.end_time_ms = now_ms()
             return
         if job.retries_remaining() <= 0:
             job.state = JobState.COMPLETED
             job.success = False
+            if job.end_time_ms is None:
+                job.end_time_ms = now_ms()
             return
         job.state = JobState.WAITING
 
@@ -1205,6 +1304,15 @@ class JobStore:
                 for inst in job.instances:
                     self.task_to_job[inst.task_id] = job.uuid
                 self._reindex(job)
+                # group membership: create_jobs extends an EXISTING
+                # group's member list without logging a group event,
+                # so replay must reconstruct it from the job's group
+                # ref — otherwise a replica's member list diverges and
+                # retention retires a group the leader still holds
+                if job.group:
+                    g = self.groups.get(job.group)
+                    if g is not None and job.uuid not in g.jobs:
+                        g.jobs.append(job.uuid)
         elif k == "group":
             g = Group(**ev["group"])
             if g.uuid not in self.groups:
@@ -1218,6 +1326,9 @@ class JobStore:
             job = self.jobs.pop(ev["job"], None)
             if job is not None:
                 self._deindex(job)
+        elif k == "retire":
+            for u in ev.get("jobs", ()):
+                self._retire_job(u)
         elif k == "rebalancer_config":
             self.rebalancer_config = dict(ev.get("cfg", {}))
         elif k == "inst":
@@ -1243,10 +1354,26 @@ class JobStore:
                     self._update_job_state(job)
                     self._reindex(job)
         elif k == "status":
-            self.update_instance(ev["task"], InstanceStatus(ev["s"]),
+            st = InstanceStatus(ev["s"])
+            self.update_instance(ev["task"], st,
                                  reason_code=ev.get("r"),
                                  preempted=bool(ev.get("p")),
                                  exit_code=ev.get("e"))
+            # replay parity: completion clocks come from the event's
+            # original timestamp, not replay wall-clock — otherwise a
+            # restart refreshes the retention window and silently
+            # changes user-visible end times for every job completed
+            # since the last snapshot (same backfill as "kill" below)
+            if ev.get("t") and st in (InstanceStatus.SUCCESS,
+                                      InstanceStatus.FAILED):
+                ju = self.task_to_job.get(ev["task"])
+                job = self.jobs.get(ju) if ju else None
+                if job is not None:
+                    for i in job.instances:
+                        if i.task_id == ev["task"] and i.end_time_ms:
+                            i.end_time_ms = ev["t"]
+                    if job.end_time_ms is not None:
+                        job.end_time_ms = min(job.end_time_ms, ev["t"])
         elif k == "progress":
             self.update_progress(ev["task"], ev["q"], ev["pc"], ev.get("m", ""))
         elif k == "retry":
@@ -1254,6 +1381,10 @@ class JobStore:
                 self.retry_job(ev["job"], ev["n"])
         elif k == "kill":
             self.kill_job(ev["job"])
+            j = self.jobs.get(ev["job"])
+            if j is not None and j.state == JobState.COMPLETED \
+                    and ev.get("t"):
+                j.end_time_ms = ev["t"]
 
 
 def _job_event(job: Job) -> dict:

@@ -681,6 +681,108 @@ def test_rotate_log_checkpoint_covers_follower_window(tmp_path):
             "follower lost state during the rotation checkpoint window"
 
 
+def test_gc_completed_retention(tmp_path):
+    """Retention GC (r5): completed jobs beyond the window leave
+    memory, the indexes, task_to_job and their groups; replay and
+    restores retire them identically; active and recent jobs are
+    untouched."""
+    from cook_tpu.state.model import Group
+
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    g = Group(uuid=new_uuid(), name="g")
+    old_done = [mkjob(group=g.uuid) for _ in range(3)]
+    s.create_jobs(old_done, groups=[g])
+    fresh_done = [mkjob() for _ in range(2)]
+    s.create_jobs(fresh_done)
+    waiting = mkjob()
+    running = mkjob()
+    s.create_jobs([waiting, running])
+    tids = []
+    for j in old_done + fresh_done + [running]:
+        inst = s.create_instance(j.uuid, "h0", "mock")
+        tids.append(inst.task_id)
+        s.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    for j, tid in zip(old_done + fresh_done, tids):
+        s.update_instance(tid, InstanceStatus.SUCCESS)
+    # age the old batch: push their end times into the past
+    for j in old_done:
+        j.end_time_ms -= 3_600_000
+        for inst in j.instances:
+            inst.end_time_ms -= 3_600_000
+
+    # a long-WAITING job killed NOW must measure retention from the
+    # kill (end_time_ms), not its old submit time
+    killed_waiting = mkjob()
+    killed_waiting.submit_time_ms = 1   # ancient submit
+    s.create_jobs([killed_waiting])
+    s.kill_job(killed_waiting.uuid)
+    # a killed job whose backend kill never landed (active instance)
+    # must be SKIPPED: retiring it would orphan the terminal status
+    zombie = mkjob()
+    s.create_jobs([zombie])
+    zi = s.create_instance(zombie.uuid, "h0", "mock")
+    s.update_instance(zi.task_id, InstanceStatus.RUNNING)
+    s.kill_job(zombie.uuid)            # instance stays active (queued)
+    zombie.end_time_ms = 1             # age it; guard must still skip
+
+    n = s.gc_completed(older_than_ms=600_000)
+    assert n == 3
+    for j in old_done:
+        assert j.uuid not in s.jobs
+        assert all(i.task_id not in s.task_to_job for i in j.instances)
+    assert g.uuid not in s.groups, "emptied group must retire too"
+    for j in fresh_done + [waiting, running, killed_waiting, zombie]:
+        assert j.uuid in s.jobs
+    assert s.gc_completed(older_than_ms=600_000) == 0  # idempotent
+
+    # replay parity: a restore retires the same jobs, and completion
+    # clocks come from the events' original timestamps — NOT replay
+    # wall-clock, which would refresh the retention window and change
+    # user-visible end times on every restart
+    s._log.close()
+    r = JobStore.restore(log_path=log)
+    assert set(r.jobs) == set(s.jobs)
+    assert g.uuid not in r.groups
+    for j in fresh_done:
+        rj = r.jobs[j.uuid]
+        assert abs((rj.end_time_ms or 0) - (j.end_time_ms or 0)) < 5000, \
+            "replayed completion clock drifted from the leader's"
+
+
+def test_replay_reconstructs_group_membership(tmp_path):
+    """create_jobs extends an EXISTING group's member list without a
+    group event; replay must reconstruct membership from the job's
+    group ref, or a replica's retention retires a group the leader
+    still holds (r5 review finding)."""
+    from cook_tpu.state.model import Group
+
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    g = Group(uuid=new_uuid(), name="g")
+    a = mkjob(group=g.uuid)
+    s.create_jobs([a], groups=[g])
+    b = mkjob(group=g.uuid)
+    s.create_jobs([b])                  # joins existing group: no event
+    assert set(s.groups[g.uuid].jobs) == {a.uuid, b.uuid}
+
+    # complete + retire member a; group must survive (b still holds it)
+    ia = s.create_instance(a.uuid, "h0", "mock")
+    s.update_instance(ia.task_id, InstanceStatus.RUNNING)
+    s.update_instance(ia.task_id, InstanceStatus.SUCCESS)
+    for inst in a.instances:
+        inst.end_time_ms -= 3_600_000
+    a.end_time_ms = (a.end_time_ms or 1) - 3_600_000
+    assert s.gc_completed(older_than_ms=600_000) == 1
+    assert g.uuid in s.groups and s.groups[g.uuid].jobs == [b.uuid]
+
+    s._log.close()
+    r = JobStore.restore(log_path=log)
+    assert g.uuid in r.groups, \
+        "replica retired a group the leader still holds"
+    assert r.groups[g.uuid].jobs == [b.uuid]
+
+
 def test_barrier_tolerates_swapped_writer_only(tmp_path):
     """_barrier runs outside the store lock (r5), so a committer's
     captured writer can be closed by a concurrent rotation/takeover.
