@@ -229,12 +229,17 @@ class Coordinator:
         # (docs/benchmarks.md round 4 tail attribution). The refreeze
         # both pays the sweep at a chosen point AND caps every sweep —
         # controlled or organic (the 25% rule fires between refreezes
-        # too) — at one interval's churn: 60 s of 1k-launch/s churn
-        # sweeps in ~100-300 ms, inside the production cadence's idle
-        # window. Cyclic transients leaked per freeze are a few
-        # in-flight request frames; gc.collect() first reclaims any
-        # dead cycles, so only alive-at-freeze objects can ever leak.
-        self.gc_refreeze_interval_s = 60.0
+        # too) — at one interval's churn. Interval tuning (r5
+        # longevity, measured): each pause scales with the churn
+        # accumulated since the last refreeze — at max-rate 2k-jobs/s
+        # churn a 60 s interval produced 400-1350 ms pauses, the
+        # dominant p99 term of the 8400-cycle run; 30 s halves each
+        # pause (more pauses, but cycle-latency p99 tracks pause
+        # magnitude, not count). Cyclic transients leaked per freeze
+        # are a few in-flight request frames; gc.collect() first
+        # reclaims any dead cycles, so only alive-at-freeze objects
+        # can ever leak.
+        self.gc_refreeze_interval_s = 30.0
         self._next_refreeze = time.monotonic() + self.gc_refreeze_interval_s
         # hash-sharded in-order status executors
         # (async-in-order-processing scheduler.clj:1524-1546): backend
