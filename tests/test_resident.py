@@ -262,6 +262,30 @@ def test_resident_async_consumer():
     coord.stop()
 
 
+def test_retention_gc_between_resident_cycles():
+    """The retention loop (r5) retires completed jobs while the
+    resident path cycles: retire events are invisible to the mirrors
+    by design (completed jobs hold no resident rows), so the
+    delta-maintained device state must still equal a fresh rebuild
+    after retirement, and subsequent cycles must keep launching."""
+    store, cluster, coord = build(
+        n_hosts=4, runtime_fn=lambda s: (5.0, True, None))
+    coord.enable_resident()
+    for round_no in range(4):
+        store.create_jobs([mkjob() for _ in range(8)])
+        coord.match_cycle()
+        cluster.advance(10.0)       # everything completes
+        coord.match_cycle()         # absorb completions
+        # retire immediately: -1 keeps this off the same-millisecond
+        # edge of the strict end < cutoff comparison
+        n = store.gc_completed(older_than_ms=-1)
+        assert n > 0, f"round {round_no}: nothing retired"
+        assert_state_matches_rebuild(coord)
+    # the store is bounded: only the latest unretired churn remains
+    assert len(store.jobs) <= 16
+    coord.stop()
+
+
 def test_consume_trace_and_queue_wait_metrics():
     """Per-consume phase records (coordinator.consume_trace) are the
     raw material for the bench's MEASURED co-located histogram: every
