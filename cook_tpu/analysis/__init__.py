@@ -1,0 +1,28 @@
+"""cookcheck: repo-native static analysis for cook_tpu.
+
+Four rule families tuned to this codebase's two hard failure classes —
+silent host syncs inside jitted scheduling kernels, and unlocked
+shared-state races in the threaded control plane — plus async hygiene
+and REST/OpenAPI drift:
+
+  R1  trace-purity    host syncs / impurities inside functions reached
+                      from ``jax.jit`` in ``ops/`` and ``parallel/``
+  R2  lock-discipline unlocked reads/writes of lock-guarded ``self._*``
+                      state from thread-entry/callback methods in
+                      ``scheduler/`` and ``agent/``
+  R3  async-hygiene   blocking calls inside ``async def`` bodies
+  R4  rest-drift      route table (``rest/api.py``) vs the OpenAPI
+                      generator (``rest/openapi.py``)
+
+Run ``python -m cook_tpu.analysis --help`` for the CLI; see
+``docs/static-analysis.md`` for rule details, the per-line suppression
+syntax (``# cookcheck: disable=R2``) and the baseline workflow.
+
+The package is pure-stdlib AST analysis: it never imports jax, numpy,
+or any cook_tpu runtime module, so it runs anywhere Python runs.
+"""
+from cook_tpu.analysis.core import (ALL_RULES, Finding, analyze_paths,
+                                    analyze_source, load_baseline)
+
+__all__ = ["ALL_RULES", "Finding", "analyze_paths", "analyze_source",
+           "load_baseline"]

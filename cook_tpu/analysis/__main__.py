@@ -1,0 +1,100 @@
+"""cookcheck CLI.
+
+    python -m cook_tpu.analysis [paths...] [--strict] [--rules R1,R2]
+                                [--baseline FILE] [--write-baseline]
+                                [--json]
+
+With no paths, scans the cook_tpu package of the repo the module was
+imported from. Exit status: 0 when every finding is suppressed or
+baselined; 1 in --strict mode when non-baselined findings exist (this
+is the CI gate); 2 on usage errors.
+
+Stale baseline entries (violations that were fixed) are reported as a
+reminder to re-run --write-baseline so the baseline only ever shrinks.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from cook_tpu.analysis.core import (ALL_RULES, analyze_paths,
+                                    diff_baseline, load_baseline,
+                                    save_baseline)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cook_tpu.analysis",
+        description="cookcheck: trace-purity (R1), lock discipline (R2), "
+                    "async hygiene (R3), REST/OpenAPI drift (R4)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the cook_tpu "
+                         "package)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any finding is not in the baseline")
+    ap.add_argument("--rules", default=",".join(ALL_RULES),
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--baseline",
+                    default=os.path.join(_REPO_ROOT,
+                                         "analysis_baseline.json"),
+                    help="baseline file (default: analysis_baseline.json "
+                         "at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    rules = tuple(r.strip().upper() for r in args.rules.split(",")
+                  if r.strip())
+    bad = [r for r in rules if r not in ALL_RULES]
+    if bad:
+        ap.exit(2, f"unknown rule(s): {', '.join(bad)} "
+                   f"(have {', '.join(ALL_RULES)})\n")
+    paths = args.paths or [_PKG_ROOT]
+    findings = analyze_paths(paths, _REPO_ROOT, rules)
+
+    baseline = {} if (args.no_baseline or args.write_baseline) \
+        else load_baseline(args.baseline)
+    new, stale = diff_baseline(findings, baseline)
+
+    if args.write_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "new": [vars(f) for f in new],
+            "stale_baseline": stale,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        n_baselined = len(findings) - len(new)
+        summary = f"{len(new)} finding(s)"
+        if n_baselined:
+            summary += f", {n_baselined} baselined"
+        print(summary)
+        if stale:
+            print(f"note: {sum(stale.values())} baseline entr(ies) are "
+                  "stale (violations fixed) — re-run --write-baseline "
+                  "to shrink the baseline:", file=sys.stderr)
+            for fp, n in sorted(stale.items()):
+                print(f"  stale x{n}: {fp}", file=sys.stderr)
+
+    if args.strict and new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,250 @@
+"""cookcheck plumbing: findings, suppressions, baseline, file walking.
+
+A Finding's identity (``fingerprint``) deliberately omits the line
+number so the baseline survives unrelated edits above a finding; it is
+``rule|path|symbol|message``, counted — two identical violations in one
+function occupy two baseline slots, so fixing one of them shrinks the
+baseline instead of hiding behind the other.
+
+Per-line suppression: a ``# cookcheck: disable=R1,R2`` (or a bare
+``# cookcheck: disable`` for every rule) comment on the flagged line.
+Comments are read with :mod:`tokenize` so a ``# cookcheck`` inside a
+string literal never suppresses anything.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+ALL_RULES = ("R1", "R2", "R3", "R4")
+
+# which rule families run over which package subdirectories when
+# scanning a tree (explicit file arguments get every AST rule)
+RULE_DIRS = {
+    "R1": ("ops", "parallel"),
+    "R2": ("scheduler", "agent"),
+    "R3": ("rest", "backends", "scheduler", "integrations"),
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*cookcheck:\s*disable(?:=(?P<rules>[A-Za-z0-9,\s]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # "R1".."R4"
+    path: str          # repo-relative path
+    line: int
+    symbol: str        # enclosing Class.method / function ("" for R4)
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym}: {self.message}"
+
+
+@dataclass
+class ModuleInfo:
+    """Shared per-module context handed to every rule."""
+
+    tree: ast.Module
+    source: str
+    path: str                       # repo-relative
+    # import alias -> dotted module ("np" -> "numpy",
+    # "rq" -> "requests"); from-imports map name -> "module.name"
+    aliases: dict = field(default_factory=dict)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with import aliases
+        applied, e.g. ``np.sum`` -> ``numpy.sum``; None for anything
+        that isn't a plain dotted chain."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.aliases.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+
+def _collect_aliases(tree: ast.Module) -> dict:
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def collect_suppressions(source: str) -> dict[int, Optional[frozenset]]:
+    """line -> suppressed rule set (None = every rule)."""
+    out: dict[int, Optional[frozenset]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = m.group("rules")
+            out[tok.start[0]] = None if rules is None else frozenset(
+                r.strip().upper() for r in rules.split(",") if r.strip())
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def suppressed(finding: Finding,
+               suppressions: dict[int, Optional[frozenset]]) -> bool:
+    rules = suppressions.get(finding.line, frozenset())
+    if rules is None:       # bare "# cookcheck: disable"
+        return True
+    return finding.rule in rules
+
+
+# ----------------------------------------------------------------------
+# baseline
+
+def load_baseline(path: str) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    with open(path, "w") as fh:
+        json.dump({"version": 1,
+                   "findings": dict(sorted(counts.items()))}, fh, indent=1)
+        fh.write("\n")
+
+
+def diff_baseline(findings: list[Finding], baseline: dict[str, int]
+                  ) -> tuple[list[Finding], dict[str, int]]:
+    """(new findings not covered by the baseline, stale baseline
+    entries whose violations no longer exist)."""
+    counts: dict[str, int] = {}
+    new: list[Finding] = []
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+        if counts[f.fingerprint] > baseline.get(f.fingerprint, 0):
+            new.append(f)
+    stale = {fp: n - counts.get(fp, 0) for fp, n in baseline.items()
+             if counts.get(fp, 0) < n}
+    return new, stale
+
+
+# ----------------------------------------------------------------------
+# analysis drivers
+
+def analyze_source(source: str, path: str,
+                   rules: Iterable[str] = ("R1", "R2", "R3"),
+                   apply_suppressions: bool = True) -> list[Finding]:
+    """Run the per-module AST rules over one source text."""
+    from cook_tpu.analysis import (async_hygiene, lock_discipline,
+                                   trace_purity)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("R0", path, e.lineno or 0, "",
+                        f"syntax error: {e.msg}")]
+    mod = ModuleInfo(tree=tree, source=source, path=path,
+                     aliases=_collect_aliases(tree))
+    findings: list[Finding] = []
+    if "R1" in rules:
+        findings += trace_purity.check(mod)
+    if "R2" in rules:
+        findings += lock_discipline.check(mod)
+    if "R3" in rules:
+        findings += async_hygiene.check(mod)
+    if apply_suppressions:
+        sup = collect_suppressions(source)
+        findings = [f for f in findings if not suppressed(f, sup)]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _rules_for(relpath: str, selected: Iterable[str]) -> list[str]:
+    parts = relpath.replace(os.sep, "/").split("/")
+    out = []
+    for rule, dirs in RULE_DIRS.items():
+        if rule in selected and any(d in parts for d in dirs):
+            out.append(rule)
+    return out
+
+
+def iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def analyze_paths(paths: list[str], root: str,
+                  rules: Iterable[str] = ALL_RULES) -> list[Finding]:
+    """Analyze files/trees. `root` anchors repo-relative paths and the
+    R4 pair lookup. Directory scans scope rules by RULE_DIRS; files
+    named explicitly get every per-module rule."""
+    from cook_tpu.analysis import rest_drift
+    findings: list[Finding] = []
+    api_path = openapi_path = None
+    for path in paths:
+        explicit_file = os.path.isfile(path)
+        for fp in iter_py_files(path):
+            rel = os.path.relpath(fp, root)
+            if rel.replace(os.sep, "/").endswith("rest/api.py"):
+                api_path = fp
+            if rel.replace(os.sep, "/").endswith("rest/openapi.py"):
+                openapi_path = fp
+            # the analyzer does not analyze itself: its rule modules
+            # are full of violation-shaped pattern literals
+            if "cook_tpu/analysis" in rel.replace(os.sep, "/"):
+                continue
+            active = (list(r for r in rules if r != "R4")
+                      if explicit_file else _rules_for(rel, rules))
+            if not active:
+                continue
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+            findings += analyze_source(src, rel, active)
+    if "R4" in rules and api_path and openapi_path:
+        with open(api_path, encoding="utf-8") as f:
+            api_src = f.read()
+        with open(openapi_path, encoding="utf-8") as f:
+            openapi_src = f.read()
+        api_rel = os.path.relpath(api_path, root)
+        openapi_rel = os.path.relpath(openapi_path, root)
+        r4 = rest_drift.check_pair(api_src, api_rel,
+                                   openapi_src, openapi_rel)
+        sup_by_path = {api_rel: collect_suppressions(api_src),
+                       openapi_rel: collect_suppressions(openapi_src)}
+        findings += [f for f in r4
+                     if not suppressed(f, sup_by_path.get(f.path, {}))]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,188 @@
+"""R1: trace-purity inside jit-reachable functions.
+
+The scheduling kernels in ``ops/`` and ``parallel/`` are compiled with
+``jax.jit`` (directly, via ``functools.partial(jax.jit, ...)``
+decorators, or by a ``jax.jit(fn)`` call site). Host-side operations
+inside a traced function either force a silent device sync (``.item()``,
+``float()`` on a tracer), bake a host value into the compiled
+executable (``time.time()``, ``np.*`` on traced values), or mutate
+state the tracer cannot see (``global``, attribute assignment) — all of
+which corrupt results or retrace per cycle without any test failing.
+
+Reachability: a function is checked when it is a jit root, is called by
+name from a checked function, or is passed by name as an argument
+inside a checked function (``lax.scan(body, ...)``,
+``functools.partial(kernel, ...)`` both reach the callee).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from cook_tpu.analysis.core import Finding, ModuleInfo
+
+# host-clock / host-effect calls that freeze a value at trace time
+_HOST_CLOCKS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.perf_counter_ns", "time.time_ns", "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+# methods on arrays that force a device->host sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NUMPY_MODULES = {"numpy", "onp"}
+_CASTS = {"float", "int", "bool"}
+
+
+def _is_jit_expr(mod: ModuleInfo, node: ast.AST) -> bool:
+    """`jax.jit` / bare `jit` imported from jax, or
+    `functools.partial(jax.jit, ...)`."""
+    dotted = mod.resolve(node)
+    if dotted in ("jax.jit", "jax.pmap", "jax.experimental.pjit.pjit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = mod.resolve(node.func)
+        if fn in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(mod, node.args[0])
+    return False
+
+
+def _static_safe(node: ast.AST) -> bool:
+    """Expressions whose value is known at trace time — casting these
+    with int()/float() is the standard static-shape idiom, not a host
+    sync: literals, .shape/.ndim/.size chains, len(), arithmetic over
+    those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "size")
+    if isinstance(node, ast.Subscript):
+        return _static_safe(node.value)
+    if isinstance(node, ast.Call):
+        return isinstance(node.func, ast.Name) and node.func.id == "len"
+    if isinstance(node, ast.BinOp):
+        return _static_safe(node.left) and _static_safe(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _static_safe(node.operand)
+    return False
+
+
+def _function_defs(tree: ast.Module) -> dict[str, ast.AST]:
+    """Every (possibly nested) def in the module, by name. Later
+    definitions shadow earlier ones of the same name — fine for the
+    kernels, which keep module-unique names."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    return defs
+
+
+def _iter_body(fn: ast.AST):
+    """Walk a function body without descending into nested defs (those
+    are visited on their own when reachable)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _jit_roots(mod: ModuleInfo, defs: dict[str, ast.AST]) -> set[str]:
+    roots: set[str] = set()
+    for name, fn in defs.items():
+        for dec in getattr(fn, "decorator_list", []):
+            if _is_jit_expr(mod, dec):
+                roots.add(name)
+    # call-site jits: jax.jit(fn), jitted = jax.jit(run)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                mod.resolve(node.func) in ("jax.jit", "jax.pmap"):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    roots.add(arg.id)
+                elif isinstance(arg, ast.Call):  # jax.jit(partial(f, ...))
+                    inner = mod.resolve(arg.func)
+                    if inner in ("functools.partial", "partial") and \
+                            arg.args and isinstance(arg.args[0], ast.Name) \
+                            and arg.args[0].id in defs:
+                        roots.add(arg.args[0].id)
+    return roots
+
+
+def _reachable(mod: ModuleInfo, defs: dict[str, ast.AST],
+               roots: set[str]) -> set[str]:
+    seen = set()
+    work = list(roots)
+    while work:
+        name = work.pop()
+        if name in seen or name not in defs:
+            continue
+        seen.add(name)
+        for node in _iter_body(defs[name]):
+            if not isinstance(node, ast.Call):
+                continue
+            # f(...) where f is a local def
+            if isinstance(node.func, ast.Name) and node.func.id in defs:
+                work.append(node.func.id)
+            # lax.scan(body, ...), partial(kernel, ...): a local def
+            # passed by name is (or becomes) traced
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    work.append(arg.id)
+    return seen
+
+
+def _violation(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+            return (f"host sync: .{fn.attr}() forces a device->host "
+                    "transfer under trace")
+        dotted = mod.resolve(fn)
+        if dotted in _HOST_CLOCKS:
+            return (f"impure call {dotted}() is frozen at trace time "
+                    "(runs once per compile, not per cycle)")
+        if dotted == "print" or (isinstance(fn, ast.Name)
+                                 and fn.id == "print"):
+            return ("print() inside jit traces once and prints tracers; "
+                    "use jax.debug.print")
+        if dotted and "." in dotted and \
+                dotted.split(".")[0] in _NUMPY_MODULES:
+            return (f"host numpy call {dotted}() on traced values "
+                    "forces a sync / constant-folds at trace time; "
+                    "use jnp")
+        if isinstance(fn, ast.Name) and fn.id in _CASTS and node.args:
+            if not _static_safe(node.args[0]):
+                return (f"{fn.id}() on a possibly-traced value is a "
+                        "host sync (ConcretizationTypeError or silent "
+                        "device_get)")
+    elif isinstance(node, ast.Global):
+        return f"global statement ({', '.join(node.names)}) inside " \
+               "jit-reachable code"
+    elif isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute):
+                return (f"attribute mutation `{ast.unparse(t)} = ...` "
+                        "inside jit-reachable code is invisible to the "
+                        "tracer after the first compile")
+    return None
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    defs = _function_defs(mod.tree)
+    roots = _jit_roots(mod, defs)
+    reachable = _reachable(mod, defs, roots)
+    findings: list[Finding] = []
+    for name in sorted(reachable):
+        fn = defs[name]
+        for node in _iter_body(fn):
+            msg = _violation(mod, node)
+            if msg is not None:
+                findings.append(Finding(
+                    "R1", mod.path, getattr(node, "lineno", fn.lineno),
+                    name, msg))
+    return findings

@@ -14,3 +14,12 @@ Two axes of scale, mirroring the reference's two scaling mechanisms
                     axis and run a distributed sequential greedy with a
                     per-step pmax/pmin argmax reduction.
 """
+import jax as _jax
+
+# jax promoted shard_map out of experimental in 0.4.x-late; support both
+# locations so the pinned toolchain (0.4.37: experimental only) and newer
+# jax both work.
+if hasattr(_jax, "shard_map"):
+    shard_map = _jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map  # noqa: F401

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cook_tpu.parallel import shard_map
 from cook_tpu.ops import cycle as cycle_ops
 
 SLICE_AXIS = "slice"
@@ -100,7 +101,7 @@ def federated_cycle(mesh: Mesh, num_considerable: int = 1024,
         return kernel(*args)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=P(SLICE_AXIS, POOL_AXIS),
         out_specs=(P(SLICE_AXIS, POOL_AXIS), P()))
     def shard_fn(args):

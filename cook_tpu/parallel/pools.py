@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cook_tpu.parallel import shard_map
 from cook_tpu.ops import cycle as cycle_ops
 from cook_tpu.ops import match as match_ops
 
@@ -76,7 +77,7 @@ def pool_sharded_cycle(mesh: Mesh, num_considerable: int = 1024,
             hosts, forbidden, q_mem, q_cpus, q_cnt)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=P(POOL_AXIS), out_specs=(P(POOL_AXIS), P()))
     def shard_fn(args):
         res = jax.vmap(per_pool)(args)

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cook_tpu.parallel import shard_map
 from cook_tpu.ops import match as match_ops
 
 HOST_AXIS = "hosts"
@@ -58,7 +59,7 @@ def sharded_match_scan(mesh: Mesh, num_groups: int = 1,
     bonus_spec = (P(None, HOST_AXIS),) if with_bonus else ()
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(HOST_AXIS), P(None, HOST_AXIS)) + bonus_spec,
         out_specs=(P(), P(HOST_AXIS), P(HOST_AXIS), P(HOST_AXIS),
                    P(HOST_AXIS)))

@@ -867,9 +867,13 @@ class Coordinator:
                 cur = self.store.get_instance(inst.task_id)
                 if cur is not None and not cur.active:
                     launch_q.put(("kill", inst.task_id, False))
-        # scaleback feedback (scheduler.clj:1002-1036)
+        # scaleback feedback (scheduler.clj:1002-1036). Racy by design:
+        # the consume thread writes this per-pool limit and the match
+        # thread reads it; the worst a stale read costs is one cycle of
+        # over/under-consideration, and a lock here would couple the
+        # two loops' cadences.
         if head_matched:
-            self._num_considerable[pool] = self.config.max_jobs_considered
+            self._num_considerable[pool] = self.config.max_jobs_considered  # cookcheck: disable=R2
         else:
             prev = self._num_considerable.get(
                 pool, self.config.max_jobs_considered)

@@ -89,6 +89,10 @@ class FileLeaderElector(LeaderElector):
         self.on_loss = on_loss or self._suicide
         self._fd: Optional[int] = None
         self._leader = False
+        # guards _leader/_fd: written by the campaign thread, read by
+        # is_leader() (request threads) and stop() (which can race the
+        # campaign's own _release when join times out)
+        self._state_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -114,8 +118,9 @@ class FileLeaderElector(LeaderElector):
                                          "pid": os.getpid(),
                                          "since": time.time()}).encode())
                 os.fsync(fd)
-                self._fd = fd
-                self._leader = True
+                with self._state_lock:
+                    self._fd = fd
+                    self._leader = True
                 log.info("acquired leadership (%s)", self.path)
                 try:
                     on_leadership()
@@ -140,17 +145,21 @@ class FileLeaderElector(LeaderElector):
         self._thread.start()
 
     def _release(self) -> None:
-        self._leader = False
-        if self._fd is not None:
+        # swap the fd out under the lock so a stop()/campaign release
+        # race can't double-close it; the syscalls run unlocked
+        with self._state_lock:
+            self._leader = False
+            fd, self._fd = self._fd, None
+        if fd is not None:
             try:
-                fcntl.flock(self._fd, fcntl.LOCK_UN)
-                os.close(self._fd)
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                os.close(fd)
             except OSError:
                 pass
-            self._fd = None
 
     def is_leader(self) -> bool:
-        return self._leader
+        with self._state_lock:
+            return self._leader
 
     def current_leader(self) -> Optional[str]:
         try:
@@ -211,6 +220,12 @@ class LeaseElector(LeaderElector):
         # to the pod-unique hostname for the same reason)
         self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
         self._leader = False
+        # guards _leader, _last_renewed and _observed: written by the
+        # campaign/renew thread, read by is_leader()/current_leader()
+        # on request threads (and _observed is also written from
+        # current_leader()'s cache-miss fallback). on_leadership/
+        # on_loss callbacks always run OUTSIDE this lock.
+        self._state_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # (holder_url, observed_at) cache fed by the campaign/renew
@@ -250,10 +265,12 @@ class LeaseElector(LeaderElector):
                                  headers=self._headers(), timeout=5.0)
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                self._observed = (None, time.time())
+                with self._state_lock:
+                    self._observed = (None, time.time())
                 return None
             raise
-        self._observed = (self._holder_url_of(lease), time.time())
+        with self._state_lock:
+            self._observed = (self._holder_url_of(lease), time.time())
         return lease
 
     def _holder_url_of(self, lease: Optional[dict]) -> Optional[str]:
@@ -295,7 +312,8 @@ class LeaseElector(LeaderElector):
                     self.base + self._path().rsplit("/", 1)[0],
                     self._lease_body(0, None),
                     headers=self._headers(), timeout=5.0)
-                self._observed = (self.url, time.time())
+                with self._state_lock:
+                    self._observed = (self.url, time.time())
                 self.epoch = 1
                 return True
             spec = lease.get("spec", {})
@@ -330,7 +348,8 @@ class LeaseElector(LeaderElector):
                     transitions,
                     lease.get("metadata", {}).get("resourceVersion")),
                 headers=self._headers(), timeout=5.0)
-            self._observed = (self.url, time.time())
+            with self._state_lock:
+                self._observed = (self.url, time.time())
             self.epoch = transitions + 1
             return True
         except urllib.error.HTTPError as e:
@@ -354,7 +373,8 @@ class LeaseElector(LeaderElector):
                     int(lease["spec"].get("leaseTransitions", 0)),
                     lease.get("metadata", {}).get("resourceVersion")),
                 headers=self._headers(), timeout=5.0)
-            self._observed = (self.url, time.time())
+            with self._state_lock:
+                self._observed = (self.url, time.time())
             return True
         except urllib.error.HTTPError as e:
             if e.code in (404, 409):
@@ -374,8 +394,9 @@ class LeaseElector(LeaderElector):
                 if not acquired:
                     self._stop.wait(self.retry_interval_s)
                     continue
-                self._leader = True
-                self._last_renewed = t0
+                with self._state_lock:
+                    self._leader = True
+                    self._last_renewed = t0
                 log.info("acquired leadership lease %s as %s",
                          self.name, self.identity)
                 # Run takeover work (store replay, backend init — can
@@ -402,27 +423,34 @@ class LeaseElector(LeaderElector):
                                  name="leader-init").start()
                 while not self._stop.wait(self.duration_s / 3.0):
                     if init_failed.is_set():
-                        self._leader = False
+                        with self._state_lock:
+                            self._leader = False
                         self.on_loss()
                         return
                     t0 = time.monotonic()   # pre-round-trip, like the
                     #                         lease's own renewTime stamp
                     try:
                         if self._renew():
-                            self._last_renewed = t0
+                            with self._state_lock:
+                                self._last_renewed = t0
                         else:
-                            self._leader = False
+                            with self._state_lock:
+                                self._leader = False
                             self.on_loss()
                             return
                     except Exception as e:
                         log.warning("lease renewal error: %s", e)
-                        if time.monotonic() - self._last_renewed \
-                                > self.duration_s:
-                            # can't prove we still hold it: step down
-                            self._leader = False
+                        with self._state_lock:
+                            stale = time.monotonic() - self._last_renewed \
+                                > self.duration_s
+                            if stale:
+                                # can't prove we still hold it: step down
+                                self._leader = False
+                        if stale:
                             self.on_loss()
                             return
-                self._leader = False
+                with self._state_lock:
+                    self._leader = False
                 return
         self._thread = threading.Thread(target=campaign, daemon=True)
         self._thread.start()
@@ -440,14 +468,17 @@ class LeaseElector(LeaderElector):
         never exceeds ~40% in a healthy process; a stalled/partitioned
         one closes its write gates here first and suicides at the full
         duration."""
-        return self._leader and \
-            (time.monotonic() - self._last_renewed) < self.duration_s * 0.8
+        with self._state_lock:
+            return self._leader and \
+                (time.monotonic() - self._last_renewed) \
+                < self.duration_s * 0.8
 
     def current_leader(self) -> Optional[str]:
         # serve from the campaign/renew loop's observation when fresh
         # (/info calls this per request; a blocking apiserver GET per
         # request would hammer the apiserver and stall during outages)
-        holder, seen = self._observed
+        with self._state_lock:
+            holder, seen = self._observed
         if time.time() - seen <= self.duration_s / 3.0:
             return holder
         try:
@@ -476,10 +507,12 @@ class LeaseElector(LeaderElector):
             pass                     # successor falls back to the TTL
 
     def stop(self) -> None:
-        was_leader = self._leader
+        with self._state_lock:
+            was_leader = self._leader
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=3)
-        self._leader = False
+        with self._state_lock:
+            self._leader = False
         if was_leader:
             self._release_lease()

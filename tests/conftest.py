@@ -14,14 +14,19 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-# Persistent compilation cache: kernel compiles dominate test wall-time on
-# the CPU backend; cache them across pytest runs.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+# The persistent compilation cache is DISABLED for the test suite: the
+# serialized _device_cycle executable (scheduler/resident.py) segfaults
+# at first dispatch when any later process deserializes it — even the
+# same jax/jaxlib with identical XLA flags (reproduced: run the resident
+# suite twice back-to-back with a fresh cache dir; the second run
+# crashes reading the entry the first one wrote). Every other kernel
+# round-trips fine, but one poisoned entry kills the whole suite, and
+# the in-process jit cache already dedupes compiles within a run.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_compilation_cache", False)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,450 @@
+"""cookcheck (cook_tpu.analysis) rule tests.
+
+Each rule family gets seeded-violation positives, clean negatives, and
+a suppression case, all on inline fixture snippets — the analyzer is
+pure AST work, so nothing here imports jax or touches devices.
+"""
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from cook_tpu.analysis import analyze_paths, analyze_source
+from cook_tpu.analysis.core import diff_baseline, load_baseline, save_baseline
+from cook_tpu.analysis import rest_drift
+
+
+def run(src: str, rules=("R1", "R2", "R3"), path="mod.py"):
+    return analyze_source(textwrap.dedent(src), path, rules)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ----------------------------------------------------------------------
+# R1 trace purity
+
+def test_r1_item_in_jit_decorated_fn():
+    fs = run("""
+        import jax
+        @jax.jit
+        def kernel(x):
+            return x.item()
+    """, rules=("R1",))
+    assert rules_of(fs) == ["R1"]
+    assert "host sync" in fs[0].message
+    assert fs[0].symbol == "kernel"
+
+
+def test_r1_partial_jit_decorator_and_host_clock():
+    fs = run("""
+        import functools, time
+        import jax
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n):
+            t = time.time()
+            return x + t
+    """, rules=("R1",))
+    assert rules_of(fs) == ["R1"]
+    assert "frozen at trace time" in fs[0].message
+
+
+def test_r1_callsite_jit_and_numpy_alias():
+    fs = run("""
+        import jax
+        import numpy as np
+        def run(x):
+            return np.sum(x)
+        jitted = jax.jit(run)
+    """, rules=("R1",))
+    assert rules_of(fs) == ["R1"]
+    assert "use jnp" in fs[0].message
+
+
+def test_r1_reaches_scan_body_and_named_callee():
+    fs = run("""
+        import jax
+        from jax import lax
+        def body(carry, x):
+            print(x)
+            return carry, x
+        def helper(x):
+            return float(x)
+        @jax.jit
+        def kernel(xs):
+            c, ys = lax.scan(body, 0, xs)
+            return helper(ys)
+    """, rules=("R1",))
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 2
+    assert any("jax.debug.print" in m for m in msgs)
+    assert any("host sync" in m for m in msgs)
+
+
+def test_r1_static_shape_cast_is_clean():
+    fs = run("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def kernel(x):
+            n = int(x.shape[0])
+            m = float(len(x.shape) + 1)
+            return jnp.zeros((n,)) + m
+    """, rules=("R1",))
+    assert fs == []
+
+
+def test_r1_unjitted_function_not_checked():
+    fs = run("""
+        import time
+        def host_side(x):
+            return time.time() + x.item()
+    """, rules=("R1",))
+    assert fs == []
+
+
+def test_r1_suppression():
+    fs = run("""
+        import jax
+        @jax.jit
+        def kernel(x):
+            return x.item()  # cookcheck: disable=R1
+    """, rules=("R1",))
+    assert fs == []
+
+
+# ----------------------------------------------------------------------
+# R2 lock discipline
+
+def test_r2_guarded_attr_unlocked_read_in_loop():
+    fs = run("""
+        import threading
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+            def set(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+            def _poll_loop(self):
+                return len(self._state)
+    """, rules=("R2",))
+    assert rules_of(fs) == ["R2"]
+    assert "_state" in fs[0].message and "_lock" in fs[0].message
+    assert fs[0].symbol == "W._poll_loop"
+
+
+def test_r2_locked_access_is_clean():
+    fs = run("""
+        import threading
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+            def set(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+            def _poll_loop(self):
+                with self._lock:
+                    return len(self._state)
+    """, rules=("R2",))
+    assert fs == []
+
+
+def test_r2_locked_suffix_convention_exempt():
+    fs = run("""
+        import threading
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+            def set(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+            def _drain_locked(self):
+                return len(self._state)
+    """, rules=("R2",))
+    assert fs == []
+
+
+def test_r2_unguarded_shared_state_via_thread_target():
+    fs = run("""
+        import threading
+        class E:
+            def __init__(self):
+                self._leader = False
+            def start(self):
+                def campaign():
+                    self._leader = True
+                threading.Thread(target=campaign).start()
+            def is_leader(self):
+                return self._leader
+    """, rules=("R2",))
+    assert rules_of(fs) == ["R2"]
+    assert "no lock guarding it" in fs[0].message
+
+
+def test_r2_threadsafe_types_and_thread_confined_state_exempt():
+    fs = run("""
+        import queue, threading
+        class E:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._scratch = 0
+            def _consume_loop(self):
+                self._scratch += 1      # only this thread touches it
+                self._q.put(self._scratch)
+            def feed(self, item):
+                self._q.put(item)
+    """, rules=("R2",))
+    assert fs == []
+
+
+def test_r2_suppression():
+    fs = run("""
+        import threading
+        class E:
+            def __init__(self):
+                self._flag = False
+            def start(self):
+                def campaign():
+                    self._flag = True  # cookcheck: disable=R2
+                threading.Thread(target=campaign).start()
+            def done(self):
+                return self._flag
+    """, rules=("R2",))
+    assert fs == []
+
+
+# ----------------------------------------------------------------------
+# R3 async hygiene
+
+def test_r3_time_sleep_in_async_def():
+    fs = run("""
+        import time
+        async def poll():
+            time.sleep(1)
+    """, rules=("R3",))
+    assert rules_of(fs) == ["R3"]
+    assert "asyncio.sleep" in fs[0].message
+
+
+def test_r3_requests_with_import_alias():
+    fs = run("""
+        import requests as rq
+        async def fetch(url):
+            return rq.get(url)
+    """, rules=("R3",))
+    assert rules_of(fs) == ["R3"]
+    assert "requests" in fs[0].message
+
+
+def test_r3_asyncio_sleep_and_sync_def_are_clean():
+    fs = run("""
+        import asyncio, time
+        async def poll():
+            await asyncio.sleep(1)
+            def blocking_helper():      # shipped to an executor
+                time.sleep(1)
+            await asyncio.get_event_loop().run_in_executor(
+                None, blocking_helper)
+        def sync_ok():
+            time.sleep(1)
+    """, rules=("R3",))
+    assert fs == []
+
+
+def test_r3_suppression():
+    fs = run("""
+        import time
+        async def poll():
+            time.sleep(0.001)  # cookcheck: disable=R3
+    """, rules=("R3",))
+    assert fs == []
+
+
+# ----------------------------------------------------------------------
+# R4 REST/OpenAPI drift
+
+_API_TMPL = """
+class CookApi:
+    def _build_router(self):
+        r = Router()
+{routes}
+        return r
+
+{handlers}
+"""
+
+
+def r4(routes: str, handlers: str, openapi: str = "") -> list:
+    api_src = _API_TMPL.format(
+        routes=textwrap.indent(textwrap.dedent(routes), " " * 8),
+        handlers=textwrap.indent(textwrap.dedent(handlers), " " * 4))
+    return rest_drift.check_pair(api_src, "rest/api.py",
+                                 textwrap.dedent(openapi),
+                                 "rest/openapi.py")
+
+
+def test_r4_missing_handler_and_param_mismatch():
+    fs = r4(
+        """
+        r.add("GET", "/jobs/:uuid", self.read_job)
+        r.add("GET", "/nope", self.gone)
+        """,
+        """
+        def read_job(self, req, job_id):
+            pass
+        """)
+    msgs = " | ".join(f.message for f in fs)
+    assert "missing handler self.gone" in msgs
+    assert "['uuid']" in msgs          # pattern param the handler lacks
+    assert "['job_id']" in msgs        # handler param never captured
+
+
+def test_r4_duplicate_route():
+    fs = r4(
+        """
+        r.add("GET", "/jobs", self.read_jobs)
+        r.add("GET", "/jobs", self.read_jobs_v2)
+        """,
+        """
+        def read_jobs(self, req):
+            pass
+        def read_jobs_v2(self, req):
+            pass
+        """)
+    assert len(fs) == 1 and "duplicate route" in fs[0].message
+
+
+def test_r4_body_hint_drift():
+    fs = r4(
+        """
+        r.add("POST", "/jobs", self.create_jobs)
+        """,
+        """
+        def create_jobs(self, req):
+            pass
+        """,
+        openapi="""
+        _BODY_HINTS = {
+            ("POST", "/jobs"): "JobSubmission",
+            ("POST", "/retry"): "Ghost",
+        }
+        _SCHEMAS = {"JobSubmission": {"type": "object"}}
+        """)
+    msgs = " | ".join(f.message for f in fs)
+    assert "no matching route" in msgs
+    assert "'Ghost' is missing from _SCHEMAS" in msgs
+
+
+def test_r4_consistent_pair_is_clean():
+    fs = r4(
+        """
+        r.add("GET", "/jobs/:uuid", self.read_job)
+        r.add("POST", "/jobs", self.create_jobs)
+        """,
+        """
+        def read_job(self, req, uuid):
+            pass
+        def create_jobs(self, req, **kw):
+            pass
+        """,
+        openapi="""
+        _BODY_HINTS = {("POST", "/jobs"): "JobSubmission"}
+        _SCHEMAS = {"JobSubmission": {"type": "object"}}
+        """)
+    assert fs == []
+
+
+def test_r4_on_the_real_repo_is_baseline_clean():
+    """The live route table and spec generator must not drift."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fs = analyze_paths([os.path.join(root, "cook_tpu", "rest")],
+                       root, rules=("R4",))
+    assert fs == []
+
+
+# ----------------------------------------------------------------------
+# plumbing: baseline + CLI
+
+def test_baseline_counts_shrink_when_one_of_two_is_fixed(tmp_path):
+    src_two = """
+        import jax
+        @jax.jit
+        def kernel(x):
+            y = x.item()
+            return y + x.item()
+    """
+    src_one = """
+        import jax
+        @jax.jit
+        def kernel(x):
+            return x.item()
+    """
+    two = run(src_two, rules=("R1",))
+    assert len(two) == 2
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(str(bl_path), two)
+    baseline = load_baseline(str(bl_path))
+    # same two findings: fully baselined
+    new, stale = diff_baseline(two, baseline)
+    assert new == [] and stale == {}
+    # one fixed: nothing new, one stale slot to burn down
+    new, stale = diff_baseline(run(src_one, rules=("R1",)), baseline)
+    assert new == [] and sum(stale.values()) == 1
+    # a third identical violation would NOT hide behind the baseline
+    three = two + run(src_one, rules=("R1",))
+    new, _ = diff_baseline(three, baseline)
+    assert len(new) == 1
+
+
+def test_syntax_error_reports_r0():
+    fs = analyze_source("def broken(:\n", "bad.py")
+    assert rules_of(fs) == ["R0"]
+
+
+def test_cli_strict_and_write_baseline(tmp_path):
+    from cook_tpu.analysis.__main__ import main
+    mod = tmp_path / "kernels.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+        @jax.jit
+        def kernel(x):
+            return x.item()
+    """))
+    bl = tmp_path / "bl.json"
+    assert main([str(mod), "--strict", "--baseline", str(bl)]) == 1
+    assert main([str(mod), "--write-baseline", "--baseline", str(bl)]) == 0
+    data = json.loads(bl.read_text())
+    assert data["version"] == 1 and len(data["findings"]) == 1
+    # baselined now: strict passes
+    assert main([str(mod), "--strict", "--baseline", str(bl)]) == 0
+
+
+def test_repo_is_strict_clean():
+    """The CI gate: no non-baselined findings in the shipped tree."""
+    from cook_tpu.analysis.__main__ import main
+    assert main(["--strict"]) == 0
+
+
+def test_rule_scoping_by_directory(tmp_path):
+    # an R1 violation under scheduler/ must NOT fire during a tree scan
+    # (R1 only covers ops/ and parallel/), but the same file named
+    # explicitly gets every rule
+    pkg = tmp_path / "cook_tpu" / "scheduler"
+    pkg.mkdir(parents=True)
+    mod = pkg / "notops.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+        @jax.jit
+        def kernel(x):
+            return x.item()
+    """))
+    assert analyze_paths([str(tmp_path)], str(tmp_path)) == []
+    explicit = analyze_paths([str(mod)], str(tmp_path))
+    assert rules_of(explicit) == ["R1"]
