@@ -177,10 +177,15 @@ class Settings:
     log_rotate_lines: int = 1_000_000
     # retention GC for completed jobs (leader-only; the role Datomic
     # excision plays for the reference — without it completed jobs
-    # live forever in memory and in every checkpoint). 0 disables.
-    # Uncommitted-job GC is separate: the coordinator watchdog's
-    # uncommitted_gc_age_ms owns that.
-    completed_gc_interval_s: float = 300.0
+    # live forever in memory and in every checkpoint). OPT-IN: the
+    # default 0 disables it, because expiring completed jobs makes
+    # them 404 from the API — a user-visible divergence from the
+    # reference, where in-repo Cook only GCs uncommitted jobs and
+    # history excision is an explicit out-of-process deployment action
+    # (see PARITY.md). Deployments that need bounded store memory set
+    # an interval explicitly. Uncommitted-job GC is separate: the
+    # coordinator watchdog's uncommitted_gc_age_ms owns that.
+    completed_gc_interval_s: float = 0.0
     completed_retention_hours: float = 72.0
     leader_lock_path: Optional[str] = None   # None = standalone leader
     # distributed HA via Kubernetes Lease objects (no shared FS): point

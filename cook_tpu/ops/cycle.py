@@ -51,6 +51,13 @@ class CycleResult(NamedTuple):
     head_matched: jnp.ndarray    # () bool — queue-head considerable placed
     n_matched: jnp.ndarray       # () i32
     n_considerable: jnp.ndarray  # () i32
+    # compaction epilogue: the MATCHED slots packed to the front in
+    # queue order (-1 pad). A consumer reads n_matched first and then
+    # fetches only the prefix — 2 x n_matched i32 over the link instead
+    # of 2 x C (and instead of the (P,)-sized job_host vector), which
+    # is what bounds the sync readback on a PCIe/tunnel link.
+    mat_idx: jnp.ndarray         # (C,) pending-row index, matched prefix
+    mat_host: jnp.ndarray        # (C,) assigned host, matched prefix
 
 
 @functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
@@ -264,6 +271,16 @@ def rank_and_match(
     cons_idx = jnp.where(in_use, pend_idx, -1).astype(jnp.int32)
     matched_slot = in_use & (res.job_host >= 0)
     head_matched = ~in_use[0] | (res.job_host[0] >= 0)
+    # compaction epilogue: pack the matched slots to the front with the
+    # same cumsum-position scatter used for the considerable batch above
+    # (slots are queue-ordered and the cumsum is monotone, so the prefix
+    # stays in queue order — the launch loop's walk order is unchanged)
+    mat_pos = jnp.cumsum(matched_slot.astype(jnp.int32)) - 1
+    mslot = jnp.where(matched_slot, jnp.minimum(mat_pos, C), C)
+    mat_idx = jnp.full(C + 1, -1, jnp.int32).at[mslot].set(
+        cons_idx, mode="drop")[:C]
+    mat_host = jnp.full(C + 1, -1, jnp.int32).at[mslot].set(
+        res.job_host.astype(jnp.int32), mode="drop")[:C]
     return CycleResult(pending_dru=pending_dru, queue_rank=queue_rank,
                        considerable=considerable, job_host=job_host,
                        mem_left=res.mem_left, cpus_left=res.cpus_left,
@@ -271,4 +288,5 @@ def rank_and_match(
                        cons_idx=cons_idx, cons_host=res.job_host,
                        head_matched=head_matched,
                        n_matched=matched_slot.sum().astype(jnp.int32),
-                       n_considerable=in_use.sum().astype(jnp.int32))
+                       n_considerable=in_use.sum().astype(jnp.int32),
+                       mat_idx=mat_idx, mat_host=mat_host)

@@ -987,7 +987,10 @@ class CookApi:
                 clusters[cluster.name] = {
                     "kind": type(cluster).__name__,
                     "hosts": hosts, "tasks": tasks}
-            trace = list(self.coord.consume_trace)
+            # locked point-in-time copy: a bare list(deque) here races
+            # the consumer thread's appends ("deque mutated during
+            # iteration" -> intermittent /debug 500s under load)
+            trace = self.coord.consume_trace_snapshot()
             by_pool: dict[str, list] = {}
             for r in trace:
                 by_pool.setdefault(r["pool"], []).append(r)

@@ -462,18 +462,31 @@ def main(argv=None) -> None:
             # Leader-only — every write inside is append-gate fenced,
             # and followers absorb a rotation via their shrink-resync.
             def snapshot_loop():
+                # checkpoints ride the store's dedicated snapshot
+                # thread (snapshot_async / rotate_log(wait=False)):
+                # this loop only pays the O(ms) rotation swap, and the
+                # launch-txn group-commit path never queues behind the
+                # chunked snapshot flush. One ticket at a time — if the
+                # previous checkpoint is still in flight at the next
+                # tick, skip the tick rather than queue a pile-up.
+                ticket = None
                 while True:
                     time.sleep(settings.snapshot_interval_s)
                     if not _still_leader():
                         continue
+                    if ticket is not None and not ticket.done():
+                        continue
+                    ticket = None
                     try:
                         lines = store.log_lines()
                         if lines >= settings.log_rotate_lines > 0:
-                            store.rotate_log(settings.snapshot_path)
+                            ticket = store.rotate_log(
+                                settings.snapshot_path, wait=False)
                             log.info("rotated event log at %d lines",
                                      lines)
                         else:
-                            store.snapshot(settings.snapshot_path)
+                            ticket = store.snapshot_async(
+                                settings.snapshot_path)
                     except Exception:
                         log.exception("snapshot/rotate failed")
 

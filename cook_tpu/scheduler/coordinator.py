@@ -184,6 +184,12 @@ class Coordinator:
         import collections
         self.consume_trace: "collections.deque[dict]" = \
             collections.deque(maxlen=8192)
+        # guards whole-deque reads (consume_trace_snapshot) against the
+        # consumer thread's appends: iterating a deque while another
+        # thread appends raises "deque mutated during iteration".
+        # Single-element ops (append, popleft) are GIL-atomic and the
+        # bench's drain relies on that; only iteration needs the lock.
+        self._trace_lock = threading.Lock()
         self.progress_aggregator = progress_aggregator
         self.heartbeats = heartbeats
         self.plugins = plugins
@@ -241,6 +247,20 @@ class Coordinator:
         # can ever leak.
         self.gc_refreeze_interval_s = 30.0
         self._next_refreeze = time.monotonic() + self.gc_refreeze_interval_s
+        # budgeted incremental refreeze (the generational ladder in
+        # _maybe_refreeze): per-rung pause budget in ms. Young-gen
+        # passes (gen-0, and gen-1 when predicted to fit) run at every
+        # cadence tick; the FULL gen-2 pass — the 400-1350 ms pause the
+        # longevity p99 was dominated by — additionally waits for
+        # gc_full_refreeze_every ticks AND a predicted fit inside the
+        # budget-or-idle window. <= 0 restores the legacy unconditional
+        # full pass at every tick.
+        self.gc_refreeze_budget_ms = 50.0
+        self.gc_full_refreeze_every = 10
+        self._refreeze_since_full = 0
+        # EWMA pause predictions per rung, seeded pessimistically so
+        # the first gen-1/gen-2 passes wait for an idle window
+        self._refreeze_pred_ms = [1.0, 10.0, 0.0]
         # hash-sharded in-order status executors
         # (async-in-order-processing scheduler.clj:1524-1546): backend
         # callbacks enqueue and return instead of running the store
@@ -410,7 +430,11 @@ class Coordinator:
         """Switch `pool`'s match cycle to the device-resident path.
         synchronous=False decouples launch writeback onto a consumer
         thread (production/bench mode); True consumes inline
-        (deterministic, for tests and the simulator).
+        (deterministic, for tests and the simulator). With
+        synchronous=True, pipeline_depth=1 (forwarded to ResidentPool)
+        double-buffers on the cycle thread itself: consume of cycle N
+        overlaps the device's match of cycle N+1 with no extra thread
+        (see _match_cycle_resident's diagram).
 
         Full feature parity with the legacy cycle: data-locality
         bonuses ride as sparse resident rows, estimated-completion as a
@@ -526,12 +550,50 @@ class Coordinator:
         for p in pools:
             rp = self._resident.get(p)
             while rp is not None and rp._inflight:
-                time.sleep(0.001)
+                if rp.synchronous:
+                    # no consumer thread exists: a pipelined sync pool
+                    # parks up to pipeline_depth cycles here, so this
+                    # thread must consume them itself or spin forever
+                    cur = rp._inflight[0]
+                    try:
+                        self._consume_cycle(p, rp, cur)
+                    except Exception:
+                        log.exception("resident consume failed during "
+                                      "drain; scheduling full resync")
+                        rp.consumed_through = cur.cycle_no
+                        if rp._inflight and rp._inflight[0] is cur:
+                            rp._inflight.popleft()
+                        rp.request_resync()
+                else:
+                    time.sleep(0.001)
             q = getattr(rp, "_launch_q", None)
             if q is not None:
                 q.join()
 
     def _match_cycle_resident(self, pool: str, rp) -> MatchStats:
+        """One resident match cycle: resync-if-due, drain deltas, ship,
+        dispatch the device program, consume.
+
+        Pipelined dataflow (pipeline_depth=1, the double-buffer): each
+        wall-clock cycle overlaps cycle N's host-side consume/launch
+        with cycle N+1's device-side match —
+
+            cycle thread  | drain/ship | dispatch N+1 | consume N     |
+                          |            | (returns at  | (readback,    |
+                          |            |  enqueue)    |  txn, launch) |
+            device        | ---- match N+1 running ------------------>|
+            link          | <-- mat_* prefix of N riding async copy --|
+
+        dispatch() returns as soon as the device program is enqueued;
+        the consume of the PREVIOUS cycle then runs while the device
+        crunches the new one, and its readback hits arrays whose
+        device->host copy was started at dispatch time. Exactly-once
+        stays intact because matched rows were invalidated on device
+        inside cycle N itself (before N+1 ever ranks), and capacity is
+        chained device-side cycle to cycle.
+
+        pipeline_depth=0 is the classic serial cycle; async pools get
+        the same overlap from the depth-2 consume queue instead."""
         t0 = time.perf_counter()
         stats = MatchStats()
         self._purge_reservations()
@@ -656,17 +718,36 @@ class Coordinator:
         t_dispatch = time.perf_counter()
         stats.offers = len(rp.host_names)
         if rp.synchronous:
-            try:
-                c_stats = self._consume_cycle(pool, rp, out)
-            except Exception:
-                rp.consumed_through = out.cycle_no
-                if rp._inflight and rp._inflight[0] is out:
-                    rp._inflight.popleft()
-                rp.request_resync()
-                raise
-            stats.considerable = c_stats["considerable"]
-            stats.matched = c_stats["matched"]
-            stats.head_matched = c_stats["head_matched"]
+            # double-buffer handoff (pipeline_depth > 0): the cycle just
+            # dispatched keeps computing ON DEVICE while this thread
+            # consumes the oldest in-flight cycle's result — see the
+            # docstring diagram above. pipeline_depth == 0 degenerates
+            # to the classic inline consume (the loop runs once, on
+            # `out` itself).
+            c_stats = None
+            while len(rp._inflight) > rp.pipeline_depth:
+                cur = rp._inflight[0]
+                try:
+                    c_stats = self._consume_cycle(pool, rp, cur)
+                except Exception:
+                    rp.consumed_through = cur.cycle_no
+                    if rp._inflight and rp._inflight[0] is cur:
+                        rp._inflight.popleft()
+                    rp.request_resync()
+                    raise
+            if c_stats is not None:
+                stats.considerable = c_stats["considerable"]
+                stats.matched = c_stats["matched"]
+                stats.head_matched = c_stats["head_matched"]
+            else:
+                # pipelined warm-up: nothing consumed yet this cycle;
+                # report the previous consumed cycle's stats (same
+                # one-cycle lag the async path reports)
+                last = rp.stats_last
+                if last is not None:
+                    stats.considerable = last["considerable"]
+                    stats.matched = last["matched"]
+                    stats.head_matched = last["head_matched"]
         else:
             # backpressure at queue depth 2: the time spent blocked here
             # is the consumer lagging the producer — a co-located
@@ -699,11 +780,40 @@ class Coordinator:
         transaction, hand specs to the backends. Returns cycle stats."""
         import jax
         t_rb0 = time.perf_counter()
-        cons_idx, cons_host, head_matched, n_considerable = jax.device_get(
-            (out.cons_idx, out.cons_host, out.head_matched,
-             out.n_considerable))
+        # scalars first: 3 values tell us exactly how much else to pull
+        head_matched, n_matched, n_considerable = jax.device_get(
+            (out.head_matched, out.n_matched, out.n_considerable))
         head_matched = bool(head_matched)
+        n_matched = int(n_matched)
         n_considerable = int(n_considerable)
+        C = int(out.mat_idx.shape[0])
+        if n_matched == 0:
+            cons_idx = np.empty(0, np.int32)
+            cons_host = np.empty(0, np.int32)
+        elif rp.synchronous and rp.pipeline_depth == 0:
+            # inline mode: the device is quiescent, so slice the matched
+            # prefix ON DEVICE and pull 2 x n_matched i32 instead of
+            # 2 x C — this is what turns the P-then-C-sized sync
+            # readback into an O(matched) transfer on a tunneled link.
+            # The slice length is bucketed (power of two, via the same
+            # bucket() the batch sizing uses) so the executable cache
+            # sees O(log C) shapes, not one per matched count.
+            nb = min(bucket(n_matched), C)
+            cons_idx, cons_host = jax.device_get(
+                (jax.lax.slice(out.mat_idx, (0,), (nb,)),
+                 jax.lax.slice(out.mat_host, (0,), (nb,))))
+            cons_idx = np.asarray(cons_idx)[:n_matched]
+            cons_host = np.asarray(cons_host)[:n_matched]
+        else:
+            # pipelined/async: the next cycle's match is (or may be)
+            # in flight, and a fresh slice op would queue behind it —
+            # but dispatch() already started copy_to_host_async on the
+            # full mat_* arrays, so by now they have ridden the link
+            # concurrently with host work and this get is a local trim
+            cons_idx, cons_host = jax.device_get(
+                (out.mat_idx, out.mat_host))
+            cons_idx = np.asarray(cons_idx)[:n_matched]
+            cons_host = np.asarray(cons_host)[:n_matched]
         t_rb1 = time.perf_counter()
         self.metrics[f"match.{pool}.readback_ms"] = (t_rb1 - t_rb0) * 1e3
         items = []        # (uuid, hostname, cluster_name)
@@ -908,18 +1018,28 @@ class Coordinator:
         # iterate consume_trace — an append after the pop would race
         # them (deque mutated during iteration / missing final record)
         t_end = time.perf_counter()
-        self.consume_trace.append({
-            "pool": pool, "cycle": out.cycle_no, "matched": launched,
-            "total_ms": (t_end - t_rb0) * 1e3,
-            "readback_ms": (t_rb1 - t_rb0) * 1e3,
-            "loop_ms": (t_loop - t_rb1) * 1e3,
-            "txn_ms": self.metrics[f"match.{pool}.launch_txn_ms"],
-            "backend_ms": self.metrics[f"match.{pool}.backend_launch_ms"],
-        })
+        with self._trace_lock:
+            self.consume_trace.append({
+                "pool": pool, "cycle": out.cycle_no, "matched": launched,
+                "total_ms": (t_end - t_rb0) * 1e3,
+                "readback_ms": (t_rb1 - t_rb0) * 1e3,
+                "loop_ms": (t_loop - t_rb1) * 1e3,
+                "txn_ms": self.metrics[f"match.{pool}.launch_txn_ms"],
+                "backend_ms":
+                    self.metrics[f"match.{pool}.backend_launch_ms"],
+            })
         rp.consumed_through = out.cycle_no
         if rp._inflight and rp._inflight[0] is out:
             rp._inflight.popleft()
         return stats
+
+    def consume_trace_snapshot(self) -> list:
+        """Point-in-time copy of the per-consume phase trace, safe to
+        iterate while the consumer thread keeps appending (/debug and
+        any other whole-deque reader must use this — bare
+        list(consume_trace) races the appender)."""
+        with self._trace_lock:
+            return list(self.consume_trace)
 
     # ------------------------------------------------------------------
     # match cycle (scheduler.clj:848-1036)
@@ -928,7 +1048,7 @@ class Coordinator:
         rp = getattr(self, "_resident", {}).get(pool)
         if rp is not None and rp.enabled:
             stats = self._match_cycle_resident(pool, rp)
-            self._maybe_refreeze()
+            self._maybe_refreeze(stats.cycle_ms)
             return stats
         t0 = time.perf_counter()
         stats = MatchStats()
@@ -1185,13 +1305,28 @@ class Coordinator:
             stats.cycle_ms)
         metrics_registry.meter(f"match.{pool}.matched").mark(launched)
         metrics_registry.counter(f"match.{pool}.cycles").inc()
-        self._maybe_refreeze()
+        self._maybe_refreeze(stats.cycle_ms)
         return stats
 
-    def _maybe_refreeze(self) -> None:
-        """Controlled gen-2 placement (see __init__ comment): no-op
+    def _maybe_refreeze(self, cycle_ms: float = 0.0) -> None:
+        """Budgeted incremental refreeze (see __init__ comment): no-op
         unless the takeover freeze is active and the cadence elapsed;
-        runs BETWEEN cycles so the sweep never lands inside a phase."""
+        runs BETWEEN cycles so the sweep never lands inside a phase.
+
+        Generational ladder: rather than paying an unbounded full
+        collect at every tick, pick the deepest rung whose EWMA-
+        predicted pause fits the allowance (gc_refreeze_budget_ms plus
+        whatever idle headroom the match cadence leaves after the cycle
+        that just ran). gen-0 is always affordable; gen-1 when
+        predicted to fit; the FULL gen-2 pass additionally waits for
+        gc_full_refreeze_every ticks and is force-run at twice that so
+        it can never starve. Only the full rung re-freezes: freezing
+        after a young-gen collect would move dead-but-uncollected
+        older-generation cycles into the permanent generation — an
+        unbounded leak — so young rungs trade a longer organic-sweep
+        cap (bounded by the forced full-rung cadence) for bounded,
+        chosen pauses. gc_refreeze_budget_ms <= 0 restores the legacy
+        unconditional full pass."""
         now = time.monotonic()
         if now < self._next_refreeze:
             return
@@ -1199,13 +1334,42 @@ class Coordinator:
         import gc
         if gc.get_freeze_count() == 0:
             return   # GC discipline not active (tests, library use)
+        budget = self.gc_refreeze_budget_ms
         t_gc = time.perf_counter()
-        gc.collect()
-        gc.freeze()
-        self.metrics["gc.refreeze_ms"] = \
-            (time.perf_counter() - t_gc) * 1e3
-        metrics_registry.timer("gc.refreeze_ms").update(
-            self.metrics["gc.refreeze_ms"])
+        if budget <= 0:
+            gc.collect()
+            gc.freeze()
+            gen = 2
+            self._refreeze_since_full = 0
+            dur = (time.perf_counter() - t_gc) * 1e3
+        else:
+            idle_ms = max(
+                0.0, self.config.match_interval_s * 1e3 - cycle_ms)
+            allowance = budget + idle_ms
+            pred = self._refreeze_pred_ms
+            self._refreeze_since_full += 1
+            due = self._refreeze_since_full >= self.gc_full_refreeze_every
+            forced = self._refreeze_since_full >= \
+                2 * self.gc_full_refreeze_every
+            if due and (forced or pred[2] <= allowance):
+                gen = 2
+            elif pred[1] <= allowance:
+                gen = 1
+            else:
+                gen = 0
+            gc.collect(gen)
+            if gen == 2:
+                gc.freeze()
+                self._refreeze_since_full = 0
+            dur = (time.perf_counter() - t_gc) * 1e3
+            # EWMA per rung; alpha 0.5 tracks churn-rate shifts within
+            # a couple of ticks. gen-2 starts at 0 so the first due
+            # full pass runs once and calibrates the prediction.
+            pred[gen] = dur if pred[gen] <= 0 else \
+                0.5 * pred[gen] + 0.5 * dur
+        self.metrics["gc.refreeze_ms"] = dur
+        self.metrics["gc.refreeze_gen"] = gen
+        metrics_registry.timer("gc.refreeze_ms").update(dur)
 
     def _audit_head_window(self, jb, hosts, forbidden, job_host,
                            queue_rank, considerable,

@@ -265,7 +265,7 @@ def _device_cycle(state, deltas, qm, qc, qn, considerable_limit, now_s,
         want, jnp.where(matched, res.cons_host, H), num_segments=H + 1)[:H]
     new_state = {**state, "pend": pend, "host": host}
     out = (res.cons_idx, res.cons_host, res.head_matched, res.n_matched,
-           res.n_considerable)
+           res.n_considerable, res.mat_idx, res.mat_host)
     return new_state, out
 
 
@@ -298,6 +298,8 @@ class _CycleOut:
     head_matched: jnp.ndarray
     n_matched: jnp.ndarray
     n_considerable: jnp.ndarray
+    mat_idx: jnp.ndarray         # matched rows compacted to the prefix
+    mat_host: jnp.ndarray        # (queue order; -1 pad past n_matched)
     t_dispatch: float = 0.0
     row_uuid: Optional[list] = None   # not snapshotted; rows are stable
                                       # until consumed_through advances
@@ -320,6 +322,7 @@ class ResidentPool:
                  full_resync_every: int = 16,
                  locality_refresh_cycles: int = 16,
                  synchronous: bool = True,
+                 pipeline_depth: int = 0,
                  background_rebuild: Optional[bool] = None,
                  device=None, devices=None):
         self.coord = coordinator
@@ -335,6 +338,12 @@ class ResidentPool:
         self.full_resync_every = full_resync_every
         self._light_since_full = 0
         self.synchronous = synchronous
+        # double-buffered SYNC mode: dispatch cycle N+1 before consuming
+        # cycle N, leaving up to pipeline_depth cycles in flight on the
+        # cycle thread itself (no consumer thread). 0 = classic inline
+        # consume. Async pools ignore this — the depth-2 consume queue
+        # already provides the overlap.
+        self.pipeline_depth = pipeline_depth
         # per-pool device pinning: each pool's resident state may live
         # on its own chip (the per-pool parallel loops of SURVEY §2.5.1
         # — pools are independent scheduling problems; N pools across N
@@ -1373,17 +1382,21 @@ class ResidentPool:
             with_bonus=self.with_bonus, with_est=self.with_est,
             matcher=matcher)
         co = _CycleOut(self.cycle_no, *out, t_dispatch=time.perf_counter())
-        # ASYNC mode only: start the device->host copy of the compact
-        # outputs NOW, so by the time the consumer (one or two cycles
-        # later) blocks on them the transfer has already ridden the
-        # link concurrently with the next dispatch's host work — this
-        # empties the depth-2 consume queue's readback-RTT bound (r3
-        # weak #4, the e2e-async 2 s tail). In synchronous mode the
-        # consume follows immediately, so the extra enqueues would only
-        # add per-transfer latency on a tunneled link.
-        if not self.synchronous:
-            for arr in (co.cons_idx, co.cons_host, co.head_matched,
-                        co.n_matched, co.n_considerable):
+        # ASYNC and PIPELINED modes: start the device->host copy of the
+        # scalars and the matched prefix NOW, so by the time the
+        # consumer (one or two cycles later) blocks on them the
+        # transfer has already ridden the link concurrently with the
+        # next dispatch's host work — this empties the depth-2 consume
+        # queue's readback-RTT bound (r3 weak #4, the e2e-async 2 s
+        # tail). Only the compaction-epilogue outputs ride the link;
+        # the C-sized cons_* vectors are no longer read back at all.
+        # In pure inline mode the consume follows immediately, so the
+        # extra enqueues would only add per-transfer latency on a
+        # tunneled link — the consume path does a bucketed prefix
+        # slice instead (see coordinator._consume_cycle).
+        if not self.synchronous or self.pipeline_depth > 0:
+            for arr in (co.head_matched, co.n_matched, co.n_considerable,
+                        co.mat_idx, co.mat_host):
                 copy_async = getattr(arr, "copy_to_host_async", None)
                 if copy_async is not None:
                     try:

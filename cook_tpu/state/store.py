@@ -24,6 +24,7 @@ import contextlib
 import json
 import logging
 import os
+import queue
 import re
 import threading
 from dataclasses import asdict, dataclass
@@ -39,6 +40,60 @@ log = logging.getLogger(__name__)
 
 class TransactionError(Exception):
     """Illegal transition / constraint violation; transaction rejected."""
+
+
+_HAVE_SYNC_RANGE = hasattr(os, "sync_file_range")
+
+
+def _writeback_hint(fd: int) -> None:
+    """Start ASYNC writeback of the file's dirty pages without waiting.
+
+    The checkpoint writer calls this at every chunk boundary. A blocking
+    per-chunk fsync forces a full ordered-journal commit per chunk on
+    the SAME filesystem the event log lives on — every launch-txn
+    group-commit fdatasync that lands during the ~76 MB snapshot queues
+    behind those commits (the fsync-tail p99 miss). SYNC_FILE_RANGE_WRITE
+    only *initiates* writeback and returns immediately, so dirty pages
+    drain in the background, nothing parks in the journal between
+    chunks, and the final full fsync before the atomic rename (which IS
+    still required for durability) becomes a cheap catch-up instead of
+    a monolithic flush. Falls back to fsync where the syscall does not
+    exist (non-Linux); durability is unchanged either way — only the
+    final fsync is load-bearing.
+    """
+    if _HAVE_SYNC_RANGE:
+        try:
+            # offset 0 / nbytes 0 = "from start through end of file"
+            os.sync_file_range(fd, 0, 0, os.SYNC_FILE_RANGE_WRITE)
+            return
+        except OSError:
+            pass
+    os.fsync(fd)
+
+
+class SnapshotTicket:
+    """Completion handle for an off-critical-path checkpoint
+    (JobStore.snapshot_async / rotate_log(wait=False)). The snapshot
+    thread stores the recorded log position (or the raised exception)
+    and sets the event; callers that need the durability point wait on
+    it, everyone else just drops the ticket."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the checkpoint is durable; return the recorded
+        log position. Re-raises whatever the snapshot raised."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("snapshot still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
 
 
 class NotLeaderError(TransactionError):
@@ -109,6 +164,15 @@ class JobStore:
         self._log = log_writer
         if log_path and log_writer is None:
             self._log = _make_log_writer(log_path)
+        # dedicated checkpoint thread (lazy): snapshot_async and
+        # rotate_log(wait=False) hand the chunked serialization + flush
+        # to it, with its own fd, so the calling thread — and the
+        # group-commit fdatasync path — never waits on snapshot I/O.
+        # One thread, one queue: checkpoints are serialized in
+        # submission order, which also makes overlapping rotation
+        # continuations impossible.
+        self._snap_q: Optional[queue.Queue] = None
+        self._snap_thread: Optional[threading.Thread] = None
 
     def _reindex(self, job: Job) -> None:
         """Maintain the pending-by-pool index after any mutation that can
@@ -825,9 +889,11 @@ class JobStore:
         # small chunks at 110k jobs convoyed a background checkpoint to
         # ~45 s under full-rate cycling. 8k-job chunks cut the acquires
         # 4x while each hold stays ~30 ms — invisible next to a launch
-        # txn. The per-chunk fsync below spreads the 76 MB dirty-page
-        # flush so the event log's group-commit fdatasync never queues
-        # behind one giant ordered-journal commit.
+        # txn. The per-chunk writeback HINT below starts the 76 MB
+        # dirty-page flush early and asynchronously, so the event log's
+        # group-commit fdatasync neither queues behind one giant
+        # ordered-journal commit at the end nor behind a blocking
+        # per-chunk fsync in the middle (see _writeback_hint).
         CHUNK = 8000
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -855,7 +921,8 @@ class JobStore:
                     f.write(blob[1:-1])
                     first = False
                     f.flush()
-                    os.fsync(f.fileno())   # spread the flush (see above)
+                    _writeback_hint(f.fileno())  # spread the flush
+                                                 # without blocking
             f.write('}, "groups": %s, "rebalancer_config": %s}'
                     % (json.dumps(groups), json.dumps(rcfg)))
             f.flush()
@@ -870,7 +937,62 @@ class JobStore:
         _fsync_dir(os.path.dirname(os.path.abspath(path)))
         return lines0
 
-    def rotate_log(self, snapshot_path: str) -> None:
+    # -- off-critical-path checkpointing ------------------------------
+    def _ensure_snap_thread(self) -> None:
+        if self._snap_thread is not None and self._snap_thread.is_alive():
+            return
+        self._snap_q = queue.Queue()
+        self._snap_thread = threading.Thread(
+            target=self._snapshot_worker, daemon=True,
+            name="store-snapshot")
+        self._snap_thread.start()
+
+    def _snapshot_worker(self) -> None:
+        while True:
+            item = self._snap_q.get()
+            try:
+                if item is None:
+                    return
+                fn, ticket = item
+                try:
+                    ticket._result = fn()
+                except BaseException as e:     # delivered via wait()
+                    log.exception("background checkpoint failed")
+                    ticket._error = e
+                finally:
+                    ticket._event.set()
+            finally:
+                self._snap_q.task_done()
+
+    def snapshot_async(self, path: str) -> SnapshotTicket:
+        """Checkpoint on the dedicated snapshot thread and return a
+        SnapshotTicket immediately.
+
+        The serialization + flush runs with its own fd on the
+        "store-snapshot" thread, taking the SAME chunked-lock
+        consistent view snapshot() takes — write transactions
+        interleave with it and their group-commit fdatasyncs never
+        wait for snapshot I/O on the calling thread. Tickets run one
+        at a time in submission order (one worker), so back-to-back
+        calls cannot interleave chunk writes to the same path."""
+        self._ensure_snap_thread()
+        ticket = SnapshotTicket()
+        self._snap_q.put((lambda: self.snapshot(path), ticket))
+        return ticket
+
+    def drain_snapshots(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued background checkpoint has finished
+        (tests and orderly shutdown). Does not propagate their errors —
+        use the tickets for that."""
+        t = self._snap_thread
+        if t is None or not t.is_alive():
+            return
+        sentinel = SnapshotTicket()
+        self._snap_q.put((lambda: None, sentinel))
+        sentinel._event.wait(timeout)
+
+    def rotate_log(self, snapshot_path: str,
+                   wait: bool = True) -> Optional[SnapshotTicket]:
         """Compaction: park the current segment aside, restart the log
         from a fresh GENESIS line, then checkpoint — segment-chain
         order, so the only full-stop stall writers ever pay is the
@@ -896,7 +1018,14 @@ class JobStore:
            snapshot.
 
         Followers stay correct throughout: their genesis-change resync
-        restores through the same chain. Only the leader may rotate."""
+        restores through the same chain. Only the leader may rotate.
+
+        wait=False returns a SnapshotTicket right after step 1's O(ms)
+        exclusive swap; steps 2-3 (checkpoint + pre-segment unlink) run
+        on the dedicated snapshot thread. A crash before the background
+        checkpoint lands is exactly the step-1->2 crash window above —
+        the pre-segment survives and the next rotation (or restore)
+        covers it."""
         if not self._log_path:
             raise ValueError("rotate_log needs a log-backed store")
         with self._lock:
@@ -947,13 +1076,23 @@ class JobStore:
         # 2) checkpoint against the fresh incarnation (chunked lock;
         # write transactions interleave). Durable (file+dir fsync)
         # before step 3 destroys the pre-segment it covers.
-        self.snapshot(snapshot_path)
-        # 3) the pre-segment is covered; drop it
-        try:
-            os.unlink(pre_path)
-        except OSError:
-            pass
-        _fsync_dir(d)
+        def _finish() -> int:
+            lines0 = self.snapshot(snapshot_path)
+            # 3) the pre-segment is covered; drop it
+            try:
+                os.unlink(pre_path)
+            except OSError:
+                pass
+            _fsync_dir(d)
+            return lines0
+
+        if wait:
+            _finish()
+            return None
+        self._ensure_snap_thread()
+        ticket = SnapshotTicket()
+        self._snap_q.put((_finish, ticket))
+        return ticket
 
     def _sweep_pre_segments(self, snapshot_path: str) -> None:
         """Cover-and-delete any `.pre-*` segments left by a rotation
@@ -1355,6 +1494,20 @@ class JobStore:
                     self._reindex(job)
         elif k == "status":
             st = InstanceStatus(ev["s"])
+            # was-state capture BEFORE applying: the clock backfill
+            # below must only fire when THIS event performed the
+            # transition. Snapshot-at-position replay re-applies events
+            # the snapshot may already contain — for a job that failed,
+            # was retried, and re-completed, an unguarded backfill
+            # would drag the final end time back to the earlier
+            # failure's timestamp and the restored store would diverge
+            # from the leader (ADVICE r5).
+            inst0 = self.get_instance(ev["task"])
+            was_inst_end = inst0.end_time_ms if inst0 is not None else None
+            ju = self.task_to_job.get(ev["task"])
+            job0 = self.jobs.get(ju) if ju else None
+            was_completed = job0 is not None \
+                and job0.state == JobState.COMPLETED
             self.update_instance(ev["task"], st,
                                  reason_code=ev.get("r"),
                                  preempted=bool(ev.get("p")),
@@ -1366,24 +1519,32 @@ class JobStore:
             # since the last snapshot (same backfill as "kill" below)
             if ev.get("t") and st in (InstanceStatus.SUCCESS,
                                       InstanceStatus.FAILED):
-                ju = self.task_to_job.get(ev["task"])
                 job = self.jobs.get(ju) if ju else None
                 if job is not None:
                     for i in job.instances:
-                        if i.task_id == ev["task"] and i.end_time_ms:
+                        if i.task_id == ev["task"] and i.end_time_ms \
+                                and was_inst_end is None:
                             i.end_time_ms = ev["t"]
-                    if job.end_time_ms is not None:
-                        job.end_time_ms = min(job.end_time_ms, ev["t"])
+                    if not was_completed \
+                            and job.state == JobState.COMPLETED:
+                        job.end_time_ms = ev["t"]
         elif k == "progress":
             self.update_progress(ev["task"], ev["q"], ev["pc"], ev.get("m", ""))
         elif k == "retry":
             if ev["job"] in self.jobs:
                 self.retry_job(ev["job"], ev["n"])
         elif k == "kill":
+            # same was-state guard as "status": only the kill that
+            # actually completes the job may stamp its end time — a
+            # replayed kill over an already-completed job (snapshot
+            # contains it, or an earlier kill in the tail) is a no-op
+            j0 = self.jobs.get(ev["job"])
+            was_completed = j0 is not None \
+                and j0.state == JobState.COMPLETED
             self.kill_job(ev["job"])
             j = self.jobs.get(ev["job"])
-            if j is not None and j.state == JobState.COMPLETED \
-                    and ev.get("t"):
+            if j is not None and not was_completed \
+                    and j.state == JobState.COMPLETED and ev.get("t"):
                 j.end_time_ms = ev["t"]
 
 

@@ -676,3 +676,35 @@ def test_batched_match_cycle_runs_audit_and_stays_clean():
     assert stats.matched > 0
     assert coord.metrics["match.default.head_inversions"] == 0
     assert coord.metrics["match.default.head_exact"] == 256
+
+
+def test_refreeze_ladder_budgeted_and_rate_limited():
+    """The budgeted refreeze ladder: young-gen rungs carry the steady
+    state, the FULL (freezing) gen-2 pass appears but only on the
+    gc_full_refreeze_every cadence, and budget <= 0 restores the
+    legacy unconditional full pass."""
+    import gc
+    store, cluster, coord = build()
+    gc.collect()
+    gc.freeze()
+    try:
+        coord.gc_refreeze_interval_s = 0.0
+        gens = []
+        for _ in range(25):
+            coord._next_refreeze = 0.0
+            # cycle_ms >= the match interval: zero idle headroom, so
+            # rung choice is driven purely by gc_refreeze_budget_ms
+            coord._maybe_refreeze(cycle_ms=2000.0)
+            gens.append(coord.metrics["gc.refreeze_gen"])
+        assert all(g in (0, 1, 2) for g in gens)
+        assert 2 in gens                       # full pass never starves
+        every = coord.gc_full_refreeze_every
+        assert 2 not in gens[:every - 1]       # ...but is not eager
+        assert gens.count(2) <= len(gens) // every + 1   # rate-limited
+        # budget <= 0: legacy behaviour, unconditional full pass
+        coord.gc_refreeze_budget_ms = 0.0
+        coord._next_refreeze = 0.0
+        coord._maybe_refreeze(cycle_ms=0.0)
+        assert coord.metrics["gc.refreeze_gen"] == 2
+    finally:
+        gc.unfreeze()

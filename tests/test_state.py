@@ -981,3 +981,60 @@ def test_no_store_private_access_outside_state():
             if pat.search(line.split("#")[0]):
                 offenders.append(f"{p.relative_to(root)}:{n}: {line.strip()}")
     assert not offenders, offenders
+
+
+def test_replay_end_time_backfill_is_idempotent(tmp_path):
+    """Snapshot-at-position replay re-applies events the snapshot may
+    already reflect (snapshot() docstring contract). For a job that
+    failed, was retried, and re-completed, re-applying the earlier
+    FAILED status event over the final state must NOT drag the job's
+    end time back to the failure's timestamp (ADVICE r5)."""
+    import json
+
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    job = mkjob(retries=2)
+    s.create_jobs([job])
+    i1 = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(i1.task_id, InstanceStatus.FAILED,
+                      reason_code=1003)
+    assert job.state == JobState.WAITING
+    i2 = s.create_instance(job.uuid, "h", "mock")
+    s.update_instance(i2.task_id, InstanceStatus.SUCCESS)
+    assert job.state == JobState.COMPLETED and job.success
+    end_job = job.end_time_ms
+    end_i1 = i1.end_time_ms
+    end_i2 = i2.end_time_ms
+    assert end_job is not None and end_i1 is not None
+
+    with open(log) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    # second application of the status tail over already-final state:
+    # transition-guarded no-ops all the way down, clocks included
+    for ev in events:
+        if ev.get("k") == "status":
+            s._apply_event(ev)
+    assert job.end_time_ms == end_job
+    assert i1.end_time_ms == end_i1
+    assert i2.end_time_ms == end_i2
+
+
+def test_replay_kill_backfill_only_on_transition(tmp_path):
+    """A replayed kill over an already-completed job must not restamp
+    its end time, even when the event carries a different timestamp."""
+    import json
+
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    job = mkjob()
+    s.create_jobs([job])
+    s.kill_job(job.uuid)
+    assert job.state == JobState.COMPLETED
+    end0 = job.end_time_ms
+
+    with open(log) as f:
+        kill_ev = next(json.loads(line) for line in f
+                       if '"kill"' in line)
+    kill_ev = dict(kill_ev, t=(kill_ev.get("t") or end0) + 5000)
+    s._apply_event(kill_ev)
+    assert job.end_time_ms == end0
