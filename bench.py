@@ -587,7 +587,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
               runtime_s=10.0, sequential_threshold=2048,
               async_consumer=False, rotate_lines=1_000_000,
               retention_s=120.0,
-              label="e2e coordinator @ 100k-pending x 10k-offers"):
+              label="e2e coordinator @ 100k-pending x 10k-offers",
+              stats_out=None):
     """END-TO-END production path: Coordinator.match_cycle itself — the
     durable store (100k pending + ~10k running), device-resident
     tensors updated by store-event deltas, the real launch transaction
@@ -887,7 +888,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
 
         n_pend = len(store.pending_jobs("default"))
         n_run = len(store.running_instances("default"))
-        print(json.dumps({
+        out = {
             "metric": f"sched decisions/sec, {label}",
             "value": round(dps, 1),
             "unit": "decisions/sec",
@@ -974,7 +975,10 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             "cycles": len(wall),
             "wall_s": round(total_s, 1),
             "device": str(jax.devices()[0]),
-        }), flush=True)
+        }
+        if stats_out is not None:
+            stats_out.update(out)
+        print(json.dumps(out), flush=True)
     finally:
         try:
             rot_stop.set()
@@ -988,6 +992,63 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
                 os.unlink(p)
             except OSError:
                 pass
+
+
+def bench_trace_overhead(out_path="/tmp/cook_trace.json",
+                         cycles=120, warmup=20):
+    """A/B the obs tracer on the e2e coordinator path and export the
+    traced run's flight recorder as Chrome-trace JSON (opens directly
+    in Perfetto / chrome://tracing).
+
+    Always-on-cheap is a claim the flight recorder must keep paying
+    for: this mode runs the SAME small e2e config twice in one process
+    — tracing disabled, then enabled — reports decisions/sec for both
+    plus the relative overhead, and publishes overhead_ok against the
+    2% budget. Both runs share the in-process JAX compile cache and the
+    warmup window excludes the first run's compiles, so the diff is the
+    tracer's own cost: per-cycle flight spans (store-submitted bench
+    jobs carry no traceparent, so the per-job path stays on its
+    zero-allocation disabled branch — exactly the production hot-path
+    mix)."""
+    from cook_tpu import obs
+
+    cfg = dict(P0=20_000, H=2_000, cycles=cycles, warmup=warmup)
+    runs = {}
+    for mode, enabled in (("disabled", False), ("enabled", True)):
+        obs.tracer.reset()
+        obs.tracer.enabled = enabled
+        stats = {}
+        bench_e2e(label=f"trace-overhead [{mode}] @ 20k-pending x "
+                        "2k-offers", stats_out=stats, **cfg)
+        runs[mode] = stats
+    # export while the enabled run's spans are still in the ring;
+    # recent() is newest-first, Perfetto sorts by ts either way
+    flight = obs.tracer.recent(2048)
+    chrome = obs.to_chrome_trace(flight)
+    with open(out_path, "w") as f:
+        json.dump(chrome, f)
+    ring_stats = obs.tracer.stats()
+    obs.tracer.enabled = True   # restore the process-wide default
+    dps_off = float(runs["disabled"]["value"])
+    dps_on = float(runs["enabled"]["value"])
+    overhead = ((dps_off - dps_on) / dps_off * 100.0) if dps_off else 0.0
+    print(json.dumps({
+        "metric": "obs tracing overhead, e2e @ 20k-pending x 2k-offers",
+        "value": round(overhead, 2),
+        "unit": "% decisions/sec lost with tracing enabled",
+        "budget_pct": 2.0,
+        "overhead_ok": overhead <= 2.0,
+        "decisions_per_sec_disabled": dps_off,
+        "decisions_per_sec_enabled": dps_on,
+        "p99_cycle_ms_disabled": runs["disabled"]["p99_cycle_ms"],
+        "p99_cycle_ms_enabled": runs["enabled"]["p99_cycle_ms"],
+        "flight_spans_exported": len(flight),
+        "chrome_trace": out_path,
+        "chrome_trace_note": "flight-recorder cycle spans with phase "
+                             "children; open in Perfetto or "
+                             "chrome://tracing",
+        "tracer": ring_stats,
+    }), flush=True)
 
 
 def bench_pallas():
@@ -1089,13 +1150,17 @@ def main():
         bench_e2e(cycles=8400, async_consumer=True,
                   label="e2e longevity @ 100k-pending x 10k-offers, "
                         "8400 cycles, async consumer, production rotation")
+    elif which == "trace-overhead":
+        # A/B of the obs flight recorder on the e2e path + Chrome-trace
+        # export; optional argv[2] = output JSON path
+        bench_trace_overhead(*(sys.argv[2:3] or ["/tmp/cook_trace.json"]))
     elif which == "pallas":
         bench_pallas()
     else:
         raise SystemExit(f"unknown config {which!r}; one of: headline "
                          "contended small pools rebalance stream e2e "
                          "e2e-small e2e-batched e2e-async longevity "
-                         "longevity-async pallas")
+                         "longevity-async trace-overhead pallas")
 
 
 if __name__ == "__main__":

@@ -74,6 +74,12 @@ class AgentDaemon:
         # window); flushed after each successful heartbeat
         self._outbox: list[dict] = []
         self._outbox_lock = threading.Lock()
+        # task_id -> trace context + locally-timed span bounds: the
+        # daemon has no tracer of its own — it echoes the launch spec's
+        # traceparent and its wall-clock launch/run windows back on
+        # status posts, and the coordinator folds them into the trace
+        self._task_traces: dict[str, dict] = {}
+        self._task_traces_lock = threading.Lock()
         self.hostname = hostname or socket.gethostname()
         self.mem, self.cpus, self.gpus = mem, cpus, gpus
         self.pool = pool
@@ -214,6 +220,24 @@ class AgentDaemon:
             "exit_code": info.get("exit_code"),
             "sandbox": info.get("sandbox", ""),
             "hostname": self.hostname}
+        # echo the trace context + this task's locally-timed spans:
+        # "launch" rides the first status that goes out, "run" the
+        # terminal one ("running" is the only non-terminal event)
+        with self._task_traces_lock:
+            entry = self._task_traces.get(task_id) if event == "running" \
+                else self._task_traces.pop(task_id, None)
+            if entry is not None:
+                spans = []
+                if not entry["sent_launch"]:
+                    spans.append({"name": "launch", "t0": entry["t0"],
+                                  "t1": entry["t_launched"]})
+                    entry["sent_launch"] = True
+                if event != "running":
+                    spans.append({"name": "run",
+                                  "t0": entry["t_launched"],
+                                  "t1": time.time() * 1000.0})
+                payload["traceparent"] = entry["tp"]
+                payload["spans"] = spans
         if not self._post_retry("/agents/status", payload):
             # terminal statuses must not be lost to a leaderless window
             # (the task is gone from later heartbeat task lists, so the
@@ -323,6 +347,8 @@ class AgentDaemon:
             env = dict(spec.get("env", {}))
             for i, p in enumerate(spec.get("ports", [])):
                 env[f"PORT{i}"] = str(p)
+            tp = spec.get("traceparent", "")
+            t0 = time.time() * 1000.0
             try:
                 self.executor.launch(
                     spec["task_id"], spec.get("command", ""), env=env,
@@ -333,9 +359,21 @@ class AgentDaemon:
             except Exception as e:
                 logger.warning("launch %s failed: %s", spec.get("task_id"),
                                e)
-                self._post_retry("/agents/status", {
-                    "task_id": spec["task_id"], "event": "fetch_failed",
-                    "hostname": self.hostname})
+                fail = {"task_id": spec["task_id"],
+                        "event": "fetch_failed",
+                        "hostname": self.hostname}
+                if tp:
+                    fail["traceparent"] = tp
+                    fail["spans"] = [{"name": "launch", "t0": t0,
+                                      "t1": time.time() * 1000.0}]
+                self._post_retry("/agents/status", fail)
+                continue
+            if tp:
+                with self._task_traces_lock:
+                    self._task_traces[spec["task_id"]] = {
+                        "tp": tp, "t0": t0,
+                        "t_launched": time.time() * 1000.0,
+                        "sent_launch": False}
         return {"ok": True}
 
     def handle_kill(self, payload: dict) -> dict:

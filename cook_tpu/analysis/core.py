@@ -22,7 +22,7 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-ALL_RULES = ("R1", "R2", "R3", "R4")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
 
 # which rule families run over which package subdirectories when
 # scanning a tree (explicit file arguments get every AST rule)
@@ -30,6 +30,8 @@ RULE_DIRS = {
     "R1": ("ops", "parallel"),
     "R2": ("scheduler", "agent"),
     "R3": ("rest", "backends", "scheduler", "integrations"),
+    "R5": ("obs", "scheduler", "rest", "backends", "agent", "state",
+           "utils"),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -159,11 +161,11 @@ def diff_baseline(findings: list[Finding], baseline: dict[str, int]
 # analysis drivers
 
 def analyze_source(source: str, path: str,
-                   rules: Iterable[str] = ("R1", "R2", "R3"),
+                   rules: Iterable[str] = ("R1", "R2", "R3", "R5"),
                    apply_suppressions: bool = True) -> list[Finding]:
     """Run the per-module AST rules over one source text."""
     from cook_tpu.analysis import (async_hygiene, lock_discipline,
-                                   trace_purity)
+                                   span_discipline, trace_purity)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -178,6 +180,8 @@ def analyze_source(source: str, path: str,
         findings += lock_discipline.check(mod)
     if "R3" in rules:
         findings += async_hygiene.check(mod)
+    if "R5" in rules:
+        findings += span_discipline.check(mod)
     if apply_suppressions:
         sup = collect_suppressions(source)
         findings = [f for f in findings if not suppressed(f, sup)]

@@ -27,6 +27,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
+from cook_tpu import obs
 from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
 from cook_tpu.state.model import InstanceStatus, now_ms
 from cook_tpu.utils.httpjson import json_request
@@ -206,6 +207,32 @@ class AgentCluster(ComputeCluster):
                 self.heartbeats.notify(tid)
         return {"ok": True, "kill": orphans}
 
+    @staticmethod
+    def _record_remote_spans(payload: dict) -> None:
+        """Fold agent-side spans into the coordinator's tracer: the
+        daemon echoes the launch spec's traceparent plus its locally
+        timed spans on each status post, so the job's /trace tree
+        crosses the process (and clock) boundary.  Malformed trace
+        payloads are ignored — tracing must never fail a status."""
+        if not obs.tracer.enabled:
+            return
+        ctx = obs.parse_traceparent(payload.get("traceparent"))
+        if ctx is None:
+            return
+        spans = payload.get("spans")
+        if not isinstance(spans, list):
+            return
+        for sp in spans:
+            try:
+                obs.tracer.record(
+                    f"agent.{sp['name']}", trace_id=ctx[0],
+                    parent_id=ctx[1], start_ms=float(sp["t0"]),
+                    end_ms=float(sp["t1"]),
+                    attrs={"hostname": payload.get("hostname", ""),
+                           "task": payload.get("task_id", "")})
+            except (KeyError, TypeError, ValueError):
+                continue
+
     def status_report(self, payload: dict) -> dict:
         """POST /agents/status: executor events relayed over the wire.
         Same event -> instance-status mapping as the in-process local
@@ -214,6 +241,7 @@ class AgentCluster(ComputeCluster):
         event = payload.get("event", "")
         exit_code = payload.get("exit_code")
         sandbox = payload.get("sandbox", "")
+        self._record_remote_spans(payload)
         with self._lock:
             entry = self._specs.get(task_id)
         if entry is None:
@@ -429,4 +457,5 @@ def _spec_wire(s: LaunchSpec) -> dict:
         "progress_regex": s.progress_regex,
         "progress_output_file": s.progress_output_file,
         "ports": s.ports, "uris": s.uris,
+        "traceparent": s.traceparent,
     }

@@ -64,6 +64,10 @@ class LaunchSpec:
     ports: list[int] = field(default_factory=list)
     # FetchableURIs to stage into the sandbox before the command runs
     uris: list[dict] = field(default_factory=list)
+    # trace context for this launch ("00-<trace>-<launch span>-01");
+    # agents parent their launch/run spans into it and echo it back on
+    # status posts.  Empty = untraced.
+    traceparent: str = ""
 
 
 StatusCallback = Callable[..., None]

@@ -140,7 +140,12 @@ class KubeCluster(ComputeCluster):
                       gpus=spec.gpus, node=spec.hostname, pool=pool,
                       env={**spec.env, **cp.checkpoint_env(ckpt)},
                       command=spec.command,
-                      labels={"cook-job": spec.job_uuid},
+                      # trace context rides as a pod label through the
+                      # stand-in apiserver, the k8s equivalent of the
+                      # agent wire's traceparent field
+                      labels={"cook-job": spec.job_uuid,
+                              **({"cook-traceparent": spec.traceparent}
+                                 if spec.traceparent else {})},
                       volumes=cp.checkpoint_volumes(ckpt),
                       init_uris=list(spec.uris),
                       container=spec.container,

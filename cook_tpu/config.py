@@ -202,6 +202,9 @@ class Settings:
     leader_hint_url: str = ""
     metrics_jsonl: Optional[str] = None
     metrics_interval_s: float = 60.0
+    # event-driven span export (obs tracer): one JSON line per
+    # finished span, alongside the interval-driven metric reporters
+    spans_jsonl: Optional[str] = None
     plugins: dict = field(default_factory=dict)
     # {"optimizer": "pkg.mod:factory" | "capacity-planning",
     #  "host_feed": "pkg.mod:factory", "interval_s": 30}

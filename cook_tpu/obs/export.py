@@ -1,0 +1,79 @@
+"""Span exporters: JSONL sink + Chrome-trace/Perfetto conversion."""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Iterable, Optional
+
+
+class SpanJsonlExporter:
+    """Tracer listener that appends one JSON line per finished span.
+
+    Sits alongside the metric reporters (utils.metrics.JsonlReporter)
+    but is event-driven rather than interval-driven: attach with
+    ``tracer.add_listener(exporter)``.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+
+    def __call__(self, span: dict) -> None:
+        line = json.dumps(span, separators=(",", ":"))
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def to_chrome_trace(spans: Iterable[dict], pid: int = 1,
+                    tid_key: str = "pool") -> dict:
+    """Convert span dicts to Chrome-trace JSON (opens in Perfetto /
+    chrome://tracing).
+
+    Each span becomes a complete ("ph": "X") event; flight-recorder
+    entries inline their phase ``children`` on the same track.  Tracks
+    (tids) are keyed by ``attrs[tid_key]`` when present, else by trace
+    id, with "M"etadata events naming each track.
+    """
+    events = []
+    tids: dict = {}
+
+    def _tid(span: dict) -> int:
+        key = (span.get("attrs") or {}).get(tid_key) \
+            or span.get("trace") or "main"
+        key = str(key)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            events.append({"ph": "M", "pid": pid, "tid": tids[key],
+                           "name": "thread_name", "args": {"name": key}})
+        return tids[key]
+
+    def _emit(span: dict, tid: Optional[int] = None) -> None:
+        if tid is None:
+            tid = _tid(span)
+        t0, t1 = float(span.get("t0", 0.0)), float(span.get("t1", 0.0))
+        args = {k: v for k, v in (span.get("attrs") or {}).items()}
+        if span.get("span"):
+            args["span"] = span["span"]
+        if span.get("parent"):
+            args["parent"] = span["parent"]
+        events.append({"name": span.get("name", "?"), "ph": "X",
+                       "cat": "cook", "pid": pid, "tid": tid,
+                       "ts": round(t0 * 1000.0, 1),
+                       "dur": round(max(t1 - t0, 0.0) * 1000.0, 1),
+                       "args": args})
+        for child in span.get("children", ()):
+            _emit(child, tid)
+
+    for s in spans:
+        _emit(s)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from cook_tpu import __version__ as VERSION
+from cook_tpu import obs
 from cook_tpu.rest.auth import (AuthConfig, AuthError, authenticate,
                                 require_authorized)
 from cook_tpu.scheduler import unscheduled
@@ -179,7 +180,7 @@ class CookApi:
                 blocked = self._leader_block(agent_channel=True)
                 if blocked is not None:
                     return blocked
-            elif path not in ("/info", "/debug",
+            elif path not in ("/info", "/debug", "/debug/flight",
                               "/metrics"):  # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)
             if method in ("POST", "PUT", "DELETE") \
@@ -267,6 +268,10 @@ class CookApi:
         r.add("GET", "/list", self.list_jobs)
         r.add("GET", "/info", self.get_info)
         r.add("GET", "/debug", self.get_debug)
+        # observability: assembled per-job span tree + the coordinator's
+        # cycle flight recorder (obs/ tracer)
+        r.add("GET", "/trace/:uuid", self.get_trace)
+        r.add("GET", "/debug/flight", self.get_debug_flight)
         r.add("GET", "/data-local", self.data_local_status)
         r.add("GET", "/data-local/:uuid", self.data_local_costs)
         r.add("GET", "/metrics", self.get_metrics)
@@ -376,6 +381,7 @@ class CookApi:
     # ------------------------------------------------------------------
     # submission (create-jobs! rest/api.clj:1805; validation :523+)
     def create_jobs(self, req: Request) -> Response:
+        t_submit0 = obs.now_ms()
         body = req.body
         if not isinstance(body, dict) or not isinstance(
                 body.get("jobs"), list) or not body["jobs"]:
@@ -433,6 +439,21 @@ class CookApi:
                             before)
                         j.pool = before
 
+        # trace context: one root span per job, stamped into the job
+        # record BEFORE the store txn so the durable "job" event (and
+        # every later scheduling layer) carries it.  An incoming W3C
+        # traceparent header continues the caller's trace; otherwise
+        # each job starts a fresh one.
+        traced_roots = []   # (job, parent_span_id)
+        if obs.tracer.enabled:
+            inbound = obs.parse_traceparent(
+                req.headers.get("traceparent", ""))
+            for j in jobs:
+                trace_id = inbound[0] if inbound else obs.new_trace_id()
+                root_sid = obs.new_span_id()
+                j.traceparent = obs.make_traceparent(trace_id, root_sid)
+                traced_roots.append((j, inbound[1] if inbound else ""))
+
         # failover idempotency: a retry after a mid-submission 503 may
         # find its own uuids already present as UNCOMMITTED jobs (the
         # old leader appended the create but fenced before the commit,
@@ -468,14 +489,28 @@ class CookApi:
             # fence between create and commit strands the batch.
             rs = set(resubmits)
             fresh = [j for j in jobs if j.uuid not in rs]
+            t_txn0 = obs.now_ms()
             uuids = self.store.create_jobs(fresh, groups, committed=True) \
                 if fresh or groups else []
+            t_txn1 = obs.now_ms()
             if resubmits:
                 self.store.commit_jobs(resubmits)
         except NotLeaderError:
             raise   # handle() maps it to 503 + leader hint (failover)
         except TransactionError as e:
             raise ApiError(409, str(e))
+        for j, parent_sid in traced_roots:
+            ctx = obs.parse_traceparent(j.traceparent)
+            if ctx is None:
+                continue
+            obs.tracer.record(
+                "job.submit", trace_id=ctx[0], span_id=ctx[1],
+                parent_id=parent_sid, start_ms=t_submit0,
+                end_ms=obs.now_ms(),
+                attrs={"uuid": j.uuid, "user": j.user, "pool": j.pool})
+            obs.tracer.record(
+                "store.create_jobs", trace_id=ctx[0], parent_id=ctx[1],
+                start_ms=t_txn0, end_ms=t_txn1)
         ordered = [j.uuid for j in jobs]
         return Response(201, {"jobs": ordered})
 
@@ -1009,9 +1044,44 @@ class CookApi:
                                      2),
                         "max": round(vals[-1], 2)}
                 consume[pool] = stats
+        # same reader-vs-writer contract as the consume trace: the
+        # match/consume threads insert metric keys concurrently, so
+        # /debug must serve a locked point-in-time copy, never the
+        # coordinator's live dict
+        metrics = self.coord.metrics_snapshot() \
+            if self.coord is not None else {}
         return Response(200, {"healthy": True, "version": VERSION,
                               "clusters": clusters,
+                              "metrics": metrics,
                               "consume_trace": consume})
+
+    def get_trace(self, req: Request, uuid: str) -> Response:
+        """Assembled span tree for one job's lifecycle: REST submit ->
+        store txn -> match-cycle phases -> launch txn -> backend/agent
+        launch -> completion, across process boundaries (the agent's
+        spans arrive via the status-post echo)."""
+        job = self.store.get_job(uuid)
+        if job is None:
+            raise ApiError(404, f"job {uuid} unknown")
+        ctx = obs.parse_traceparent(job.traceparent)
+        if ctx is None:
+            raise ApiError(404, f"no trace recorded for job {uuid}")
+        spans = obs.tracer.trace(ctx[0])
+        return Response(200, {"uuid": uuid, "trace_id": ctx[0],
+                              "traceparent": job.traceparent,
+                              "spans": spans,
+                              "tree": obs.tracer.tree(ctx[0])})
+
+    def get_debug_flight(self, req: Request) -> Response:
+        """The coordinator's cycle flight recorder: the most recent
+        per-cycle spans (phase timings embedded as children), newest
+        first."""
+        try:
+            limit = int(req.qp("limit", "64"))
+        except (TypeError, ValueError):
+            limit = 64
+        return Response(200, {"tracer": obs.tracer.stats(),
+                              "spans": obs.tracer.recent(limit)})
 
     # -- data-locality debug endpoints (data_locality.clj debug REST,
     # rest/api.clj data-local routes) ----------------------------------

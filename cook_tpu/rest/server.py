@@ -558,6 +558,9 @@ def main(argv=None) -> None:
     if settings.metrics_jsonl:
         JsonlReporter(registry, settings.metrics_jsonl,
                       interval_s=settings.metrics_interval_s).start()
+    if settings.spans_jsonl:
+        from cook_tpu import obs
+        obs.tracer.add_listener(obs.SpanJsonlExporter(settings.spans_jsonl))
     server = ApiServer(api, port=settings.port).start()
     log.info("cook_tpu scheduler listening on %s (leader=%s)", server.url,
              elector.is_leader() if elector is not None else "api-only")

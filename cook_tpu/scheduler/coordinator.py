@@ -46,6 +46,7 @@ from cook_tpu.state.model import (REASON_BY_CODE, InstanceStatus, Job,
 from cook_tpu.parallel import federation
 from cook_tpu.state.pools import DruMode, PoolRegistry
 from cook_tpu.utils.metrics import registry as metrics_registry
+from cook_tpu import obs
 from cook_tpu.state.store import JobStore, TransactionError
 
 
@@ -190,6 +191,10 @@ class Coordinator:
         # Single-element ops (append, popleft) are GIL-atomic and the
         # bench's drain relies on that; only iteration needs the lock.
         self._trace_lock = threading.Lock()
+        # guards metrics_snapshot() readers against the match/consume
+        # threads' writes (same reader-vs-writer contract as
+        # consume_trace_snapshot: /debug must copy, never iterate live)
+        self._metrics_lock = threading.Lock()
         self.progress_aggregator = progress_aggregator
         self.heartbeats = heartbeats
         self.plugins = plugins
@@ -393,6 +398,20 @@ class Coordinator:
         job = self.store.update_instance(
             task_id, status, reason_code=reason, preempted=preempted,
             exit_code=exit_code, sandbox=sandbox, output_url=output_url)
+        if job is not None and job.traceparent and obs.tracer.enabled \
+                and status in (InstanceStatus.SUCCESS,
+                               InstanceStatus.FAILED):
+            ctx = obs.parse_traceparent(job.traceparent)
+            if ctx is not None:
+                # terminal marker closing the job's lifecycle tree (the
+                # agent's launch/run spans arrive separately via the
+                # status-post echo in backends/agent.py)
+                end = obs.now_ms()
+                obs.tracer.record(
+                    "job.complete", trace_id=ctx[0], parent_id=ctx[1],
+                    start_ms=end, end_ms=end,
+                    attrs={"task": task_id, "status": status.name,
+                           "reason": reason})
         # completion plugin (write-status path, scheduler.clj:305-316)
         if self.plugins is not None and job is not None and \
                 status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
@@ -773,6 +792,21 @@ class Coordinator:
             stats.cycle_ms)
         metrics_registry.meter(f"match.{pool}.matched").mark(stats.matched)
         metrics_registry.counter(f"match.{pool}.cycles").inc()
+        if obs.tracer.enabled:
+            # flight-recorder entry: this cycle with the phase stamps it
+            # already took — the tail segment is the inline consume for
+            # sync pools, the queue handoff wait for the async consumer
+            end, t_now = obs.now_ms(), time.perf_counter()
+            w = lambda t: end - (t_now - t) * 1e3
+            tail = "queue_wait" if not rp.synchronous else "consume"
+            obs.tracer.record_cycle(
+                "cycle.match", w(t0), end,
+                phases=[("drain", w(t0), w(t_drain)),
+                        ("ship", w(t_drain), w(t_ship)),
+                        ("dispatch", w(t_ship), w(t_dispatch)),
+                        (tail, w(t_dispatch), end)],
+                attrs={"pool": pool, "cycle": rp.cycle_no,
+                       "matched": stats.matched})
         return stats
 
     def _consume_cycle(self, pool: str, rp, out) -> dict:
@@ -920,12 +954,19 @@ class Coordinator:
         t_loop = time.perf_counter()
         self.metrics[f"match.{pool}.launch_loop_ms"] = \
             (t_loop - t_rb1) * 1e3
+        # one span id for the whole bulk launch transaction: it rides
+        # on the durable "insts" log record AND appears (same id) as
+        # the launch_txn child in every launched traced job's tree
+        txn_sid = obs.new_span_id() if obs.tracer.enabled and any(
+            job.traceparent for job, _p, _c in item_jobs) else ""
         insts = self.store.create_instances_bulk(
-            items, origin=("resident", pool, out.cycle_no)) if items else []
+            items, origin=("resident", pool, out.cycle_no),
+            span_id=txn_sid) if items else []
         self.metrics[f"match.{pool}.launch_txn_ms"] = \
             (time.perf_counter() - t_loop) * 1e3
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
+        traced = []   # (trace_id, root_sid, launch_sid, task_id)
         for (uuid, hostname, cname), (job, ports, credit), inst in zip(
                 items, item_jobs, insts):
             if inst is None:
@@ -944,6 +985,14 @@ class Coordinator:
             env = dict(job.env)
             for k, p in enumerate(ports):
                 env[f"PORT{k}"] = str(p)
+            tp_launch = ""
+            if job.traceparent and obs.tracer.enabled:
+                ctx = obs.parse_traceparent(job.traceparent)
+                if ctx is not None:
+                    launch_sid = obs.new_span_id()
+                    tp_launch = obs.make_traceparent(ctx[0], launch_sid)
+                    traced.append((ctx[0], ctx[1], launch_sid,
+                                   inst.task_id))
             by_cluster.setdefault(cname, []).append(
                 LaunchSpec(task_id=inst.task_id, job_uuid=uuid,
                            hostname=hostname, command=job.command,
@@ -953,7 +1002,8 @@ class Coordinator:
                            progress_output_file=job.progress_output_file,
                            checkpoint=job.checkpoint,
                            prior_failure_reasons=_failure_reason_names(job),
-                           ports=ports, uris=job.uris))
+                           ports=ports, uris=job.uris,
+                           traceparent=tp_launch))
             launched += 1
             if self.heartbeats is not None:
                 self.heartbeats.track(inst.task_id)
@@ -1028,6 +1078,42 @@ class Coordinator:
                 "backend_ms":
                     self.metrics[f"match.{pool}.backend_launch_ms"],
             })
+        if obs.tracer.enabled:
+            # flight-recorder entry (cycle-level) + per-traced-job span
+            # reconstruction from the stamps this function already took
+            # — no extra clocks, no device work, nothing on the hot
+            # path when tracing is disabled
+            end = obs.now_ms()
+            w = lambda t: end - (t_end - t) * 1e3
+            txn_ms = self.metrics[f"match.{pool}.launch_txn_ms"]
+            wall_rb0, wall_rb1, wall_loop = w(t_rb0), w(t_rb1), w(t_loop)
+            wall_txn = wall_loop + txn_ms
+            obs.tracer.record_cycle(
+                "cycle.consume", wall_rb0, end,
+                phases=[("readback", wall_rb0, wall_rb1),
+                        ("launch_loop", wall_rb1, wall_loop),
+                        ("launch_txn", wall_loop, wall_txn),
+                        ("backend_launch", wall_txn, end)],
+                attrs={"pool": pool, "cycle": out.cycle_no,
+                       "matched": launched})
+            for tid, root_sid, launch_sid, task_id in traced:
+                cyc_sid = obs.tracer.record(
+                    "match.cycle", trace_id=tid, parent_id=root_sid,
+                    start_ms=wall_rb0, end_ms=end,
+                    attrs={"pool": pool, "cycle": out.cycle_no,
+                           "task": task_id, "path": "resident"})
+                obs.tracer.record("readback", trace_id=tid,
+                                  parent_id=cyc_sid, start_ms=wall_rb0,
+                                  end_ms=wall_rb1)
+                obs.tracer.record("launch_loop", trace_id=tid,
+                                  parent_id=cyc_sid, start_ms=wall_rb1,
+                                  end_ms=wall_loop)
+                obs.tracer.record("launch_txn", trace_id=tid,
+                                  span_id=txn_sid, parent_id=cyc_sid,
+                                  start_ms=wall_loop, end_ms=wall_txn)
+                obs.tracer.record("backend_launch", trace_id=tid,
+                                  span_id=launch_sid, parent_id=cyc_sid,
+                                  start_ms=wall_txn, end_ms=end)
         rp.consumed_through = out.cycle_no
         if rp._inflight and rp._inflight[0] is out:
             rp._inflight.popleft()
@@ -1040,6 +1126,15 @@ class Coordinator:
         list(consume_trace) races the appender)."""
         with self._trace_lock:
             return list(self.consume_trace)
+
+    def metrics_snapshot(self) -> dict:
+        """Point-in-time copy of the per-pool phase metrics, safe for
+        /debug while the match/consume threads keep writing.  Readers
+        must never iterate the live dict (a key insert mid-iteration
+        raises); the lock additionally keeps any future multi-key
+        update transaction atomic with respect to snapshots."""
+        with self._metrics_lock:
+            return dict(self.metrics)
 
     # ------------------------------------------------------------------
     # match cycle (scheduler.clj:848-1036)
@@ -1184,6 +1279,8 @@ class Coordinator:
                                          for p in range(lo, hi + 1)]
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
+        t_launch0 = time.perf_counter()
+        traced = []   # (ctx, txn_sid, launch_sid, task_id, t_ci0, t_ci1)
         for idx in np.argsort(queue_rank[:len(pending)]):
             h = job_host[idx]
             if h < 0 or h >= len(offers):
@@ -1202,11 +1299,22 @@ class Coordinator:
                 continue
             if assigned_ports:
                 port_pool[hostname] = port_pool[hostname][job.ports:]
+            ctx = obs.parse_traceparent(job.traceparent) \
+                if job.traceparent and obs.tracer.enabled else None
+            txn_sid = obs.new_span_id() if ctx is not None else ""
+            t_ci0 = time.perf_counter()
             try:
                 inst = self.store.create_instance(job.uuid, hostname,
-                                                  offer_cluster[hostname])
+                                                  offer_cluster[hostname],
+                                                  span_id=txn_sid)
             except TransactionError:
                 continue  # lost a race (job killed meanwhile)
+            tp_launch = ""
+            if ctx is not None:
+                launch_sid = obs.new_span_id()
+                tp_launch = obs.make_traceparent(ctx[0], launch_sid)
+                traced.append((ctx, txn_sid, launch_sid, inst.task_id,
+                               t_ci0, time.perf_counter()))
             inst.ports = assigned_ports
             env = dict(job.env)
             for i, p in enumerate(assigned_ports):
@@ -1220,7 +1328,8 @@ class Coordinator:
                            progress_output_file=job.progress_output_file,
                            checkpoint=job.checkpoint,
                            prior_failure_reasons=_failure_reason_names(job),
-                           ports=assigned_ports, uris=job.uris))
+                           ports=assigned_ports, uris=job.uris,
+                           traceparent=tp_launch))
             launched += 1
             if self.heartbeats is not None:
                 # deadline starts at launch (the reference creates the
@@ -1253,6 +1362,28 @@ class Coordinator:
             if errors:
                 raise errors[0]
         stats.matched = launched
+        t_launch1 = time.perf_counter()
+        if traced:
+            # per-traced-job lifecycle spans, reconstructed from the
+            # stamps the loop above already took (legacy path: the
+            # launch txn is per-job, the backend launch per-cycle)
+            end = obs.now_ms()
+            w = lambda t: end - (t_launch1 - t) * 1e3
+            for ctx, txn_sid, launch_sid, task_id, t_ci0, t_ci1 in traced:
+                cyc_sid = obs.tracer.record(
+                    "match.cycle", trace_id=ctx[0], parent_id=ctx[1],
+                    start_ms=w(t0), end_ms=w(t_launch1),
+                    attrs={"pool": pool, "task": task_id,
+                           "path": "legacy"})
+                obs.tracer.record("tensorize_match", trace_id=ctx[0],
+                                  parent_id=cyc_sid, start_ms=w(t0),
+                                  end_ms=w(t_launch0))
+                obs.tracer.record("launch_txn", trace_id=ctx[0],
+                                  span_id=txn_sid, parent_id=cyc_sid,
+                                  start_ms=w(t_ci0), end_ms=w(t_ci1))
+                obs.tracer.record("backend_launch", trace_id=ctx[0],
+                                  span_id=launch_sid, parent_id=cyc_sid,
+                                  start_ms=w(t_ci1), end_ms=w(t_launch1))
 
         # placement-failure bookkeeping for /unscheduled_jobs
         # (record-placement-failures! fenzo_utils.clj:74): structured
@@ -1305,6 +1436,16 @@ class Coordinator:
             stats.cycle_ms)
         metrics_registry.meter(f"match.{pool}.matched").mark(launched)
         metrics_registry.counter(f"match.{pool}.cycles").inc()
+        if obs.tracer.enabled:
+            end, t_now = obs.now_ms(), time.perf_counter()
+            w = lambda t: end - (t_now - t) * 1e3
+            obs.tracer.record_cycle(
+                "cycle.match", w(t0), end,
+                phases=[("tensorize_match", w(t0), w(t_launch0)),
+                        ("launch", w(t_launch0), w(t_launch1)),
+                        ("bookkeeping", w(t_launch1), end)],
+                attrs={"pool": pool, "matched": launched,
+                       "offers": stats.offers})
         self._maybe_refreeze(stats.cycle_ms)
         return stats
 

@@ -199,6 +199,10 @@ class Job:
     # why the job can't be scheduled right now (for /unscheduled_jobs)
     last_placement_failure: Optional[dict[str, Any]] = None
     datasets: list[dict[str, Any]] = field(default_factory=list)
+    # W3C-style trace context stamped at REST submit ("00-<trace>-
+    # <root span>-01"); every downstream span of this job's lifecycle
+    # parents into it.  Empty = job not traced.
+    traceparent: str = ""
 
     @property
     def active_instances(self) -> list[Instance]:

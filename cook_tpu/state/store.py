@@ -506,10 +506,13 @@ class JobStore:
                     and not job.active_instances)
 
     def create_instance(self, job_uuid: str, hostname: str, backend: str,
-                        task_id: Optional[str] = None) -> Instance:
+                        task_id: Optional[str] = None,
+                        span_id: str = "") -> Instance:
         """Atomically guard allowed-to-start and write the new instance +
         job state (:instance/create schema.clj:949; launch txn
-        scheduler.clj:762-777)."""
+        scheduler.clj:762-777).  ``span_id`` (the coordinator's launch-
+        txn span) rides on the durable event so the log carries trace
+        context; replay ignores unknown keys."""
         with self._lock:
             self._check_writable()
             if not self.allowed_to_start(job_uuid):
@@ -522,13 +525,17 @@ class JobStore:
             self.task_to_job[inst.task_id] = job_uuid
             self._update_job_state(job)
             self._reindex(job)
-            self._append("inst", {"job": job_uuid, "task": inst.task_id,
-                                  "host": hostname, "backend": backend})
+            ev = {"job": job_uuid, "task": inst.task_id,
+                  "host": hostname, "backend": backend}
+            if span_id:
+                ev["sp"] = span_id
+            self._append("inst", ev)
             self._emit("inst", {"obj": job, "inst": inst})
         self._barrier()
         return inst
 
-    def create_instances_bulk(self, items, origin=None) -> list:
+    def create_instances_bulk(self, items, origin=None,
+                              span_id: str = "") -> list:
         """Launch transaction for a whole match cycle in ONE store
         transaction: items is [(job_uuid, hostname, backend), ...];
         returns a same-length list of Instance | None (None = the
@@ -562,8 +569,12 @@ class JobStore:
                     f'{{"j":{json.dumps(job_uuid)},"i":"{inst.task_id}",'
                     f'"h":{json.dumps(hostname)},"b":{json.dumps(backend)}}}')
             if log_items:
+                # "sp" = the cycle's launch-txn span id: the durable
+                # batch record carries trace context (replay-safe —
+                # _apply_event ignores unknown keys)
+                sp = f',"sp":{json.dumps(span_id)}' if span_id else ""
                 self._append_raw(
-                    f'{{"t":{t_ms},"k":"insts","items":['
+                    f'{{"t":{t_ms},"k":"insts"{sp},"items":['
                     + ",".join(log_items)
                     + f']{self._epoch_suffix()}}}')
             if created:

@@ -8,6 +8,7 @@ JMX/Graphite/Riemann role).  Stdlib + numpy only.
 """
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
@@ -40,7 +41,10 @@ class Meter:
     def __init__(self, window_s: float = 60.0, clock=time.monotonic):
         self.window_s = window_s
         self._clock = clock
-        self._events: list[tuple[float, float]] = []
+        # deque: mark() runs once per match, and list.pop(0) made the
+        # window trim O(n) on exactly that hot path
+        self._events: collections.deque[tuple[float, float]] = \
+            collections.deque()
         self._total = 0.0
         self._lock = threading.Lock()
 
@@ -51,7 +55,7 @@ class Meter:
             self._total += n
             cutoff = now - self.window_s
             while self._events and self._events[0][0] < cutoff:
-                self._events.pop(0)
+                self._events.popleft()
 
     @property
     def rate(self) -> float:

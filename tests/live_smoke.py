@@ -1,0 +1,112 @@
+"""CI live-stack smoke: boot the whole server, run one job through it
+over the wire, and scrape what an operator would scrape.
+
+Usage: ``python tests/live_smoke.py [artifact_dir]``
+
+Boots the shared tests/livestack harness (REST server + coordinator +
+mock virtual-clock cluster), submits a job over HTTP, pumps match
+cycles until it completes, then HTTP-scrapes:
+
+  - ``/metrics``        — Prometheus text exposition
+  - ``/trace/<uuid>``   — the job's assembled lifecycle span tree
+  - ``/debug/flight``   — the cycle flight recorder
+
+and writes them (plus a Chrome-trace conversion of the trace, openable
+directly in Perfetto) into ``artifact_dir`` for the workflow's
+upload-artifact step. Exits non-zero if any invariant fails, so the
+smoke is a real gate, not just an artifact producer.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+# runnable as `python tests/live_smoke.py` from a fresh checkout: put
+# the repo root (not tests/) on the path
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def scrape(url: str, user: str = "admin") -> bytes:
+    req = urllib.request.Request(url, headers={"X-Cook-User": user})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.read()
+
+
+def main(artifact_dir: str = "smoke-artifacts") -> int:
+    os.makedirs(artifact_dir, exist_ok=True)
+
+    from cook_tpu import obs
+    from cook_tpu.backends.mock import MockHost
+    from cook_tpu.state.model import JobState
+    from tests.livestack import Stack
+
+    stack = Stack([MockHost("h0", mem=4096, cpus=32)])
+    try:
+        client = stack.client("smoke")
+        uuid = client.submit(command="true", mem=64, cpus=1)
+        print(f"submitted {uuid} to {stack.server.url}")
+
+        deadline = time.time() + 60
+        while stack.store.jobs[uuid].state != JobState.COMPLETED:
+            stack.coord.match_cycle()
+            stack.cluster.advance(120)   # virtual clock: finish tasks
+            if time.time() > deadline:
+                print("FAIL: job did not complete within 60s")
+                return 1
+            time.sleep(0.05)
+        print(f"job {uuid} completed")
+
+        metrics = scrape(stack.server.url + "/metrics").decode()
+        trace = json.loads(scrape(stack.server.url + f"/trace/{uuid}"))
+        flight = json.loads(scrape(stack.server.url + "/debug/flight"))
+
+        with open(os.path.join(artifact_dir, "metrics.txt"), "w") as f:
+            f.write(metrics)
+        with open(os.path.join(artifact_dir, "trace.json"), "w") as f:
+            json.dump(trace, f, indent=1)
+        with open(os.path.join(artifact_dir, "flight.json"), "w") as f:
+            json.dump(flight, f, indent=1)
+        chrome = obs.to_chrome_trace(trace["spans"] + flight["spans"])
+        with open(os.path.join(artifact_dir,
+                               "chrome_trace.json"), "w") as f:
+            json.dump(chrome, f)
+
+        failures = []
+        if "cook_match_default_cycle_ms" not in metrics:
+            failures.append("/metrics missing match cycle timer")
+        names = {sp["name"] for sp in trace["spans"]}
+        for required in ("job.submit", "store.create_jobs",
+                         "match.cycle", "launch_txn", "backend_launch",
+                         "job.complete"):
+            if required not in names:
+                failures.append(f"/trace missing span {required!r}")
+        ids = {sp["span"] for sp in trace["spans"]}
+        root = obs.parse_traceparent(trace["traceparent"])[1]
+        for sp in trace["spans"]:
+            if sp["parent"] not in ids | {root, ""}:
+                failures.append(f"orphan span {sp['name']}")
+        if not trace["tree"] or trace["tree"][0]["name"] != "job.submit":
+            failures.append("/trace tree does not root at job.submit")
+        if not any(sp["name"] == "cycle.match"
+                   for sp in flight["spans"]):
+            failures.append("/debug/flight has no cycle.match entries")
+        if not chrome["traceEvents"]:
+            failures.append("chrome trace conversion is empty")
+
+        for msg in failures:
+            print(f"FAIL: {msg}")
+        if not failures:
+            print(f"smoke OK: {len(trace['spans'])} spans, "
+                  f"{len(flight['spans'])} flight entries, artifacts "
+                  f"in {artifact_dir}/")
+        return 1 if failures else 0
+    finally:
+        stack.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main(*(sys.argv[1:2] or ["smoke-artifacts"])))

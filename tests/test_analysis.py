@@ -403,6 +403,68 @@ def test_baseline_counts_shrink_when_one_of_two_is_fixed(tmp_path):
     assert len(new) == 1
 
 
+# ----------------------------------------------------------------------
+# R5 span discipline
+
+def test_r5_bare_start_span_leaks():
+    fs = run("""
+        from cook_tpu.obs import tracer
+
+        def handler():
+            tracer.start_span("work")
+    """, rules=("R5",))
+    assert rules_of(fs) == ["R5"]
+    assert "context manager" in fs[0].message
+    assert fs[0].symbol == "handler"
+
+
+def test_r5_assigned_but_never_finished():
+    fs = run("""
+        from cook_tpu.obs import tracer
+
+        def handler():
+            sp = tracer.start_span("work")
+            sp.set_attr("k", 1)
+    """, rules=("R5",))
+    assert rules_of(fs) == ["R5"]
+
+
+def test_r5_context_manager_finish_and_return_are_clean():
+    fs = run("""
+        from cook_tpu.obs import tracer
+
+        def ctx():
+            with tracer.start_span("a") as sp:
+                sp.set_attr("k", 1)
+
+        def finished():
+            sp = tracer.start_span("b")
+            try:
+                pass
+            finally:
+                sp.finish()
+
+        def factory():
+            sp = tracer.start_span("c")
+            return sp
+
+        def attr_owner(self):
+            self.sp = tracer.start_span("d")
+            self.sp.finish()
+    """, rules=("R5",))
+    assert fs == []
+
+
+def test_r5_suppression():
+    fs = run("""
+        from cook_tpu.obs import tracer
+
+        def handler():
+            tracer.start_span("work")  # cookcheck: disable=R5
+    """, rules=("R5",))
+    assert fs == []
+
+
 def test_syntax_error_reports_r0():
     fs = analyze_source("def broken(:\n", "bad.py")
     assert rules_of(fs) == ["R0"]
