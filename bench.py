@@ -1051,6 +1051,58 @@ def bench_trace_overhead(out_path="/tmp/cook_trace.json",
     }), flush=True)
 
 
+def bench_chaos_overhead(cycles=120, warmup=20):
+    """A/B the chaos fault-injection hooks on the e2e coordinator path.
+
+    Chaos must be free when disarmed: every site is compiled into the
+    production code, so the disabled branch has to cost one attribute
+    read. This mode runs the SAME small e2e config twice in one
+    process — controller disabled (the production default), then armed
+    with zero-probability sites on the store hot path (per-append lock
+    + rng draw, the worst armed case short of actually injecting
+    faults) — and publishes overhead_ok against the same 2% budget the
+    flight recorder answers to. Both runs share the in-process JAX
+    compile cache, so the diff is the chaos plumbing's own cost."""
+    from cook_tpu import chaos
+
+    cfg = dict(P0=20_000, H=2_000, cycles=cycles, warmup=warmup)
+    # probabilities of exactly 0: every armed draw walks the full
+    # ladder and comes back ACT_NONE, so behavior is unchanged while
+    # the bookkeeping (lock, rng, event ring) is fully exercised
+    benign = {"store.append": {"delay": 0.0},
+              "store.fsync": {"delay": 0.0}}
+    runs = {}
+    for mode in ("disabled", "armed"):
+        chaos.controller.reset()
+        if mode == "armed":
+            chaos.controller.configure(seed=7, sites=benign)
+        stats = {}
+        bench_e2e(label=f"chaos-overhead [{mode}] @ 20k-pending x "
+                        "2k-offers", stats_out=stats, **cfg)
+        runs[mode] = stats
+    armed = chaos.controller.stats()
+    # the event ring records every draw (none included); its fill level
+    # proves the armed run actually exercised the sites
+    armed_draws = len(chaos.controller.events_snapshot())
+    chaos.controller.reset()    # never leave the process armed
+    dps_off = float(runs["disabled"]["value"])
+    dps_on = float(runs["armed"]["value"])
+    overhead = ((dps_off - dps_on) / dps_off * 100.0) if dps_off else 0.0
+    print(json.dumps({
+        "metric": "chaos hooks overhead, e2e @ 20k-pending x 2k-offers",
+        "value": round(overhead, 2),
+        "unit": "% decisions/sec lost with chaos armed (benign sites)",
+        "budget_pct": 2.0,
+        "overhead_ok": overhead <= 2.0,
+        "decisions_per_sec_disabled": dps_off,
+        "decisions_per_sec_armed": dps_on,
+        "p99_cycle_ms_disabled": runs["disabled"]["p99_cycle_ms"],
+        "p99_cycle_ms_armed": runs["armed"]["p99_cycle_ms"],
+        "armed_draws": armed_draws,
+        "armed_stats": armed,
+    }), flush=True)
+
+
 def bench_pallas():
     """Real-TPU A/B of the Pallas kernels vs the XLA lowering (VERDICT
     r2 #2: prove a win or drop it): the batched headline cycle (dense
@@ -1154,13 +1206,18 @@ def main():
         # A/B of the obs flight recorder on the e2e path + Chrome-trace
         # export; optional argv[2] = output JSON path
         bench_trace_overhead(*(sys.argv[2:3] or ["/tmp/cook_trace.json"]))
+    elif which == "chaos-overhead":
+        # A/B of the chaos fault-injection hooks (disabled vs armed
+        # with zero-probability sites) on the e2e path
+        bench_chaos_overhead()
     elif which == "pallas":
         bench_pallas()
     else:
         raise SystemExit(f"unknown config {which!r}; one of: headline "
                          "contended small pools rebalance stream e2e "
                          "e2e-small e2e-batched e2e-async longevity "
-                         "longevity-async trace-overhead pallas")
+                         "longevity-async trace-overhead chaos-overhead "
+                         "pallas")
 
 
 if __name__ == "__main__":

@@ -37,8 +37,19 @@ from typing import Optional
 from cook_tpu.agent.executor import Executor
 from cook_tpu.agent.file_server import FileServer
 from cook_tpu.utils.httpjson import json_request
+from cook_tpu.utils.metrics import registry as metrics_registry
+from cook_tpu.utils.retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
+
+# coordinator-bound RPC path -> chaos injection site (utils/httpjson
+# applies the fault; empty-string sites are free)
+_CHAOS_SITES = {
+    "/agents/register": "agent.register",
+    "/agents/heartbeat": "agent.heartbeat",
+    "/agents/status": "agent.status_post",
+    "/agents/progress": "agent.progress_post",
+}
 
 
 class AgentDaemon:
@@ -60,7 +71,8 @@ class AgentDaemon:
                  attributes: Optional[dict] = None,
                  advertise_host: str = "127.0.0.1",
                  agent_token: str = "",
-                 bind_host: str = "127.0.0.1"):
+                 bind_host: str = "127.0.0.1",
+                 outbox_max: int = 256):
         self._urls = [u.strip().rstrip("/")
                       for u in coordinator_url.split(",") if u.strip()]
         if not self._urls:
@@ -71,9 +83,24 @@ class AgentDaemon:
         # threads concurrently: all failover-state mutation is locked
         self._url_lock = threading.Lock()
         # terminal statuses that couldn't be delivered (leaderless
-        # window); flushed after each successful heartbeat
+        # window); flushed after each successful heartbeat. Bounded:
+        # a coordinator outage longer than outbox_max terminal events
+        # drops the OLDEST (the coordinator's heartbeat-diff safety net
+        # will eventually fail those tasks anyway); drops are counted
+        # in agent.outbox_dropped and self.outbox_dropped.
         self._outbox: list[dict] = []
         self._outbox_lock = threading.Lock()
+        self.outbox_max = int(outbox_max)
+        self.outbox_dropped = 0
+        # delivery policies: statuses get a few jittered tries, the
+        # blocking register loop retries until shutdown (the daemon is
+        # useless unregistered, so there is no deadline)
+        self._status_policy = RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.2,
+                                          max_delay_s=5.0)
+        self._register_policy = RetryPolicy(max_attempts=0,
+                                            base_delay_s=0.2,
+                                            max_delay_s=5.0)
         # task_id -> trace context + locally-timed span bounds: the
         # daemon has no tracer of its own — it echoes the launch spec's
         # traceparent and its wall-clock launch/run windows back on
@@ -181,20 +208,27 @@ class AgentDaemon:
         }
 
     def _register(self, block: bool = False) -> None:
-        backoff = 0.2
-        while not self._stop.is_set():
+        def attempt():
+            self._post("/agents/register", self._register_payload())
+
+        if block:
             try:
-                self._post("/agents/register", self._register_payload())
-                logger.info("registered with %s as %s",
-                            self.coordinator_url, self.hostname)
-                return
-            except Exception as e:
-                if not block:
-                    raise
-                logger.warning("register failed (%s); retrying in %.1fs",
-                               e, backoff)
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
+                # every failure retries here (even a 4xx: the daemon
+                # has nothing better to do than wait out a coordinator
+                # that is mid-upgrade or mid-election)
+                self._register_policy.call(
+                    attempt, retryable=lambda _e: True,
+                    should_abort=self._stop.is_set,
+                    on_retry=lambda n, e: logger.warning(
+                        "register failed (%s); attempt %d", e, n))
+            except BaseException:
+                if self._stop.is_set():
+                    return  # shutdown interrupted the loop; stay quiet
+                raise
+        else:
+            attempt()
+        logger.info("registered with %s as %s",
+                    self.coordinator_url, self.hostname)
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
@@ -245,15 +279,30 @@ class AgentDaemon:
             # after the next successful register/heartbeat
             with self._outbox_lock:
                 self._outbox.append(payload)
+                self._trim_outbox_locked()
             logger.warning("queued undelivered status for %s", task_id)
+
+    def _trim_outbox_locked(self) -> None:
+        while len(self._outbox) > self.outbox_max:
+            dropped = self._outbox.pop(0)
+            self.outbox_dropped += 1
+            metrics_registry.counter("agent.outbox_dropped").inc()
+            logger.warning("outbox full (%d): dropped oldest status for "
+                           "%s", self.outbox_max,
+                           dropped.get("task_id"))
 
     def _flush_outbox(self) -> None:
         with self._outbox_lock:
             pending, self._outbox = self._outbox, []
-        for payload in pending:
+        for i, payload in enumerate(pending):
             if not self._post_retry("/agents/status", payload, attempts=1):
+                # redeliver in arrival order: stop at the first failure
+                # and put the unsent remainder back at the FRONT, so
+                # statuses queued while we flushed stay behind them
                 with self._outbox_lock:
-                    self._outbox.append(payload)
+                    self._outbox[0:0] = pending[i:]
+                    self._trim_outbox_locked()
+                return
 
     def _on_progress(self, task_id: str, sequence: int, percent: int,
                      message: str) -> None:
@@ -306,7 +355,8 @@ class AgentDaemon:
             url = self.coordinator_url
             try:
                 return json_request("POST", url + path, payload,
-                                    headers=headers)
+                                    headers=headers,
+                                    chaos_site=_CHAOS_SITES.get(path, ""))
             except urllib.error.HTTPError as e:
                 if e.code != 503:
                     raise
@@ -327,19 +377,18 @@ class AgentDaemon:
 
     def _post_retry(self, path: str, payload: dict,
                     attempts: int = 3) -> bool:
-        delay = 0.2
-        for i in range(attempts):
-            try:
-                self._post(path, payload)
-                return True
-            except Exception as e:
-                if i == attempts - 1:
-                    logger.warning("status post %s undelivered after %d "
-                                   "attempts: %s", path, attempts, e)
-                    return False
-                time.sleep(delay)
-                delay *= 2
-        return False
+        policy = self._status_policy if attempts == 3 \
+            else RetryPolicy(max_attempts=attempts,
+                             base_delay_s=self._status_policy.base_delay_s,
+                             max_delay_s=self._status_policy.max_delay_s)
+        try:
+            policy.call(lambda: self._post(path, payload),
+                        should_abort=self._stop.is_set)
+            return True
+        except Exception as e:
+            logger.warning("post %s undelivered after %d attempt(s): %s",
+                           path, attempts, e)
+            return False
 
     # -- coordinator-issued work ---------------------------------------
     def handle_launch(self, payload: dict) -> dict:

@@ -22,7 +22,7 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 
 # which rule families run over which package subdirectories when
 # scanning a tree (explicit file arguments get every AST rule)
@@ -32,6 +32,8 @@ RULE_DIRS = {
     "R3": ("rest", "backends", "scheduler", "integrations"),
     "R5": ("obs", "scheduler", "rest", "backends", "agent", "state",
            "utils"),
+    "R6": ("agent", "backends", "scheduler", "rest", "state", "utils",
+           "integrations", "plugins", "obs"),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -161,11 +163,12 @@ def diff_baseline(findings: list[Finding], baseline: dict[str, int]
 # analysis drivers
 
 def analyze_source(source: str, path: str,
-                   rules: Iterable[str] = ("R1", "R2", "R3", "R5"),
+                   rules: Iterable[str] = ("R1", "R2", "R3", "R5", "R6"),
                    apply_suppressions: bool = True) -> list[Finding]:
     """Run the per-module AST rules over one source text."""
     from cook_tpu.analysis import (async_hygiene, lock_discipline,
-                                   span_discipline, trace_purity)
+                                   retry_discipline, span_discipline,
+                                   trace_purity)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -182,6 +185,8 @@ def analyze_source(source: str, path: str,
         findings += async_hygiene.check(mod)
     if "R5" in rules:
         findings += span_discipline.check(mod)
+    if "R6" in rules:
+        findings += retry_discipline.check(mod)
     if apply_suppressions:
         sup = collect_suppressions(source)
         findings = [f for f in findings if not suppressed(f, sup)]

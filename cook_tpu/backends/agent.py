@@ -30,7 +30,10 @@ from typing import Optional
 from cook_tpu import obs
 from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
 from cook_tpu.state.model import InstanceStatus, now_ms
+from cook_tpu.utils.breaker import (
+    BreakerOpenError, CircuitBreaker, CLOSED, OPEN)
 from cook_tpu.utils.httpjson import json_request
+from cook_tpu.utils.metrics import registry as metrics_registry
 
 logger = logging.getLogger(__name__)
 
@@ -61,12 +64,20 @@ class AgentCluster(ComputeCluster):
                  request_timeout_s: float = 10.0,
                  lost_task_grace_s: float = 5.0,
                  agent_token: str = "",
-                 task_lookup=None):
+                 task_lookup=None,
+                 breaker_failures: int = 3,
+                 breaker_reset_s: float = 30.0):
         self.name = name
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.request_timeout_s = request_timeout_s
         self.lost_task_grace_s = lost_task_grace_s
         self.agent_token = agent_token
+        self.breaker_failures = breaker_failures
+        self.breaker_reset_s = breaker_reset_s
+        # hostname -> CircuitBreaker over coordinator->agent RPCs: a
+        # host that black-holes requests stops receiving offers (OPEN)
+        # instead of costing a request_timeout_s stall per launch cycle
+        self._breakers: dict[str, CircuitBreaker] = {}
         self.progress = progress_aggregator
         self.heartbeats = heartbeats
         # task_id -> (Job, Instance) or None, consulted before declaring
@@ -114,6 +125,12 @@ class AgentCluster(ComputeCluster):
                 # polls offer_generation to learn the host set changed
                 self.bump_offer_generation()
             self.agents[hostname] = info
+            # a (re)registered agent gets a clean breaker: registration
+            # proves the process is back even if its old URL was
+            # black-holing, and a stale-open breaker would starve the
+            # fresh agent of offers for a full reset timeout
+            if hostname in self._breakers:
+                self._breakers[hostname].record_success()
             lost = [tid for tid, (_, h, t0) in self._specs.items()
                     if h == hostname and tid not in reported
                     and t0 < grace_cutoff]
@@ -309,6 +326,14 @@ class AgentCluster(ComputeCluster):
             for info in self.agents.values():
                 if not info.alive or info.pool != pool:
                     continue
+                br = self._breakers.get(info.hostname)
+                if br is not None and br.state == OPEN:
+                    # black-holing host: no offers until the reset
+                    # timeout elapses. HALF_OPEN hosts DO get offers —
+                    # the next launch there is the probe (nothing else
+                    # posts to an idle agent, so withholding offers
+                    # would leave the breaker half-open forever)
+                    continue
                 used_mem = used_cpus = used_gpus = 0.0
                 for spec, h, _ in self._specs.values():
                     if h == info.hostname:
@@ -347,7 +372,8 @@ class AgentCluster(ComputeCluster):
                 continue
             try:
                 self._post(info.url + "/launch", {
-                    "specs": [_spec_wire(s) for s in host_specs]})
+                    "specs": [_spec_wire(s) for s in host_specs]},
+                    hostname=hostname, chaos_site="backend.launch")
             except Exception as e:
                 logger.warning("launch to agent %s failed: %s", hostname, e)
                 for s in host_specs:
@@ -356,7 +382,9 @@ class AgentCluster(ComputeCluster):
                     # the heartbeat orphan reconciliation is the backstop
                     try:
                         self._post(info.url + "/kill",
-                                   {"task_id": s.task_id})
+                                   {"task_id": s.task_id},
+                                   hostname=hostname,
+                                   chaos_site="backend.kill")
                     except Exception:
                         pass
                     self._forget(s.task_id)
@@ -374,7 +402,8 @@ class AgentCluster(ComputeCluster):
         if info is None:
             return
         try:
-            self._post(info.url + "/kill", {"task_id": task_id})
+            self._post(info.url + "/kill", {"task_id": task_id},
+                       hostname=hostname, chaos_site="backend.kill")
         except Exception as e:
             # the agent is unreachable: the watchdog will fail the task
             # host-lost when the heartbeat lapses
@@ -438,14 +467,51 @@ class AgentCluster(ComputeCluster):
                 "mem": a.mem, "cpus": a.cpus, "gpus": a.gpus,
                 "alive": a.alive,
                 "last_heartbeat_ms": a.last_heartbeat_ms,
+                "breaker": self._breakers[a.hostname].snapshot()
+                if a.hostname in self._breakers
+                else {"state": CLOSED, "consecutive_failures": 0,
+                      "trips": 0},
             } for a in self.agents.values()]
 
-    def _post(self, url: str, payload: dict) -> dict:
+    def breaker_snapshots(self) -> dict[str, dict]:
+        """hostname -> breaker state, for /debug."""
+        with self._lock:
+            return {h: b.snapshot() for h, b in self._breakers.items()}
+
+    def _breaker(self, hostname: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(hostname)
+            if br is None:
+                br = CircuitBreaker(
+                    failure_threshold=self.breaker_failures,
+                    reset_timeout_s=self.breaker_reset_s)
+                self._breakers[hostname] = br
+            return br
+
+    def _post(self, url: str, payload: dict, hostname: str = "",
+              chaos_site: str = "") -> dict:
+        br = self._breaker(hostname) if hostname else None
+        if br is not None and not br.allow():
+            raise BreakerOpenError(f"agent {hostname}: circuit open")
         headers = {}
         if self.agent_token:
             headers["X-Cook-Agent-Token"] = self.agent_token
-        return json_request("POST", url, payload, headers=headers,
-                            timeout=self.request_timeout_s)
+        try:
+            resp = json_request("POST", url, payload, headers=headers,
+                                timeout=self.request_timeout_s,
+                                chaos_site=chaos_site)
+        except Exception:
+            if br is not None:
+                before = br.trips
+                br.record_failure()
+                if br.trips > before:
+                    metrics_registry.counter("agent.breaker_trips").inc()
+                    logger.warning("circuit breaker OPEN for agent %s",
+                                   hostname)
+            raise
+        if br is not None:
+            br.record_success()
+        return resp
 
 
 def _spec_wire(s: LaunchSpec) -> dict:

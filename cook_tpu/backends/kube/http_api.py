@@ -531,7 +531,11 @@ class HttpKube(KubeApi):
         backoff = backoff_lo
         rv: Optional[str] = None
         known: dict = {}
-        while not self._stopping.is_set():
+        # Intentional infinite watch-reconnect loop, not a bounded
+        # retry: shutdown-aware via _stopping, honors Retry-After on
+        # 429, resets backoff on clean EOF. RetryPolicy's bounded
+        # attempts/deadline semantics do not fit a lifelong watch.
+        while not self._stopping.is_set():  # cookcheck: disable=R6
             try:
                 if rv is None:
                     rv, known = self._relist(path, translate, cb, known,

@@ -129,10 +129,16 @@ class SchedulerSettings:
     # hash-sharded in-order status executors (scheduler.clj:1524-1546);
     # 0 = inline on the backend callback thread
     status_shards: int = 19
+    # launch-ack watchdog (coordinator): instance launched but never
+    # acknowledged RUNNING within this window fails 5003 (mea-culpa)
+    # and requeues; must exceed the worst honest fetch+start time
+    launch_ack_timeout_s: float = 300.0
 
     def validate(self) -> None:
         if self.max_jobs_considered < 1:
             raise ConfigError("max_jobs_considered must be >= 1")
+        if self.launch_ack_timeout_s <= 0:
+            raise ConfigError("launch_ack_timeout_s must be > 0")
         if not 0 < self.scaleback <= 1:
             raise ConfigError("scaleback must be in (0, 1]")
         if self.rebalancer_candidate_cap < 0:
@@ -143,6 +149,27 @@ class SchedulerSettings:
             raise ConfigError(
                 f"use_pallas must be true, false or 'auto'; "
                 f"got {self.use_pallas!r}")
+
+
+@dataclass
+class ChaosSettings:
+    """Deterministic fault injection (cook_tpu.chaos). Disabled unless
+    both `enabled` and at least one site are set; COOK_CHAOS_SITES /
+    COOK_CHAOS_SEED env vars override this section at server start
+    (the chaos-soak CI job uses the env path)."""
+    enabled: bool = False
+    seed: int = 0
+    # site name -> {drop/delay/error/duplicate/torn: prob,
+    #               delay_ms, error_status} (see cook_tpu/chaos)
+    sites: dict = field(default_factory=dict)
+
+    def validate(self) -> None:
+        from cook_tpu import chaos as _chaos
+        for name, spec in self.sites.items():
+            try:
+                _chaos._Site(dict(spec or {}), self.seed, name)
+            except (TypeError, ValueError) as e:
+                raise ConfigError(f"chaos.sites[{name!r}]: {e}")
 
 
 @dataclass
@@ -166,6 +193,7 @@ class Settings:
     auth: AuthSettings = field(default_factory=AuthSettings)
     task_constraints: TaskConstraintSettings = field(
         default_factory=TaskConstraintSettings)
+    chaos: ChaosSettings = field(default_factory=ChaosSettings)
     rate_limits: dict = field(default_factory=dict)
     # {user_submit|user_launch|global_launch: RateLimitSettings}
     log_path: Optional[str] = None
@@ -224,7 +252,8 @@ class Settings:
             raise ConfigError(f"unknown config keys: {sorted(unknown)}")
         s = cls(**{k: v for k, v in raw.items()
                    if k not in ("pools", "clusters", "scheduler", "auth",
-                                "task_constraints", "rate_limits")})
+                                "task_constraints", "rate_limits",
+                                "chaos")})
         s.pools = [PoolSettings(**p) for p in raw.get("pools", [])]
         s.clusters = [ClusterSettings(**c) for c in
                       raw.get("clusters", [asdict(ClusterSettings())])]
@@ -232,6 +261,7 @@ class Settings:
         s.auth = AuthSettings(**raw.get("auth", {}))
         s.task_constraints = TaskConstraintSettings(
             **raw.get("task_constraints", {}))
+        s.chaos = ChaosSettings(**raw.get("chaos", {}))
         s.rate_limits = {k: RateLimitSettings(**v)
                          for k, v in raw.get("rate_limits", {}).items()}
         s.validate()
@@ -258,6 +288,7 @@ class Settings:
             c.validate()
         self.scheduler.validate()
         self.auth.validate()
+        self.chaos.validate()
         # a write-capable machine channel must not default open: an
         # agent cluster without an agent token is only a dev setup
         if any(c.kind == "agent" for c in self.clusters) \

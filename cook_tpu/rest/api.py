@@ -1022,6 +1022,9 @@ class CookApi:
                 clusters[cluster.name] = {
                     "kind": type(cluster).__name__,
                     "hosts": hosts, "tasks": tasks}
+                if hasattr(cluster, "breaker_snapshots"):
+                    clusters[cluster.name]["breakers"] = \
+                        cluster.breaker_snapshots()
             # locked point-in-time copy: a bare list(deque) here races
             # the consumer thread's appends ("deque mutated during
             # iteration" -> intermittent /debug 500s under load)
@@ -1050,10 +1053,16 @@ class CookApi:
         # coordinator's live dict
         metrics = self.coord.metrics_snapshot() \
             if self.coord is not None else {}
-        return Response(200, {"healthy": True, "version": VERSION,
-                              "clusters": clusters,
-                              "metrics": metrics,
-                              "consume_trace": consume})
+        body = {"healthy": True, "version": VERSION,
+                "clusters": clusters,
+                "metrics": metrics,
+                "consume_trace": consume}
+        from cook_tpu import chaos
+        if chaos.controller.enabled:
+            # operators must be able to tell an injected outage from a
+            # real one at a glance
+            body["chaos"] = chaos.controller.stats()
+        return Response(200, body)
 
     def get_trace(self, req: Request, uuid: str) -> Response:
         """Assembled span tree for one job's lifecycle: REST submit ->

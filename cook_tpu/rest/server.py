@@ -181,6 +181,18 @@ def build_scheduler(config, read_only=False):
     if isinstance(config, dict):
         config = Settings.from_dict(config)
 
+    # fault injection (cook_tpu.chaos): armed BEFORE the store restores
+    # so even boot-time appends run under the schedule. Env overrides
+    # the settings section (the chaos-soak CI job uses the env path);
+    # the production default leaves the controller disabled and every
+    # site check on its zero-overhead path.
+    from cook_tpu import chaos
+    if not chaos.controller.configure_from_env() and config.chaos.enabled:
+        chaos.controller.configure(seed=config.chaos.seed,
+                                   sites=config.chaos.sites)
+    if chaos.controller.enabled:
+        log.warning("CHAOS ENABLED: %s", chaos.controller.stats())
+
     # In an HA deployment the log is shared and a live leader may be
     # mid-append while this (standby) process boots: trimming a torn
     # tail would truncate under its writer. A standby replays up to the
@@ -287,7 +299,8 @@ def build_scheduler(config, read_only=False):
                 candidate_cap=s.rebalancer_candidate_cap),
             sequential_match_threshold=s.sequential_match_threshold,
             use_pallas=_resolve_use_pallas(s.use_pallas,
-                                           s.max_jobs_considered)),
+                                           s.max_jobs_considered),
+            launch_ack_timeout_s=s.launch_ack_timeout_s),
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,

@@ -99,6 +99,12 @@ class SchedulerConfig:
     # uncommitted jobs older than this are purged by the watchdog
     # (clear-uncommitted-jobs uses "-7 days", tools.clj:752)
     uncommitted_gc_age_ms: int = 7 * 24 * 3600 * 1000
+    # launch-ack watchdog: an instance launched but never acknowledged
+    # RUNNING within this window is failed 5003 (mea-culpa) and
+    # requeued — the backend swallowed the task. Must exceed the worst
+    # honest fetch+start time (image pulls, uri downloads); reconcile()
+    # can't cover this case because it only resyncs RUNNING instances
+    launch_ack_timeout_s: float = 300.0
 
 
 @dataclass
@@ -1149,11 +1155,21 @@ class Coordinator:
         stats = MatchStats()
         self._purge_reservations()
 
-        # gather offers from every cluster (scheduler.clj:977-985)
+        # gather offers from every cluster (scheduler.clj:977-985); a
+        # degraded cluster loses its turn, not the whole cycle — the
+        # remaining clusters' jobs must keep scheduling
         offers: list[Offer] = []
         offer_cluster: dict[str, str] = {}
         for cluster in self.clusters.all():
-            for o in cluster.pending_offers(pool):
+            try:
+                cluster_offers = cluster.pending_offers(pool)
+            except Exception:
+                log.exception("cluster %s offers failed; skipping it "
+                              "this cycle", cluster.name)
+                metrics_registry.counter(
+                    f"match.{pool}.cluster_skipped").inc()
+                continue
+            for o in cluster_offers:
                 offers.append(o)
                 offer_cluster[o.hostname] = cluster.name
         pending = self.store.pending_jobs(pool)
@@ -1350,17 +1366,22 @@ class Coordinator:
                 cname: self._launch_pool.submit(
                     self.clusters.get(cname).launch_tasks, pool, specs)
                 for cname, specs in by_cluster.items()}
-            # retrieve EVERY outcome before surfacing any error — a
-            # second cluster's failure must not vanish unretrieved
-            errors = []
+            # retrieve EVERY outcome — a second cluster's failure must
+            # not vanish unretrieved. A failed cluster no longer aborts
+            # the cycle (one stalled backend must not wedge the match
+            # loop): its instances either got FAILED statuses from the
+            # backend contract, or sit in UNKNOWN until the launch-ack
+            # watchdog fails them 5003 and requeues.
+            errors = 0
             for cname, f in futures.items():
                 try:
                     f.result()
-                except Exception as e:
+                except Exception:
                     log.exception("launch to cluster %s failed", cname)
-                    errors.append(e)
+                    errors += 1
             if errors:
-                raise errors[0]
+                metrics_registry.counter(
+                    f"match.{pool}.cluster_launch_errors").inc(errors)
         stats.matched = launched
         t_launch1 = time.perf_counter()
         if traced:
@@ -1888,11 +1909,26 @@ class Coordinator:
     # watchdog killers (scheduler.clj:1114-1240, group.clj:17-45)
     def watchdog_cycle(self, wall_ms: Optional[int] = None) -> dict:
         wall_ms = wall_ms or now_ms()
-        killed_lingering, killed_straggler = [], []
+        killed_lingering, killed_straggler, killed_unacked = [], [], []
+        ack_cutoff = wall_ms - int(self.config.launch_ack_timeout_s * 1000)
         for job in list(self.store.jobs.values()):
             if job.state != JobState.RUNNING:
                 continue
             for inst in job.active_instances:
+                if inst.status == InstanceStatus.UNKNOWN:
+                    # launched but never acknowledged RUNNING: the
+                    # launch-ack watchdog owns this instance. Max-runtime
+                    # (4000, NOT mea-culpa) must never burn a real
+                    # attempt on a task whose command never ran — 5003
+                    # is mea-culpa, so the requeue is free (up to its
+                    # failure_limit).
+                    if inst.start_time_ms < ack_cutoff:
+                        self.store.update_instance(
+                            inst.task_id, InstanceStatus.FAILED,
+                            reason_code=5003)
+                        self._backend_kill(inst.task_id)
+                        killed_unacked.append(inst.task_id)
+                    continue
                 runtime = wall_ms - inst.start_time_ms
                 if runtime > job.max_runtime_ms:
                     self.store.update_instance(
@@ -1936,6 +1972,7 @@ class Coordinator:
         gced = self.store.gc_uncommitted(self.config.uncommitted_gc_age_ms)
         return {"lingering": killed_lingering,
                 "stragglers": killed_straggler,
+                "launch_ack": killed_unacked,
                 "uncommitted_gced": gced}
 
     def _backend_kill(self, task_id: str, preempt: bool = False) -> None:

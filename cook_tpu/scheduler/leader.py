@@ -347,7 +347,8 @@ class LeaseElector(LeaderElector):
                 self._lease_body(
                     transitions,
                     lease.get("metadata", {}).get("resourceVersion")),
-                headers=self._headers(), timeout=5.0)
+                headers=self._headers(), timeout=5.0,
+                chaos_site="leader.acquire")
             with self._state_lock:
                 self._observed = (self.url, time.time())
             self.epoch = transitions + 1
@@ -367,12 +368,16 @@ class LeaseElector(LeaderElector):
                     lease.get("spec", {}).get("holderIdentity") \
                     != self.identity:
                 return False
+            # chaos "error"/"drop" here surfaces as a failed renewal:
+            # the campaign loop's freshness fencing (0.2x-duration
+            # margin) must step down before a rival can win the lease
             json_request(
                 "PUT", self.base + self._path(),
                 self._lease_body(
                     int(lease["spec"].get("leaseTransitions", 0)),
                     lease.get("metadata", {}).get("resourceVersion")),
-                headers=self._headers(), timeout=5.0)
+                headers=self._headers(), timeout=5.0,
+                chaos_site="leader.renew")
             with self._state_lock:
                 self._observed = (self.url, time.time())
             return True

@@ -86,6 +86,15 @@ REASONS = [
            mea_culpa=True, failure_limit=3),
     Reason(5002, "killed-externally", "Container killed externally",
            mea_culpa=True, failure_limit=3),
+    # cook_tpu extension (no reference equivalent; PARITY.md §5): the
+    # coordinator's launch-ack watchdog fails an instance that was
+    # launched but never acknowledged RUNNING within
+    # launch_ack_timeout_s — the backend swallowed the task. Mea-culpa:
+    # the user's command never ran, so the retry must be free (bounded,
+    # like host-lost, so a systematically black-holing cluster cannot
+    # retry forever).
+    Reason(5003, "launch-ack-timeout", "Launch not acknowledged in time",
+           mea_culpa=True, failure_limit=3),
     Reason(6000, "unknown", "Unknown failure"),
     Reason(99000, "scheduling-failed", "Could not launch task",
            mea_culpa=True, failure_limit=None),

@@ -27,6 +27,9 @@ import os
 import queue
 import re
 import threading
+import time
+
+from cook_tpu import chaos
 from dataclasses import asdict, dataclass
 from typing import Any, Callable, Iterable, Optional
 
@@ -238,6 +241,21 @@ class JobStore:
         gate = getattr(self, "append_gate", None)
         if gate is not None and not gate():
             raise NotLeaderError("write fenced: not the leader")
+        if chaos.controller.enabled:
+            a = chaos.controller.act("store.append")
+            if a.kind == "torn":
+                # persist a truncated record, then fail the transaction
+                # — disk-wise this is a crash mid-append (the writer
+                # still terminates the line, so restore sees a
+                # complete-but-corrupt final record, the case _replay's
+                # torn-tail recovery must skip; an UNterminated tail is
+                # already handled by _trim_torn_tail)
+                self._log.append(line[:max(1, len(line) // 2)])
+                raise OSError("chaos[store.append]: torn write")
+            if a.kind == "error":
+                raise OSError("chaos[store.append]: write failed")
+            if a.kind == "delay":
+                time.sleep(a.delay_s)
         self._log.append(line)
 
     def _epoch_suffix(self) -> str:
@@ -307,6 +325,15 @@ class JobStore:
         if w is None or not hasattr(w, "sync"):
             return
         try:
+            if chaos.controller.enabled:
+                a = chaos.controller.act("store.fsync")
+                if a.kind == "delay":
+                    time.sleep(a.delay_s)
+                elif a.kind:
+                    # raised INSIDE the try so the injected fsync
+                    # failure takes the same still-live-writer verdict
+                    # path as a real one
+                    raise OSError("chaos[store.fsync]: injected failure")
             w.sync()
         except Exception:
             with self._lock:
@@ -1287,9 +1314,23 @@ class JobStore:
                     consumed = lineno + 1
                     if not line.strip():
                         continue
-                    # torn tails are truncated before replay; any decode
-                    # error here is real corruption and must surface
-                    ev = json.loads(line)
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        # UNterminated torn tails are truncated before
+                        # replay (_trim_torn_tail); a complete-but-
+                        # corrupt FINAL record is the other crash shape
+                        # (power cut mid-append on a filesystem that
+                        # persisted the newline first): log + skip it —
+                        # the transaction it encoded never acked.
+                        # Anything corrupt MID-log means real damage
+                        # and must surface.
+                        if f.read().strip():
+                            raise
+                        log.warning(
+                            "replay: dropping corrupt final record at "
+                            "line %d of %s", lineno + 1, log_path)
+                        break
                     self._apply_event(ev)
         finally:
             self._replaying = False

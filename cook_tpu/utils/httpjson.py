@@ -2,23 +2,91 @@
 
 One place for the POST-a-dict/parse-a-dict pattern used by the agent
 control plane on both sides; keeps timeout and decode behavior from
-drifting between copies.
+drifting between copies. Being the single transport choke point also
+makes it the natural home for two cross-cutting concerns:
+
+* **Typed failures**: HTTP error responses raise `HttpJsonError`, which
+  subclasses `urllib.error.HTTPError` (so every existing `except
+  HTTPError` site keeps working, including `.code` checks and
+  `.read()` of the error body) but additionally exposes ``.status``
+  and the already-read ``.body`` so `utils.retry.RetryPolicy` can stop
+  retrying permanent 4xx without re-reading a consumed stream.
+* **Fault injection**: callers name their `chaos_site` and the module
+  applies transport-level faults (drop / delay / error / duplicate)
+  from `cook_tpu.chaos` in one place, so every RPC in the repo is
+  injectable without per-call-site fault code.
 """
 from __future__ import annotations
 
+import io
 import json
+import time
+import urllib.error
 import urllib.request
 from typing import Optional
+
+from cook_tpu import chaos
+
+
+class HttpJsonError(urllib.error.HTTPError):
+    """An HTTP error response with its status and body captured.
+
+    The body is read eagerly: `urllib` error objects wrap the live
+    socket, so a caller that catches, releases, and later `.read()`s
+    would get nothing. Here `.read()` replays from memory.
+    """
+
+    def __init__(self, url: str, status: int, body: bytes,
+                 headers=None):
+        super().__init__(url, status, f"HTTP {status}", headers or {},
+                         io.BytesIO(body))
+        # .status is inherited read-only (mirrors .code); only the
+        # captured body is new state
+        self.body = body
+
+    def __reduce__(self):  # HTTPError's pickle support loses the body
+        return (self.__class__,
+                (self.url, self.status, self.body, None))
 
 
 def json_request(method: str, url: str, body: Optional[dict] = None,
                  headers: Optional[dict] = None, timeout: float = 10.0,
-                 context=None) -> dict:
+                 context=None, chaos_site: str = "") -> dict:
+    if chaos_site:
+        a = chaos.act(chaos_site)
+        if a.kind:
+            if a.kind == "drop":
+                # the request never reaches the wire
+                raise urllib.error.URLError(
+                    f"chaos[{chaos_site}]: dropped")
+            if a.kind == "error":
+                raise HttpJsonError(url, a.status,
+                                    b'{"error": "chaos injected"}')
+            if a.kind == "delay":
+                time.sleep(a.delay_s)
+            elif a.kind == "duplicate":
+                # at-least-once delivery: send once, discard, resend
+                _send(method, url, body, headers, timeout, context)
+
+    return _send(method, url, body, headers, timeout, context)
+
+
+def _send(method: str, url: str, body: Optional[dict],
+          headers: Optional[dict], timeout: float, context) -> dict:
     h = {"Content-Type": "application/json", **(headers or {})}
     req = urllib.request.Request(
         url, data=json.dumps(body).encode() if body is not None else None,
         headers=h, method=method)
-    with urllib.request.urlopen(req, timeout=timeout,
-                                context=context) as resp:
-        raw = resp.read().decode()
-        return json.loads(raw) if raw else {}
+    try:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=context) as resp:
+            raw = resp.read().decode()
+            return json.loads(raw) if raw else {}
+    except HttpJsonError:
+        raise
+    except urllib.error.HTTPError as e:
+        try:
+            payload = e.read() or b""
+        except Exception:
+            payload = b""
+        raise HttpJsonError(url, e.code, payload, e.headers) from None

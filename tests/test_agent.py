@@ -253,6 +253,62 @@ def test_local_cluster_capacity_accounting(local_stack):
     assert offers == [] or offers[0].mem <= 96
 
 
+# -- daemon outbox bounding --------------------------------------------
+def _dead_daemon(tmp_path, **kw):
+    """A daemon pointed at a dead coordinator, never start()ed (the
+    ctor binds sockets but spawns no loops)."""
+    from cook_tpu.agent.daemon import AgentDaemon
+    return AgentDaemon("http://127.0.0.1:1", hostname="box",
+                       sandbox_root=str(tmp_path / "box"),
+                       agent_token="t", **kw)
+
+
+def test_daemon_outbox_bounded_drops_oldest(tmp_path, monkeypatch):
+    from cook_tpu.utils.metrics import registry as metrics_registry
+
+    d = _dead_daemon(tmp_path, outbox_max=3)
+    monkeypatch.setattr(d, "_post_retry", lambda *a, **kw: False)
+    before = metrics_registry.counter("agent.outbox_dropped").value
+    for i in range(5):
+        d._on_status(f"t-{i}", "exited", {"exit_code": 0, "sandbox": ""})
+    # oldest two dropped (the coordinator's heartbeat-diff safety net
+    # eventually fails those tasks anyway); newest three retained
+    assert [p["task_id"] for p in d._outbox] == ["t-2", "t-3", "t-4"]
+    assert d.outbox_dropped == 2
+    assert metrics_registry.counter("agent.outbox_dropped").value == \
+        before + 2
+
+
+def test_daemon_outbox_flush_preserves_arrival_order(tmp_path,
+                                                     monkeypatch):
+    d = _dead_daemon(tmp_path, outbox_max=8)
+    monkeypatch.setattr(d, "_post_retry", lambda *a, **kw: False)
+    for i in range(4):
+        d._on_status(f"t-{i}", "exited", {"exit_code": 0, "sandbox": ""})
+    # coordinator comes back but flakes after two deliveries: the unsent
+    # remainder must go back at the FRONT, still in arrival order
+    sent = []
+
+    def flaky(path, payload, attempts=3):
+        if len(sent) < 2:
+            sent.append(payload["task_id"])
+            return True
+        return False
+
+    monkeypatch.setattr(d, "_post_retry", flaky)
+    d._flush_outbox()
+    assert sent == ["t-0", "t-1"]
+    assert [p["task_id"] for p in d._outbox] == ["t-2", "t-3"]
+    # recovery: the next flush drains the rest in order
+    monkeypatch.setattr(
+        d, "_post_retry",
+        lambda path, payload, attempts=3: sent.append(
+            payload["task_id"]) or True)
+    d._flush_outbox()
+    assert sent == ["t-0", "t-1", "t-2", "t-3"]
+    assert d._outbox == []
+
+
 def test_uri_fetch_into_sandbox(tmp_path):
     """FetchableURIs stage into the sandbox before the command runs:
     copy, executable bit, tar extraction, and failure -> OSError."""

@@ -465,6 +465,110 @@ def test_r5_suppression():
     assert fs == []
 
 
+# ----------------------------------------------------------------------
+# R6 retry discipline
+
+def test_r6_hand_rolled_backoff_loop():
+    fs = run("""
+        import time
+
+        def poll(fetch):
+            delay = 0.5
+            while True:
+                try:
+                    return fetch()
+                except Exception:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 30.0)
+    """, rules=("R6",))
+    assert rules_of(fs) == ["R6"]
+    assert "RetryPolicy" in fs[0].message
+    assert fs[0].symbol == "poll"
+
+
+def test_r6_augassign_and_tuple_handler():
+    fs = run("""
+        import time
+
+        def register(post):
+            backoff = 1.0
+            for _ in range(8):
+                try:
+                    post()
+                    break
+                except (ValueError, Exception):
+                    time.sleep(backoff)
+                    backoff *= 2
+    """, rules=("R6",))
+    assert rules_of(fs) == ["R6"]
+
+
+def test_r6_negatives_narrow_additive_event_paced():
+    fs = run("""
+        import time
+
+        def narrow(fetch):
+            delay = 0.5
+            while True:
+                try:
+                    return fetch()
+                except OSError:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 30.0)
+
+        def additive(fetch):
+            delay = 1.0
+            while True:
+                try:
+                    return fetch()
+                except Exception:
+                    time.sleep(delay)
+                    delay += 1
+
+        def event_paced(fetch, stop):
+            delay = 0.5
+            while not stop.is_set():
+                try:
+                    return fetch()
+                except Exception:
+                    stop.wait(delay)
+                    delay = min(delay * 2, 30.0)
+    """, rules=("R6",))
+    assert fs == []
+
+
+def test_r6_retry_module_exempt_by_path():
+    fs = run("""
+        import time
+
+        def _loop(fn):
+            delay = 0.5
+            while True:
+                try:
+                    return fn()
+                except Exception:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 30.0)
+    """, rules=("R6",), path="cook_tpu/utils/retry.py")
+    assert fs == []
+
+
+def test_r6_suppression_on_loop_line():
+    fs = run("""
+        import time
+
+        def watch(fetch):
+            delay = 0.5
+            while True:  # cookcheck: disable=R6
+                try:
+                    return fetch()
+                except Exception:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 30.0)
+    """, rules=("R6",))
+    assert fs == []
+
+
 def test_syntax_error_reports_r0():
     fs = analyze_source("def broken(:\n", "bad.py")
     assert rules_of(fs) == ["R0"]

@@ -1,0 +1,355 @@
+"""Fault-injection layer + self-healing primitives.
+
+Covers the chaos controller (seeded determinism, zero-overhead disabled
+path, validation, event log), the typed HttpJsonError, the unified
+RetryPolicy, the per-agent circuit breaker (unit + AgentCluster
+integration), chaos-injected storage faults with torn-tail replay
+recovery, and the coordinator's launch-ack watchdog / degraded-pool
+handling. The multi-component soak lives in test_chaos_soak.py.
+"""
+from __future__ import annotations
+
+import json
+import pickle
+import urllib.error
+
+import pytest
+
+from cook_tpu import chaos
+from cook_tpu.backends.agent import AgentCluster
+from cook_tpu.state.model import Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+from cook_tpu.utils.breaker import (
+    BreakerOpenError, CircuitBreaker, CLOSED, HALF_OPEN, OPEN)
+from cook_tpu.utils.httpjson import HttpJsonError, json_request
+from cook_tpu.utils.metrics import registry as metrics_registry
+from cook_tpu.utils.retry import RetryPolicy, default_retryable
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """The module singleton must never leak between tests."""
+    chaos.controller.reset()
+    yield
+    chaos.controller.reset()
+
+
+def mkjob(**kw):
+    return Job(uuid=new_uuid(), user="alice", command="true", mem=10,
+               cpus=1, **kw)
+
+
+# -- chaos controller --------------------------------------------------
+def test_disabled_controller_is_free_shared_noop():
+    c = chaos.ChaosController()
+    a = c.act("anything")
+    assert a is chaos.ACT_NONE and not a.kind
+    assert c.events_snapshot() == []
+    # module-level helper hits the singleton's disabled path too
+    assert chaos.act("agent.status_post") is chaos.ACT_NONE
+
+
+def test_seeded_determinism_per_site():
+    def draws(seed, site, n=60):
+        c = chaos.ChaosController()
+        c.configure(seed=seed, sites={
+            site: {"drop": 0.3, "delay": 0.2, "error": 0.1}})
+        return [c.act(site).kind for _ in range(n)]
+
+    assert draws(7, "s") == draws(7, "s")
+    # reconfiguring the SAME controller replays the same schedule
+    c = chaos.ChaosController()
+    spec = {"s": {"drop": 0.3, "error": 0.2}}
+    c.configure(seed=11, sites=spec)
+    first = [c.act("s").kind for _ in range(40)]
+    c.configure(seed=11, sites=spec)
+    assert [c.act("s").kind for _ in range(40)] == first
+    # a site's stream is independent of other sites' call volume
+    c2 = chaos.ChaosController()
+    c2.configure(seed=11, sites={**spec, "noisy": {"drop": 0.5}})
+    for _ in range(25):
+        c2.act("noisy")
+    assert [c2.act("s").kind for _ in range(40)] == first
+    assert draws(7, "s") != draws(8, "s")
+
+
+def test_unknown_site_and_act_knobs():
+    c = chaos.ChaosController()
+    c.configure(seed=1, sites={"s": {"delay": 1.0, "delay_ms": 120,
+                                     "error_status": 429}})
+    assert c.act("not-configured") is chaos.ACT_NONE
+    a = c.act("s")
+    assert a.kind == "delay"
+    assert a.delay_s == pytest.approx(0.12)
+    assert a.status == 429
+
+
+def test_site_spec_validation():
+    c = chaos.ChaosController()
+    with pytest.raises(ValueError):
+        c.configure(seed=0, sites={"s": {"drop": 0.9, "error": 0.3}})
+    with pytest.raises(ValueError):
+        c.configure(seed=0, sites={"s": {"drop": -0.1}})
+    # empty site map never arms the controller
+    c.configure(seed=0, sites={})
+    assert not c.enabled
+
+
+def test_configure_from_env():
+    c = chaos.ChaosController()
+    assert not c.configure_from_env(env={})
+    env = {"COOK_CHAOS_SITES": json.dumps({"s": {"drop": 1.0}}),
+           "COOK_CHAOS_SEED": "9"}
+    assert c.configure_from_env(env=env)
+    assert c.enabled and c.seed == 9
+    assert c.act("s").kind == "drop"
+
+
+def test_event_log_counts_and_artifact(tmp_path):
+    c = chaos.ChaosController()
+    c.configure(seed=2, sites={"s": {"drop": 1.0}})
+    for _ in range(5):
+        c.act("s")
+    events = c.events_snapshot()
+    assert [e["seq"] for e in events] == [1, 2, 3, 4, 5]
+    assert all(e["action"] == "drop" for e in events)
+    assert c.stats()["injected"] == {"s:drop": 5}
+    path = tmp_path / "events.jsonl"
+    assert c.save_events(str(path)) == 5
+    lines = path.read_text().splitlines()
+    assert len(lines) == 5 and json.loads(lines[0])["site"] == "s"
+
+
+# -- HttpJsonError -----------------------------------------------------
+def test_httpjson_error_compatible_with_httperror():
+    e = HttpJsonError("http://x/y", 404, b'{"error": "nope"}')
+    assert isinstance(e, urllib.error.HTTPError)
+    assert e.code == 404 and e.status == 404
+    # body replays from memory (a raw HTTPError's socket would be dead)
+    assert e.read() == b'{"error": "nope"}'
+    assert json.loads(e.body) == {"error": "nope"}
+    e2 = pickle.loads(pickle.dumps(e))
+    assert e2.status == 404 and e2.body == e.body
+
+
+def test_json_request_chaos_drop_error_delay():
+    # probabilities of 1.0: no network I/O ever happens for drop/error,
+    # so the bogus URL proves the fault fires before the wire
+    chaos.controller.configure(seed=1, sites={
+        "t.drop": {"drop": 1.0},
+        "t.err": {"error": 1.0, "error_status": 418}})
+    with pytest.raises(urllib.error.URLError) as drop_exc:
+        json_request("POST", "http://127.0.0.1:1/x", {},
+                     chaos_site="t.drop")
+    assert not isinstance(drop_exc.value, urllib.error.HTTPError)
+    with pytest.raises(HttpJsonError) as err_exc:
+        json_request("POST", "http://127.0.0.1:1/x", {},
+                     chaos_site="t.err")
+    assert err_exc.value.status == 418
+    # an unnamed site is exempt even while armed (still fails on the
+    # dead socket, but records no chaos event)
+    with pytest.raises(Exception):
+        json_request("POST", "http://127.0.0.1:1/x", {})
+    assert chaos.controller.stats()["injected"] == \
+        {"t.drop:drop": 1, "t.err:error": 1}
+
+
+# -- RetryPolicy -------------------------------------------------------
+def test_retry_backoff_exponential_with_cap():
+    calls, sleeps = [], []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 4:
+            raise ConnectionError("flake")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=9, base_delay_s=0.2, max_delay_s=0.5)
+    assert p.call(fn, sleep=sleeps.append, rng=lambda: 1.0) == "ok"
+    assert len(calls) == 4
+    # rng pinned to 1.0 exposes the caps: 0.2, 0.4, then the 0.5 ceiling
+    assert sleeps == pytest.approx([0.2, 0.4, 0.5])
+    # full jitter: rng=0 collapses every delay to zero
+    calls.clear()
+    sleeps.clear()
+    with pytest.raises(ConnectionError):
+        p.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+               sleep=sleeps.append, rng=lambda: 0.0)
+    assert sleeps == [0.0] * 8
+
+
+def test_retry_permanent_4xx_stops_timing_4xx_retry():
+    assert not default_retryable(HttpJsonError("u", 400, b""))
+    assert not default_retryable(HttpJsonError("u", 404, b""))
+    assert default_retryable(HttpJsonError("u", 408, b""))
+    assert default_retryable(HttpJsonError("u", 429, b""))
+    assert default_retryable(HttpJsonError("u", 503, b""))
+    assert default_retryable(ConnectionError())
+    assert default_retryable(OSError())
+    assert not default_retryable(ValueError())
+
+    calls = []
+
+    def bad_request():
+        calls.append(1)
+        raise HttpJsonError("u", 400, b"malformed")
+
+    p = RetryPolicy(max_attempts=5)
+    with pytest.raises(HttpJsonError):
+        p.call(bad_request, sleep=lambda s: None)
+    assert len(calls) == 1          # permanent: no second attempt
+
+    calls.clear()
+
+    def throttled():
+        calls.append(1)
+        raise HttpJsonError("u", 429, b"")
+
+    with pytest.raises(HttpJsonError):
+        p.call(throttled, sleep=lambda s: None, rng=lambda: 0.0)
+    assert len(calls) == 5          # timing 4xx: retried to exhaustion
+
+
+def test_retry_deadline_bounds_total_time():
+    t = [0.0]
+    calls = []
+
+    def fn():
+        calls.append(1)
+        t[0] += 3.0
+        raise ConnectionError("x")
+
+    p = RetryPolicy(max_attempts=0, base_delay_s=1.0, max_delay_s=1.0,
+                    deadline_s=5.0)
+    with pytest.raises(ConnectionError):
+        p.call(fn, sleep=lambda s: t.__setitem__(0, t[0] + s),
+               rng=lambda: 1.0, clock=lambda: t[0])
+    # attempt 1 ends at t=3 (3+1 <= 5: sleep+retry); attempt 2 ends at
+    # t=7 (7+1 > 5: the deadline refuses a third)
+    assert len(calls) == 2
+
+
+def test_retry_unbounded_with_abort():
+    stop = [False]
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) >= 3:
+            stop[0] = True
+        raise ConnectionError("flake")
+
+    p = RetryPolicy(max_attempts=0, base_delay_s=0.0, max_delay_s=0.0)
+    with pytest.raises(ConnectionError):     # abort re-raises the last
+        p.call(fn, should_abort=lambda: stop[0], sleep=lambda s: None)
+    assert len(calls) == 3
+    with pytest.raises(InterruptedError):    # aborted before attempt 1
+        p.call(lambda: "never", should_abort=lambda: True)
+
+
+# -- circuit breaker ---------------------------------------------------
+def test_circuit_breaker_lifecycle():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
+                        clock=lambda: t[0])
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == CLOSED                # below threshold
+    br.record_failure()
+    assert br.state == OPEN and br.trips == 1
+    assert not br.allow()
+    t[0] = 10.0
+    assert br.state == HALF_OPEN
+    assert br.allow()                        # the single probe slot
+    assert not br.allow()                    # everyone else refused
+    br.record_failure()                      # probe failed: re-open
+    assert br.state == OPEN and br.trips == 2
+    t[0] = 20.0
+    assert br.allow()
+    br.record_success()                      # probe succeeded: close
+    assert br.state == CLOSED and br.allow()
+    assert br.snapshot() == {"state": CLOSED, "consecutive_failures": 0,
+                             "trips": 2}
+
+
+def test_agent_cluster_breaker_excludes_open_host():
+    cluster = AgentCluster(breaker_failures=2, breaker_reset_s=60.0,
+                           request_timeout_s=1.0)
+    reg = {"hostname": "h1", "url": "http://127.0.0.1:1",
+           "mem": 100, "cpus": 4}
+    cluster.register_agent(reg)
+    assert [o.hostname for o in cluster.pending_offers("default")] == \
+        ["h1"]
+    trips_before = metrics_registry.counter("agent.breaker_trips").value
+    for _ in range(2):                       # nothing listens on :1
+        with pytest.raises(Exception):
+            cluster._post("http://127.0.0.1:1/kill", {}, hostname="h1")
+    snap = cluster.breaker_snapshots()["h1"]
+    assert snap["state"] == OPEN and snap["trips"] == 1
+    assert metrics_registry.counter("agent.breaker_trips").value == \
+        trips_before + 1
+    # open host: no offers, and calls short-circuit without the wire
+    assert cluster.pending_offers("default") == []
+    with pytest.raises(BreakerOpenError):
+        cluster._post("http://127.0.0.1:1/kill", {}, hostname="h1")
+    assert cluster.describe_agents()[0]["breaker"]["state"] == OPEN
+    # re-registration proves the process is back: breaker resets
+    cluster.register_agent(reg)
+    assert cluster.breaker_snapshots()["h1"]["state"] == CLOSED
+    assert [o.hostname for o in cluster.pending_offers("default")] == \
+        ["h1"]
+
+
+# -- storage faults + replay recovery ----------------------------------
+def test_store_torn_write_recovered_on_restore(tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    store = JobStore(log_path=log)
+    j1 = mkjob()
+    store.create_jobs([j1])
+    chaos.controller.configure(seed=3, sites={"store.append":
+                                              {"torn": 1.0}})
+    j2 = mkjob()
+    with pytest.raises(OSError):
+        store.create_jobs([j2])              # transaction fails loudly
+    chaos.controller.reset()
+    # disk now ends with a complete-but-corrupt final record; restore
+    # must drop exactly that record and keep everything acked before it
+    restored = JobStore.restore(log_path=log)
+    assert j1.uuid in restored.jobs
+    assert j2.uuid not in restored.jobs
+
+
+def test_store_fsync_fault_fails_the_ack(tmp_path):
+    store = JobStore(log_path=str(tmp_path / "events.jsonl"))
+    chaos.controller.configure(seed=1, sites={"store.fsync":
+                                              {"error": 1.0}})
+    with pytest.raises(OSError):
+        store.create_jobs([mkjob()])
+
+
+def test_replay_mid_log_corruption_raises(tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    store = JobStore(log_path=log)
+    for _ in range(3):
+        store.create_jobs([mkjob()])
+    with open(log) as f:
+        lines = f.read().splitlines()
+    assert len(lines) >= 3
+    lines[1] = lines[1][:len(lines[1]) // 2]   # corrupt a MIDDLE record
+    with open(log, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    # mid-log damage is real corruption, not a crashed append: surface it
+    with pytest.raises(ValueError):
+        JobStore.restore(log_path=log)
+
+
+def test_store_append_delay_site_preserves_behavior(tmp_path):
+    chaos.controller.configure(seed=5, sites={"store.append":
+                                              {"delay": 1.0,
+                                               "delay_ms": 1}})
+    log = str(tmp_path / "events.jsonl")
+    store = JobStore(log_path=log)
+    j = mkjob()
+    store.create_jobs([j])                   # slowed, not broken
+    assert JobStore.restore(log_path=log).jobs[j.uuid].state == \
+        JobState.WAITING

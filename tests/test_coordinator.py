@@ -178,6 +178,158 @@ def test_straggler_kill():
     assert jobs[3].state == JobState.WAITING
 
 
+def test_watchdog_launch_ack_timeout_requeues_mea_culpa():
+    """A backend that swallows the launch leaves the instance UNKNOWN;
+    the launch-ack watchdog fails it 5003 after launch_ack_timeout_s —
+    mea-culpa, so the requeue burns no user retry."""
+    store, cluster, coord = build()
+    cluster.launch_tasks = lambda pool, specs: None   # black hole
+    job = mkjob(max_retries=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    inst = job.instances[0]
+    assert inst.status == InstanceStatus.UNKNOWN
+    # before the cutoff nothing fires
+    out = coord.watchdog_cycle(wall_ms=inst.start_time_ms + 1000)
+    assert out["launch_ack"] == [] and out["lingering"] == []
+    wall = inst.start_time_ms + \
+        int(coord.config.launch_ack_timeout_s * 1000) + 1
+    out = coord.watchdog_cycle(wall_ms=wall)
+    assert out["launch_ack"] == [inst.task_id]
+    assert inst.reason_code == 5003
+    assert job.state == JobState.WAITING
+    assert job.attempts_consumed() == 0
+
+
+def test_watchdog_unacked_instance_never_charged_max_runtime():
+    """4000 (max-runtime, NOT mea-culpa) must not burn an attempt on a
+    command that never ran: UNKNOWN instances belong to the launch-ack
+    pass only."""
+    store, cluster, coord = build()
+    cluster.launch_tasks = lambda pool, specs: None
+    job = mkjob(max_runtime_ms=1, max_retries=1)
+    store.create_jobs([job])
+    coord.match_cycle()
+    inst = job.instances[0]
+    # far past max_runtime_ms but inside the (longer) ack window
+    out = coord.watchdog_cycle(wall_ms=inst.start_time_ms + 10_000)
+    assert out["lingering"] == [] and out["launch_ack"] == []
+    assert inst.status == InstanceStatus.UNKNOWN
+    assert job.attempts_consumed() == 0
+
+
+def test_watchdog_kill_reason_attempt_accounting():
+    """The accounting matrix the watchdog killers feed: 4000 consumes a
+    real attempt, 4001 (straggler) is free without limit, 5003
+    (launch-ack) is free up to its failure_limit of 3."""
+    from cook_tpu.state.model import Instance
+
+    def failed(job, reason):
+        inst = Instance(task_id=new_uuid(), job_uuid=job.uuid,
+                        hostname="h0", backend="mock")
+        inst.status = InstanceStatus.FAILED
+        inst.reason_code = reason
+        job.instances.append(inst)
+
+    lingering = mkjob(max_retries=2)
+    failed(lingering, 4000)
+    assert lingering.attempts_consumed() == 1
+    straggler = mkjob(max_retries=1)
+    for _ in range(5):
+        failed(straggler, 4001)
+    assert straggler.attempts_consumed() == 0
+    unacked = mkjob(max_retries=1)
+    for _ in range(4):
+        failed(unacked, 5003)
+    # free up to failure_limit=3; the 4th converts to a real attempt so
+    # a systematically black-holing cluster cannot retry forever
+    assert unacked.attempts_consumed() == 1
+
+
+def test_watchdog_max_runtime_consumes_retries_to_completion():
+    """Two 4000 kills exhaust max_retries=2: the second failure
+    completes the job unsuccessfully (non-mea-culpa accounting
+    end-to-end, not just in the model)."""
+    import time
+    store, cluster, coord = build()
+    job = mkjob(max_runtime_ms=1, max_retries=2)
+    store.create_jobs([job])
+    for expect_consumed in (1, 2):
+        coord.match_cycle()
+        time.sleep(0.01)
+        out = coord.watchdog_cycle()
+        assert len(out["lingering"]) == 1
+        assert job.attempts_consumed() == expect_consumed
+    assert job.state == JobState.COMPLETED and job.success is False
+    assert all(i.reason_code == 4000 for i in job.instances)
+
+
+def test_degraded_cluster_offers_skipped_not_fatal():
+    """A stalled backend loses its turn, not the whole cycle: the other
+    cluster's jobs keep scheduling and the skip is counted."""
+    from cook_tpu.utils.metrics import registry as metrics_registry
+
+    store = JobStore()
+    good = MockCluster([MockHost("g0", mem=1000, cpus=16)], name="good")
+    bad = MockCluster([MockHost("b0", mem=1000, cpus=16)], name="bad")
+
+    def boom(pool):
+        raise ConnectionError("backend stalled")
+
+    bad.pending_offers = boom
+    reg = ClusterRegistry()
+    reg.register(good)
+    reg.register(bad)
+    coord = Coordinator(store, reg)
+    jobs = [mkjob() for _ in range(2)]
+    store.create_jobs(jobs)
+    before = metrics_registry.counter(
+        "match.default.cluster_skipped").value
+    stats = coord.match_cycle()
+    assert stats.matched == 2
+    assert {j.instances[0].hostname for j in jobs} == {"g0"}
+    assert metrics_registry.counter(
+        "match.default.cluster_skipped").value == before + 1
+
+
+def test_degraded_cluster_launch_error_does_not_wedge_cycle():
+    """A cluster whose launch RPC throws must not abort the cycle: the
+    healthy cluster's launches stand, the error is counted, and the
+    swallowed instance is requeued by the launch-ack watchdog."""
+    from cook_tpu.utils.metrics import registry as metrics_registry
+
+    store = JobStore()
+    good = MockCluster([MockHost("g0", mem=100, cpus=1)], name="good")
+    bad = MockCluster([MockHost("b0", mem=100, cpus=1)], name="bad")
+
+    def boom(pool, specs):
+        raise ConnectionError("launch RPC failed")
+
+    bad.launch_tasks = boom
+    reg = ClusterRegistry()
+    reg.register(good)
+    reg.register(bad)
+    coord = Coordinator(store, reg)
+    jobs = [mkjob(mem=100, cpus=1, max_retries=1) for _ in range(2)]
+    store.create_jobs(jobs)
+    before = metrics_registry.counter(
+        "match.default.cluster_launch_errors").value
+    stats = coord.match_cycle()             # must not raise
+    assert stats.matched == 2
+    assert metrics_registry.counter(
+        "match.default.cluster_launch_errors").value == before + 1
+    by_host = {j.instances[0].hostname: j for j in jobs}
+    assert by_host["g0"].instances[0].status == InstanceStatus.RUNNING
+    swallowed = by_host["b0"]
+    assert swallowed.instances[0].status == InstanceStatus.UNKNOWN
+    wall = swallowed.instances[0].start_time_ms + \
+        int(coord.config.launch_ack_timeout_s * 1000) + 1
+    out = coord.watchdog_cycle(wall_ms=wall)
+    assert out["launch_ack"] == [swallowed.instances[0].task_id]
+    assert swallowed.state == JobState.WAITING
+    assert swallowed.attempts_consumed() == 0
+
+
 def test_novel_host_constraint():
     # job fails on h0 -> next attempt must go to h1
     fates = iter([(5.0, False, 1003), (5.0, True, None)])
