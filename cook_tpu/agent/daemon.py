@@ -205,6 +205,7 @@ class AgentDaemon:
             "file_server_url":
                 f"http://{self.advertise_host}:{self.file_server.port}",
             "tasks": sorted(self.executor.alive_task_ids()),
+            "outbox_dropped": self.outbox_dropped,
         }
 
     def _register(self, block: bool = False) -> None:
@@ -236,7 +237,8 @@ class AgentDaemon:
             try:
                 resp = self._post("/agents/heartbeat", {
                     "hostname": self.hostname,
-                    "tasks": sorted(self.executor.alive_task_ids())})
+                    "tasks": sorted(self.executor.alive_task_ids()),
+                    "outbox_dropped": self.outbox_dropped})
                 if resp.get("reregister"):
                     self._register(block=True)
                 self._flush_outbox()
@@ -430,8 +432,17 @@ class AgentDaemon:
         return {"ok": True}
 
     def state(self) -> dict:
+        # `undelivered` carries the outbox's terminal statuses so a
+        # restarted coordinator's reconciliation census can fold in a
+        # task that finished while it was down, instead of
+        # mis-classifying it as never-launched and re-running the
+        # command (the outbox would eventually deliver them on the next
+        # heartbeat, but reconciliation runs before that).
+        with self._outbox_lock:
+            undelivered = list(self._outbox)
         return {"hostname": self.hostname,
                 "tasks": sorted(self.executor.alive_task_ids()),
+                "undelivered": undelivered,
                 "mem": self.mem, "cpus": self.cpus, "pool": self.pool}
 
 

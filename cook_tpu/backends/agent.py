@@ -53,6 +53,10 @@ class AgentInfo:
     file_server_url: str = ""
     last_heartbeat_ms: int = 0
     alive: bool = True
+    # the daemon's lifetime count of terminal statuses its bounded
+    # outbox overflowed and dropped (reported on register/heartbeat);
+    # surfaced in /debug + Prometheus so silent status loss is visible
+    outbox_dropped: int = 0
 
 
 class AgentCluster(ComputeCluster):
@@ -96,6 +100,12 @@ class AgentCluster(ComputeCluster):
         # terminal status post (executor pops the task before POSTing)
         # has a window to land
         self._missing: dict[str, int] = {}
+        # bounded breaker state-transition log for /debug: each entry
+        # {hostname, from, to, t_ms} (appends are GIL-atomic; /debug
+        # copies before serializing)
+        import collections
+        self.breaker_transitions: "collections.deque[dict]" = \
+            collections.deque(maxlen=256)
         self._lock = threading.RLock()
 
     # -- agent control-plane entry points (wired to REST routes) -------
@@ -118,8 +128,10 @@ class AgentCluster(ComputeCluster):
             last_heartbeat_ms=now_ms())
         reported = set(payload.get("tasks", []))
         grace_cutoff = now_ms() - int(self.lost_task_grace_s * 1000)
+        info.outbox_dropped = int(payload.get("outbox_dropped", 0))
         with self._lock:
             prev = self.agents.get(hostname)
+            self._account_outbox_dropped(prev, info.outbox_dropped)
             if prev is None or not prev.alive:
                 # new host (or resurrection): the resident match path
                 # polls offer_generation to learn the host set changed
@@ -194,6 +206,9 @@ class AgentCluster(ComputeCluster):
             if info is None or not info.alive:
                 return {"ok": False, "reregister": True}
             info.last_heartbeat_ms = now_ms()
+            dropped = int(payload.get("outbox_dropped", 0))
+            self._account_outbox_dropped(info, dropped)
+            info.outbox_dropped = dropped
             known_here = set()
             for tid, (_, h, t0) in self._specs.items():
                 if h != hostname:
@@ -414,6 +429,53 @@ class AgentCluster(ComputeCluster):
         with self._lock:
             return set(self._specs)
 
+    def _account_outbox_dropped(self, prev: Optional[AgentInfo],
+                                new_count: int) -> None:
+        """Fold the positive delta of a daemon's lifetime outbox-drop
+        count into the coordinator-side Prometheus counter (a daemon
+        restart resets its count to 0 — never subtract)."""
+        old = prev.outbox_dropped if prev is not None else 0
+        if new_count > old:
+            metrics_registry.counter(
+                "agent.outbox_dropped_reported").inc(new_count - old)
+
+    def query_agent_tasks(self, timeout_s: Optional[float] = None):
+        """GET every alive agent's /state for its live task_ids — the
+        restart-reconciliation census. Returns (tasks_by_host,
+        responded, undelivered): a host appears in `responded` only
+        when it actually answered, so the caller can distinguish
+        "agent says the task is not running" (requeue it, no attempt
+        burned) from "agent unreachable" (decide nothing — leave it to
+        the heartbeat/ack watchdogs). `undelivered` carries terminal
+        status payloads still sitting in agent outboxes — tasks that
+        finished while the coordinator was down; the caller folds them
+        in before classifying anything as never-launched. Goes around
+        the circuit breakers on purpose: this runs once at boot, when
+        breakers carry no history yet, and a wrong OPEN here would
+        mis-classify every task on the host."""
+        with self._lock:
+            targets = [(h, i.url) for h, i in self.agents.items()
+                       if i.alive]
+        headers = {}
+        if self.agent_token:
+            headers["X-Cook-Agent-Token"] = self.agent_token
+        tasks: dict[str, set[str]] = {}
+        responded: set[str] = set()
+        undelivered: list[dict] = []
+        for hostname, url in targets:
+            try:
+                resp = json_request(
+                    "GET", url + "/state", None, headers=headers,
+                    timeout=timeout_s or self.request_timeout_s)
+            except Exception as e:
+                logger.warning("reconcile: state query to agent %s "
+                               "failed: %s", hostname, e)
+                continue
+            responded.add(hostname)
+            tasks[hostname] = set(resp.get("tasks", []))
+            undelivered.extend(resp.get("undelivered", []) or [])
+        return tasks, responded, undelivered
+
     def host_attributes(self) -> dict[str, dict[str, str]]:
         with self._lock:
             return {h: {"backend": "agent", **i.attributes}
@@ -467,6 +529,7 @@ class AgentCluster(ComputeCluster):
                 "mem": a.mem, "cpus": a.cpus, "gpus": a.gpus,
                 "alive": a.alive,
                 "last_heartbeat_ms": a.last_heartbeat_ms,
+                "outbox_dropped": a.outbox_dropped,
                 "breaker": self._breakers[a.hostname].snapshot()
                 if a.hostname in self._breakers
                 else {"state": CLOSED, "consecutive_failures": 0,
@@ -478,13 +541,25 @@ class AgentCluster(ComputeCluster):
         with self._lock:
             return {h: b.snapshot() for h, b in self._breakers.items()}
 
+    def _record_breaker_transition(self, hostname: str,
+                                   old: str, new: str) -> None:
+        # invoked by the breaker OUTSIDE its lock; deque append is
+        # GIL-atomic so no extra lock is needed here
+        self.breaker_transitions.append(
+            {"hostname": hostname, "from": old, "to": new,
+             "t_ms": now_ms()})
+        metrics_registry.counter(
+            "agent.breaker_transitions.%s" % new).inc()
+
     def _breaker(self, hostname: str) -> CircuitBreaker:
         with self._lock:
             br = self._breakers.get(hostname)
             if br is None:
                 br = CircuitBreaker(
                     failure_threshold=self.breaker_failures,
-                    reset_timeout_s=self.breaker_reset_s)
+                    reset_timeout_s=self.breaker_reset_s,
+                    on_transition=lambda old, new, h=hostname:
+                        self._record_breaker_transition(h, old, new))
                 self._breakers[hostname] = br
             return br
 

@@ -203,6 +203,17 @@ class Settings:
     # (JobStore.rotate_log) instead of snapshotting alongside.
     snapshot_interval_s: float = 300.0
     log_rotate_lines: int = 1_000_000
+    # delta-snapshot chain (JobStore.snapshot_delta): between full
+    # snapshots the periodic checkpoint writes only the jobs dirtied
+    # since the last one, so checkpoint cost tracks churn instead of
+    # store size and restore replays snapshot -> deltas -> log tail.
+    # Value = max chain length before the next checkpoint is forced
+    # full again; 0 disables (every checkpoint is a full snapshot).
+    snapshot_delta_chain: int = 16
+    # restart reconciliation (Coordinator.reconcile_restart): how long
+    # the first post-restore match cycle may wait for the live-agent
+    # census before matching resumes anyway; 0 disables the gate
+    restart_reconcile_timeout_s: float = 30.0
     # retention GC for completed jobs (leader-only; the role Datomic
     # excision plays for the reference — without it completed jobs
     # live forever in memory and in every checkpoint). OPT-IN: the
@@ -289,6 +300,12 @@ class Settings:
         self.scheduler.validate()
         self.auth.validate()
         self.chaos.validate()
+        if self.snapshot_delta_chain < 0:
+            raise ConfigError("snapshot_delta_chain must be >= 0 "
+                              "(0 = full snapshots only)")
+        if self.restart_reconcile_timeout_s < 0:
+            raise ConfigError("restart_reconcile_timeout_s must be "
+                              ">= 0 (0 = no match-cycle gate)")
         # a write-capable machine channel must not default open: an
         # agent cluster without an agent token is only a dev setup
         if any(c.kind == "agent" for c in self.clusters) \

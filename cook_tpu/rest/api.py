@@ -1025,6 +1025,23 @@ class CookApi:
                 if hasattr(cluster, "breaker_snapshots"):
                     clusters[cluster.name]["breakers"] = \
                         cluster.breaker_snapshots()
+                if hasattr(cluster, "describe_agents"):
+                    # per-agent view: outbox_dropped + breaker state
+                    # ride along for the operator
+                    clusters[cluster.name]["agents"] = \
+                        cluster.describe_agents()
+                transitions = getattr(cluster, "breaker_transitions",
+                                      None)
+                if transitions is not None:
+                    # bounded deque; a racing append can fault the
+                    # copy ("deque mutated during iteration") — an
+                    # empty list beats a /debug 500
+                    try:
+                        clusters[cluster.name]["breaker_transitions"] \
+                            = list(transitions)
+                    except RuntimeError:
+                        clusters[cluster.name]["breaker_transitions"] \
+                            = []
             # locked point-in-time copy: a bare list(deque) here races
             # the consumer thread's appends ("deque mutated during
             # iteration" -> intermittent /debug 500s under load)
@@ -1056,7 +1073,21 @@ class CookApi:
         body = {"healthy": True, "version": VERSION,
                 "clusters": clusters,
                 "metrics": metrics,
-                "consume_trace": consume}
+                "consume_trace": consume,
+                # crash-recovery evidence: how this store came back,
+                # and what the restart reconciliation pass resolved
+                "recovery": {
+                    "restore_ms": round(
+                        getattr(self.store, "restore_ms", 0.0), 2),
+                    "restored_from": getattr(
+                        self.store, "_restored_from", None),
+                    "restore_deltas": getattr(
+                        self.store, "_restore_deltas", 0),
+                    "delta_chain_length":
+                        self.store.delta_chain_length(),
+                    "restart_reconcile": getattr(
+                        self.coord, "last_restart_reconcile", {})
+                        if self.coord is not None else {}}}
         from cook_tpu import chaos
         if chaos.controller.enabled:
             # operators must be able to tell an injected outage from a

@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -116,6 +117,47 @@ class ApiServer:
         self.httpd.server_close()
 
 
+def install_artifact_flush() -> None:
+    """When $CHAOS_ARTIFACTS_DIR is set, flush the chaos event ring and
+    the flight-recorder trace to JSONL artifacts on SIGTERM/atexit — a
+    soak run killed by its harness (or a CI timeout) still uploads its
+    evidence. SIGKILL cannot be caught by design: the procfault budget
+    file records the kill schedule durably BEFORE the signal, and the
+    respawned incarnation flushes what the dead one could not."""
+    out = os.environ.get("CHAOS_ARTIFACTS_DIR")
+    if not out:
+        return
+    import atexit
+    import signal
+    from cook_tpu import chaos, obs
+    flushed = threading.Event()
+
+    def flush():
+        if flushed.is_set():
+            return
+        flushed.set()
+        try:
+            os.makedirs(out, exist_ok=True)
+            tag = f"server-{os.getpid()}"
+            chaos.controller.save_events(
+                os.path.join(out, f"chaos-events-{tag}.jsonl"))
+            with open(os.path.join(out, f"trace-{tag}.json"), "w") as f:
+                json.dump(obs.to_chrome_trace(obs.tracer.recent(4096)), f)
+        except Exception:
+            log.exception("chaos artifact flush failed")
+
+    atexit.register(flush)
+
+    def on_term(signum, frame):
+        flush()
+        raise SystemExit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except (ValueError, OSError):
+        pass  # not on the main thread (embedded use); atexit still runs
+
+
 def apply_gc_discipline() -> None:
     """Move the store's long-lived object graph out of the cyclic
     collector's reach. At 100k jobs the store holds ~10^6 live objects
@@ -192,6 +234,15 @@ def build_scheduler(config, read_only=False):
                                    sites=config.chaos.sites)
     if chaos.controller.enabled:
         log.warning("CHAOS ENABLED: %s", chaos.controller.stats())
+    # process-level kill points (SIGKILL chaos): env-only by design —
+    # the schedule crosses the exec boundary from the supervisor
+    # (procfault.ServerSupervisor), never from a config file a
+    # production deployment could ship by accident
+    from cook_tpu.chaos import procfault
+    if procfault.controller.configure_from_env():
+        log.warning("PROCFAULT ARMED: seed=%d incarnation=%d",
+                    procfault.controller.seed,
+                    procfault.controller.incarnation)
 
     # In an HA deployment the log is shared and a live leader may be
     # mid-append while this (standby) process boots: trimming a torn
@@ -382,9 +433,9 @@ def main(argv=None) -> None:
                         help="API only; don't start scheduling loops")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    install_artifact_flush()
     # Respect JAX_PLATFORMS even when a site hook already imported jax
     # and pinned a different platform.
-    import os
     if os.environ.get("JAX_PLATFORMS"):
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
@@ -436,6 +487,15 @@ def main(argv=None) -> None:
         # and the store's append gate is the chokepoint for anything
         # already in flight when the fence closes
         store.append_gate = _still_leader
+        # restart reconciliation: with agent-backed clusters, gate the
+        # first match cycle until the live-agent census resolves the
+        # UNKNOWN (launched-but-unacked) instances the previous
+        # incarnation left behind — or the grace window expires
+        agentish = [c for c in coord.clusters.all()
+                    if hasattr(c, "query_agent_tasks")]
+        reconcile_s = settings.restart_reconcile_timeout_s
+        if agentish and reconcile_s > 0:
+            coord.arm_restart_reconcile(reconcile_s)
         coord.run(leadership_check=_still_leader)
         # only now may writes land: the replayed store can vouch for
         # live tasks the agents report
@@ -446,6 +506,41 @@ def main(argv=None) -> None:
         # cycle (the same tuning the e2e bench measures with)
         apply_gc_discipline()
         api.leader_ready.set()
+
+        if agentish and reconcile_s > 0:
+            def reconcile_thread():
+                # agents can only register once the HTTP server
+                # listens (which happens after this callback returns),
+                # so the census waits for the hosts that actually hold
+                # UNKNOWN instances to call home — or the deadline
+                from cook_tpu.state.model import (InstanceStatus,
+                                                  JobState)
+                deadline = time.monotonic() + reconcile_s
+                want = {i.hostname for j in list(store.jobs.values())
+                        if j.state == JobState.RUNNING
+                        for i in j.active_instances
+                        if i.status == InstanceStatus.UNKNOWN
+                        and i.hostname}
+                while want and time.monotonic() < deadline:
+                    have = set()
+                    for c in agentish:
+                        try:
+                            have |= {h for h, i in
+                                     list(getattr(c, "agents",
+                                                  {}).items())
+                                     if i.alive}
+                        except RuntimeError:
+                            continue  # registry mutated mid-copy
+                    if want <= have:
+                        break
+                    time.sleep(0.05)
+                try:
+                    coord.reconcile_restart()
+                except Exception:
+                    log.exception("restart reconciliation failed")
+
+            threading.Thread(target=reconcile_thread,
+                             daemon=True).start()
 
         def tick():  # real-time driver for mock virtual clocks + monitor
             while True:
@@ -497,6 +592,15 @@ def main(argv=None) -> None:
                                 settings.snapshot_path, wait=False)
                             log.info("rotated event log at %d lines",
                                      lines)
+                        elif settings.snapshot_delta_chain > 0 and \
+                                store.delta_chain_length() < \
+                                settings.snapshot_delta_chain:
+                            # delta chain: checkpoint only the jobs
+                            # dirtied since the last one; a full
+                            # snapshot re-bases the chain once it
+                            # reaches the configured length
+                            ticket = store.snapshot_delta_async(
+                                settings.snapshot_path)
                         else:
                             ticket = store.snapshot_async(
                                 settings.snapshot_path)

@@ -43,6 +43,7 @@ from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
 from cook_tpu.backends.kube import checkpoint as cp
 from cook_tpu.state.model import (REASON_BY_CODE, InstanceStatus, Job,
                                   JobState, now_ms)
+from cook_tpu.chaos import procfault
 from cook_tpu.parallel import federation
 from cook_tpu.state.pools import DruMode, PoolRegistry
 from cook_tpu.utils.metrics import registry as metrics_registry
@@ -180,6 +181,15 @@ class Coordinator:
         self._adaptive_head: dict[str, AdaptiveHead] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # restart-reconciliation gate: set = match cycles may run. Open
+        # by default (tests/simulator drive cycles directly); the
+        # server arms it before run() so the first post-restore cycle
+        # waits for reconcile_restart() — or the grace deadline — and
+        # can never double-launch a task an agent still carries.
+        self._reconcile_done = threading.Event()
+        self._reconcile_done.set()
+        self._reconcile_deadline = 0.0
+        self.last_restart_reconcile: dict = {}
         self.metrics: dict[str, float] = {}
         # per-consume phase records (bounded; appended by whichever
         # thread runs _consume_cycle). This is the raw material for a
@@ -1146,6 +1156,10 @@ class Coordinator:
     # match cycle (scheduler.clj:848-1036)
     def match_cycle(self, pool: Optional[str] = None) -> MatchStats:
         pool = pool or self.pools.default_pool
+        # chaos: a SIGKILL here lands between cycles' store
+        # transactions — the restart must resume from the last
+        # committed event with no job lost (zero-cost when disarmed)
+        procfault.kill_point("cycle.mid")
         rp = getattr(self, "_resident", {}).get(pool)
         if rp is not None and rp.enabled:
             stats = self._match_cycle_resident(pool, rp)
@@ -2029,6 +2043,124 @@ class Coordinator:
         return {"lost": lost}
 
     # ------------------------------------------------------------------
+    # restart reconciliation: the crash-recovery counterpart of
+    # reconcile(). A SIGKILL can leave instances in UNKNOWN (the launch
+    # transaction committed, but the ack — or even the launch POST —
+    # may or may not have happened). Before the first post-restore
+    # match cycle the restarted leader takes a census of the live
+    # agents and resolves each UNKNOWN instance into one of three
+    # classes:
+    #   launched-but-unacked  -> the agent reports it: adopt + RUNNING
+    #   never-launched        -> its host answered and does not report
+    #                            it: FAILED 5003 (mea-culpa — no user
+    #                            attempt burned) and requeued
+    #   completed-while-down  -> terminal status still in the agent's
+    #                            outbox: folded in via the normal
+    #                            status path before classification
+    # Hosts that did NOT answer the census decide nothing — their
+    # tasks stay UNKNOWN for the launch-ack watchdog (5003) and the
+    # heartbeat watchdog (5000) to settle, exactly as if no restart
+    # had happened.
+    def arm_restart_reconcile(self, timeout_s: float = 30.0) -> None:
+        """Block match cycles (run() only — direct match_cycle() calls
+        are not gated) until reconcile_restart() finishes or timeout_s
+        elapses. Called by the server before starting the cycle
+        threads; the census itself must run later, once the HTTP
+        server is up, because agents can only register against a
+        listening socket."""
+        self._reconcile_deadline = time.monotonic() + float(timeout_s)
+        self._reconcile_done.clear()
+
+    def _match_gate(self) -> bool:
+        """True when match cycles may run. Never blocks forever: if
+        reconciliation hasn't finished by the armed deadline, matching
+        resumes and the watchdogs own whatever is still ambiguous."""
+        if self._reconcile_done.is_set():
+            return True
+        if time.monotonic() >= self._reconcile_deadline:
+            log.warning("restart-reconcile window expired; resuming "
+                        "match cycles (watchdogs own the remainder)")
+            self._reconcile_done.set()
+            return True
+        return False
+
+    def reconcile_restart(self) -> dict:
+        """Resolve UNKNOWN instances against a live-agent census (see
+        block comment above). Always releases the match gate, even on
+        an unexpected census failure — a broken reconcile pass must
+        degrade to watchdog-paced recovery, not a frozen scheduler."""
+        adopted, requeued, folded = [], [], []
+        unknown: list[str] = []
+        try:
+            unknown = [inst.task_id
+                       for job in list(self.store.jobs.values())
+                       if job.state == JobState.RUNNING
+                       for inst in job.active_instances
+                       if inst.status == InstanceStatus.UNKNOWN]
+            report = {"unknown": len(unknown), "adopted": adopted,
+                      "requeued": requeued, "folded": folded}
+            if not unknown:
+                return report
+            for cluster in self.clusters.all():
+                census = getattr(cluster, "query_agent_tasks", None)
+                if census is None:
+                    continue
+                try:
+                    tasks_by_host, responded, undelivered = census()
+                except Exception:
+                    log.exception("restart-reconcile: census on "
+                                  "cluster %s failed", cluster.name)
+                    continue
+                # completed-while-down first: fold outboxed terminal
+                # statuses through the normal status path (which
+                # adopts via the durable store), so a finished task is
+                # never mis-read as never-launched and re-run
+                for payload in undelivered:
+                    try:
+                        if cluster.status_report(payload).get("ok"):
+                            folded.append(payload.get("task_id"))
+                    except Exception:
+                        log.exception("restart-reconcile: folding "
+                                      "outboxed status failed")
+                for task_id in unknown:
+                    inst = self.store.get_instance(task_id)
+                    # re-read: an outbox fold above (or a racing agent
+                    # POST) may already have settled this instance
+                    if inst is None or \
+                            inst.status != InstanceStatus.UNKNOWN:
+                        continue
+                    host = inst.hostname
+                    if host in tasks_by_host and \
+                            task_id in tasks_by_host[host]:
+                        # launched-but-unacked: the process is real —
+                        # adopt the spec so kill/status route, then
+                        # mark RUNNING in the store
+                        if cluster._try_adopt(task_id, host):
+                            self.store.update_instance(
+                                task_id, InstanceStatus.RUNNING)
+                            adopted.append(task_id)
+                    elif host in responded:
+                        # host is up and does not know the task: the
+                        # launch POST never landed. 5003 is mea-culpa,
+                        # so the requeue burns no user attempt.
+                        self.store.update_instance(
+                            task_id, InstanceStatus.FAILED,
+                            reason_code=5003)
+                        self._backend_kill(task_id)
+                        requeued.append(task_id)
+                    # else: host silent — leave to the watchdogs
+            if unknown:
+                log.info("restart-reconcile: %d unknown -> %d adopted, "
+                         "%d requeued, %d folded", len(unknown),
+                         len(adopted), len(requeued), len(folded))
+            return report
+        finally:
+            self.last_restart_reconcile = {
+                "unknown": len(unknown), "adopted": list(adopted),
+                "requeued": list(requeued), "folded": list(folded)}
+            self._reconcile_done.set()
+
+    # ------------------------------------------------------------------
     # production mode: timer threads (make-trigger-chans mesos.clj:85-109)
     def run(self, leadership_check=None) -> None:
         """leadership_check: callable -> bool; when it returns False the
@@ -2039,12 +2171,14 @@ class Coordinator:
         reference's deposed leader suicides and Datomic's single
         transactor refuses it anyway)."""
         self._leadership_check = leadership_check
-        def loop(interval, fn, per_pool=True):
+        def loop(interval, fn, per_pool=True, gate=None):
             def body():
                 while not self._stop.wait(interval):
                     try:
                         if leadership_check is not None \
                                 and not leadership_check():
+                            continue
+                        if gate is not None and not gate():
                             continue
                         if per_pool:
                             for p in self.pools.active():
@@ -2057,7 +2191,8 @@ class Coordinator:
             t.start()
             self._threads.append(t)
 
-        loop(self.config.match_interval_s, self.match_cycle)
+        loop(self.config.match_interval_s, self.match_cycle,
+             gate=self._match_gate)
         loop(self.config.rebalancer_interval_s, self.rebalance_cycle)
         loop(60.0, self.watchdog_cycle, per_pool=False)
         opt = getattr(self, "optimizer_cycle", None)

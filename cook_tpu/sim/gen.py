@@ -17,10 +17,29 @@ def generate_trace(n_jobs: int = 1000, n_users: int = 10,
                    submit_window_ms: int = 3_600_000,
                    mean_runtime_ms: int = 600_000,
                    fail_fraction: float = 0.05,
-                   seed: int = 0) -> list[dict]:
+                   seed: int = 0, diurnal: bool = False) -> list[dict]:
+    """diurnal=True replaces the uniform arrival process with a
+    production-day shape: two workday bursts (morning and
+    mid-afternoon peaks) over a background floor — the arrival pattern
+    the crash soak replays at compressed timescale."""
     rng = np.random.default_rng(seed)
     users = [chr(ord("a") + i % 26) + (str(i // 26) if i >= 26 else "")
              for i in range(n_users)]
+
+    def submit_time() -> int:
+        if not diurnal:
+            return int(rng.integers(submit_window_ms))
+        r = rng.random()
+        if r < 0.45:            # morning burst
+            t = rng.normal(0.33 * submit_window_ms,
+                           0.07 * submit_window_ms)
+        elif r < 0.90:          # afternoon burst
+            t = rng.normal(0.68 * submit_window_ms,
+                           0.07 * submit_window_ms)
+        else:                   # overnight/background floor
+            t = rng.uniform(0, submit_window_ms)
+        return int(min(max(t, 0), submit_window_ms - 1))
+
     jobs = []
     for _ in range(n_jobs):
         runtime = int(rng.lognormal(np.log(mean_runtime_ms), 0.8))
@@ -34,7 +53,7 @@ def generate_trace(n_jobs: int = 1000, n_users: int = 10,
             "job/max-retries": 3,
             "job/max-runtime": 86_400_000,
             "job/disable-mea-culpa-retries": False,
-            "submit-time-ms": int(rng.integers(submit_window_ms)),
+            "submit-time-ms": submit_time(),
             "run-time-ms": max(runtime, 1000),
             "status": status,
             "job/resource": [

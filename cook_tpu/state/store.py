@@ -21,6 +21,7 @@ dataclasses and may be slightly stale, like Datomic's snapshot reads.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import logging
 import os
@@ -28,8 +29,10 @@ import queue
 import re
 import threading
 import time
+import zlib
 
 from cook_tpu import chaos
+from cook_tpu.chaos import procfault
 from dataclasses import asdict, dataclass
 from typing import Any, Callable, Iterable, Optional
 
@@ -167,6 +170,25 @@ class JobStore:
         self._log = log_writer
         if log_path and log_writer is None:
             self._log = _make_log_writer(log_path)
+        # delta-snapshot bookkeeping: every transaction that mutates a
+        # job marks its uuid dirty (through _reindex /
+        # update_progress); retirement/GC records a tombstone. A FULL
+        # snapshot swaps the sets out and anoints itself the chain
+        # base (_delta_base_id, stamped into the file as snap_id);
+        # snapshot_delta serializes only the swapped-out dirty jobs
+        # against that base. The chain is process-local by design: the
+        # first checkpoint after a restart is always full (base_id is
+        # None), so no cross-restart dirty accounting exists to get
+        # wrong.
+        self._dirty_jobs: set[str] = set()
+        self._dirty_tombstones: set[str] = set()
+        self._delta_base_id: Optional[str] = None
+        self._delta_base_path: Optional[str] = None
+        self._delta_seq = 1
+        # wall time restore() spent rebuilding this store (0 for a
+        # store that was never restored) — /debug evidence and the
+        # crash-soak's recovery-time gate
+        self.restore_ms = 0.0
         # dedicated checkpoint thread (lazy): snapshot_async and
         # rotate_log(wait=False) hand the chunked serialization + flush
         # to it, with its own fd, so the calling thread — and the
@@ -186,6 +208,10 @@ class JobStore:
         else:
             d.pop(job.uuid, None)
         self._account_usage(job)
+        # every mutating transaction funnels through here, so this is
+        # the one choke point for delta-snapshot dirty tracking
+        # (update_progress, which skips _reindex, marks explicitly)
+        self._dirty_jobs.add(job.uuid)
 
     def _account_usage(self, job: Job) -> None:
         """Fold a (possible) RUNNING transition into the per-user
@@ -261,10 +287,18 @@ class JobStore:
     def _epoch_suffix(self) -> str:
         return f',"ep":{self.epoch}' if self.epoch else ""
 
-    def _append(self, kind: str, data: dict) -> None:
+    def _append(self, kind: str, data: dict,
+                t_ms: Optional[int] = None) -> None:
+        # t_ms: transactions that stamp wall-clock times into live
+        # state pass the SAME value here, so the durable event and the
+        # in-memory state agree to the millisecond and a replayed store
+        # hashes identically to the live one (state_hash is the
+        # delta-restore oracle; a 1 ms skew between two now_ms() calls
+        # in one transaction would fail it spuriously)
         if self._log is None or getattr(self, "_replaying", False):
             return
-        ev = {"t": now_ms(), "k": kind, **data}
+        ev = {"t": t_ms if t_ms is not None else now_ms(),
+              "k": kind, **data}
         if self.epoch:
             ev["ep"] = self.epoch
         self._append_raw(json.dumps(ev, separators=(",", ":")))
@@ -426,6 +460,8 @@ class JobStore:
             for u in dead:
                 self._deindex(self.jobs[u])
                 del self.jobs[u]
+                self._dirty_jobs.discard(u)
+                self._dirty_tombstones.add(u)
                 self._append("gc", {"job": u})
             for u in dead:
                 self._emit("gc", {"job": u})
@@ -511,6 +547,8 @@ class JobStore:
         job = self.jobs.pop(uuid, None)
         if job is None:
             return
+        self._dirty_jobs.discard(uuid)
+        self._dirty_tombstones.add(uuid)
         self._deindex(job)
         for inst in job.instances:
             self.task_to_job.pop(inst.task_id, None)
@@ -540,6 +578,7 @@ class JobStore:
         scheduler.clj:762-777).  ``span_id`` (the coordinator's launch-
         txn span) rides on the durable event so the log carries trace
         context; replay ignores unknown keys."""
+        t_ms = now_ms()
         with self._lock:
             self._check_writable()
             if not self.allowed_to_start(job_uuid):
@@ -547,7 +586,7 @@ class JobStore:
             job = self.jobs[job_uuid]
             inst = Instance(task_id=task_id or new_uuid(), job_uuid=job_uuid,
                             hostname=hostname, backend=backend,
-                            start_time_ms=now_ms())
+                            start_time_ms=t_ms)
             job.instances.append(inst)
             self.task_to_job[inst.task_id] = job_uuid
             self._update_job_state(job)
@@ -556,7 +595,10 @@ class JobStore:
                   "host": hostname, "backend": backend}
             if span_id:
                 ev["sp"] = span_id
-            self._append("inst", ev)
+            self._append("inst", ev, t_ms=t_ms)
+            # mid-launch-txn kill point (classic path): see
+            # create_instances_bulk for the recovery contract
+            procfault.kill_point("store.launch_txn")
             self._emit("inst", {"obj": job, "inst": inst})
         self._barrier()
         return inst
@@ -604,6 +646,12 @@ class JobStore:
                     f'{{"t":{t_ms},"k":"insts"{sp},"items":['
                     + ",".join(log_items)
                     + f']{self._epoch_suffix()}}}')
+                # mid-launch-txn kill point: appended but not yet
+                # fsync'd/acked — on restart these instances replay as
+                # UNKNOWN (or the torn tail drops them) and restart
+                # reconciliation must resolve them without a double
+                # launch (tests/test_crash_soak.py)
+                procfault.kill_point("store.launch_txn")
             if created:
                 self._emit("insts", {"items": created, "origin": origin})
         self._barrier()
@@ -642,14 +690,15 @@ class JobStore:
                 inst.sandbox_directory = sandbox
             if output_url is not None:
                 inst.output_url = output_url
+            t_ms = now_ms()
             if status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
-                inst.end_time_ms = now_ms()
+                inst.end_time_ms = t_ms
             was = job.state
-            self._update_job_state(job)
+            self._update_job_state(job, t_ms=t_ms)
             self._reindex(job)
             self._append("status", {"task": task_id, "s": status.value,
                                     "r": reason_code, "p": preempted,
-                                    "e": exit_code})
+                                    "e": exit_code}, t_ms=t_ms)
             self._emit("status", {"obj": job, "inst": inst, "was": was})
             if job.state == JobState.COMPLETED and was != JobState.COMPLETED:
                 self._emit("job-completed", {"job": job_uuid})
@@ -698,7 +747,7 @@ class JobStore:
                 if status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
                     inst.end_time_ms = t_ms
                 was = job.state
-                self._update_job_state(job)
+                self._update_job_state(job, t_ms=t_ms)
                 self._reindex(job)
                 # hand-built fixed-shape line (see _append_raw); task
                 # ids are store-generated uuids and status values are
@@ -739,6 +788,7 @@ class JobStore:
             inst.progress = percent
             if message:
                 inst.progress_message = message
+            self._dirty_jobs.add(job_uuid)
             self._append("progress", {"task": task_id, "q": sequence,
                                       "pc": percent, "m": message})
         self._barrier()
@@ -772,21 +822,25 @@ class JobStore:
             if job is None or job.state == JobState.COMPLETED:
                 return []
             to_kill = [i.task_id for i in job.active_instances]
+            t_ms = now_ms()
             job.state = JobState.COMPLETED
             job.success = False
             if job.end_time_ms is None:
-                job.end_time_ms = now_ms()
+                job.end_time_ms = t_ms
             self._reindex(job)
-            self._append("kill", {"job": job_uuid})
+            self._append("kill", {"job": job_uuid}, t_ms=t_ms)
             self._emit("kill", {"obj": job, "to_kill": list(to_kill)})
             self._emit("job-completed", {"job": job_uuid})
         self._barrier()
         return to_kill
 
     # ------------------------------------------------------------------
-    def _update_job_state(self, job: Job) -> None:
+    def _update_job_state(self, job: Job,
+                          t_ms: Optional[int] = None) -> None:
         """:job/update-state (schema.clj:1065): derive job state from its
-        instances + retry budget."""
+        instances + retry budget. t_ms: the caller's transaction
+        timestamp, so the completion clock matches the durable event's
+        (see _append)."""
         if job.state == JobState.COMPLETED:
             return
         if any(i.active for i in job.instances):
@@ -796,13 +850,13 @@ class JobStore:
             job.state = JobState.COMPLETED
             job.success = True
             if job.end_time_ms is None:
-                job.end_time_ms = now_ms()
+                job.end_time_ms = t_ms if t_ms is not None else now_ms()
             return
         if job.retries_remaining() <= 0:
             job.state = JobState.COMPLETED
             job.success = False
             if job.end_time_ms is None:
-                job.end_time_ms = now_ms()
+                job.end_time_ms = t_ms if t_ms is not None else now_ms()
             return
         job.state = JobState.WAITING
 
@@ -914,13 +968,34 @@ class JobStore:
         after the position was recorded may serialize with LATER state;
         replaying the tail re-applies those events, and every event
         application is idempotent/transition-guarded, so the restore
-        converges to the same state."""
+        converges to the same state.
+
+        Framing: the JSON document is followed by a `#crc <hex> <len>`
+        trailer line (crc32 + byte length of the document). restore()
+        verifies it, so a torn or bit-rotted snapshot is DETECTED and
+        recovery falls back (previous snapshot, then longer log
+        replay) instead of loading garbage. The previous good snapshot
+        survives as `<path>.prev` (hardlink taken before the rename).
+
+        A full snapshot also anoints itself the base of a fresh delta
+        chain (snap_id in the header; see snapshot_delta) and sweeps
+        the delta files of the chain it obsoletes."""
         with self._lock:
             lines0 = self._log.lines() if self._log else 0
             genesis = getattr(self, "_log_genesis", None)
+            snap_id = new_uuid()
             items = list(self.jobs.items())
             groups = {u: asdict(g) for u, g in self.groups.items()}
             rcfg = dict(self.rebalancer_config)
+            # swap the dirty sets out in the SAME critical section as
+            # the log-position capture: mutations landing after lines0
+            # re-mark their jobs and belong to the next delta; on a
+            # failed write the swapped-out sets merge back so no
+            # mutation is ever lost to the chain
+            dirty0 = self._dirty_jobs
+            self._dirty_jobs = set()
+            tombs0 = self._dirty_tombstones
+            self._dirty_tombstones = set()
         # chunk sizing is a lock-convoy trade-off measured on the e2e
         # bench: every chunk boundary re-acquires self._lock behind
         # live transactions (which hold it across their fsync), so 55
@@ -934,46 +1009,187 @@ class JobStore:
         # per-chunk fsync in the middle (see _writeback_hint).
         CHUNK = 8000
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            # streamed per-chunk C-encoder writes, NOT one json.dump or
-            # one giant json.dumps: dump() goes through the pure-Python
-            # iterencode (measured 4.0 s / 87M calls at 110k jobs), and
-            # a single dumps() holds the GIL for its whole ~0.7 s run —
-            # observed as a phase spike INSIDE live match cycles during
-            # rotation checkpoints. Chunked dumps keeps the C encoder's
-            # speed with ~ms GIL holds, so a checkpoint never starves
-            # (or gets starved by) the cycle/consumer threads.
-            # Key order matters: log_lines/log_genesis lead so
-            # _read_snapshot_genesis can header-sniff the file.
-            f.write('{"log_lines": %d, "log_genesis": %s, "jobs": {'
-                    % (lines0, json.dumps(genesis)))
-            first = True
-            for lo in range(0, len(items), CHUNK):
-                with self._lock:
-                    part = {u: _job_dict(j)
-                            for u, j in items[lo:lo + CHUNK]}
-                blob = json.dumps(part)
-                if blob != "{}":
-                    if not first:
-                        f.write(",")
-                    f.write(blob[1:-1])
-                    first = False
-                    f.flush()
-                    _writeback_hint(f.fileno())  # spread the flush
-                                                 # without blocking
-            f.write('}, "groups": %s, "rebalancer_config": %s}'
-                    % (json.dumps(groups), json.dumps(rcfg)))
-            f.flush()
-            # durable before visible: rotate_log DESTROYS the old log
-            # segment on the strength of this snapshot, so it must hit
-            # disk (file + directory entry) before rotation proceeds —
-            # otherwise a crash can leave a fsync'd new segment next to
-            # a page-cache-only snapshot and lose every acked txn
-            # between the previous snapshot and lines0
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        try:
+            with open(tmp, "w") as f:
+                crc = 0
+                nbytes = 0
+
+                def w(s: str) -> None:
+                    # accumulate the frame CRC as we stream, so the
+                    # trailer costs no extra pass over the document
+                    nonlocal crc, nbytes
+                    f.write(s)
+                    b = s.encode()
+                    crc = zlib.crc32(b, crc)
+                    nbytes += len(b)
+
+                # streamed per-chunk C-encoder writes, NOT one
+                # json.dump or one giant json.dumps: dump() goes
+                # through the pure-Python iterencode (measured 4.0 s /
+                # 87M calls at 110k jobs), and a single dumps() holds
+                # the GIL for its whole ~0.7 s run — observed as a
+                # phase spike INSIDE live match cycles during rotation
+                # checkpoints. Chunked dumps keeps the C encoder's
+                # speed with ~ms GIL holds, so a checkpoint never
+                # starves (or gets starved by) the cycle/consumer
+                # threads. Key order matters: log_lines/log_genesis
+                # lead so _read_snapshot_genesis can header-sniff.
+                w('{"log_lines": %d, "log_genesis": %s, '
+                  '"snap_id": %s, "jobs": {'
+                  % (lines0, json.dumps(genesis), json.dumps(snap_id)))
+                first = True
+                for lo in range(0, len(items), CHUNK):
+                    with self._lock:
+                        part = {u: _job_dict(j)
+                                for u, j in items[lo:lo + CHUNK]}
+                    blob = json.dumps(part)
+                    if blob != "{}":
+                        if not first:
+                            w(",")
+                        w(blob[1:-1])
+                        first = False
+                        f.flush()
+                        _writeback_hint(f.fileno())  # spread the flush
+                                                     # without blocking
+                w('}, "groups": %s, "rebalancer_config": %s}'
+                  % (json.dumps(groups), json.dumps(rcfg)))
+                f.write("\n#crc %08x %d\n" % (crc, nbytes))
+                f.flush()
+                # durable before visible: rotate_log DESTROYS the old
+                # log segment on the strength of this snapshot, so it
+                # must hit disk (file + directory entry) before
+                # rotation proceeds — otherwise a crash can leave a
+                # fsync'd new segment next to a page-cache-only
+                # snapshot and lose every acked txn between the
+                # previous snapshot and lines0
+                os.fsync(f.fileno())
+            # keep the outgoing snapshot reachable as <path>.prev: the
+            # torn-snapshot fallback (restore) and nothing else reads
+            # it; hardlink so the retention costs no copy
+            if os.path.exists(path):
+                prev_tmp = path + ".prev.tmp"
+                try:
+                    try:
+                        os.unlink(prev_tmp)
+                    except OSError:
+                        pass
+                    os.link(path, prev_tmp)
+                    os.replace(prev_tmp, path + ".prev")
+                except OSError:
+                    pass
+            procfault.kill_point("store.snapshot")
+            os.replace(tmp, path)
+            _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        except BaseException:
+            # the chain must not lose the swapped-out dirty marks: a
+            # later delta against the OLD base still needs them
+            with self._lock:
+                self._dirty_jobs |= dirty0
+                self._dirty_tombstones |= tombs0
+            raise
+        with self._lock:
+            self._delta_base_id = snap_id
+            self._delta_base_path = path
+            self._delta_seq = 1
+        self._sweep_deltas(path)
         return lines0
+
+    def snapshot_delta(self, path: str) -> int:
+        """Incremental checkpoint: serialize only the jobs mutated
+        since the last checkpoint (full or delta) into
+        `<path>.delta-<seq>`, CRC-framed and atomically renamed, plus
+        the tombstones of jobs retired since. Groups and the
+        rebalancer config are small and ride along whole.
+
+        Falls back to a FULL snapshot when this process has no chain
+        base yet (first checkpoint after a restart/rotation) — the
+        chain is process-local, so there is no cross-restart dirty
+        bookkeeping to corrupt. restore() applies base → deltas in seq
+        order → log tail; a delta whose base_id does not match the
+        loaded snapshot (stale chain) or whose CRC fails simply ends
+        the chain early, and the log replays from the last good
+        position — always correct, just slower.
+
+        Returns the recorded log position, like snapshot()."""
+        with self._lock:
+            base_id = self._delta_base_id
+            if base_id is None or self._delta_base_path != path:
+                base_id = None
+        if base_id is None:
+            return self.snapshot(path)
+        with self._lock:
+            lines0 = self._log.lines() if self._log else 0
+            genesis = getattr(self, "_log_genesis", None)
+            seq = self._delta_seq
+            dirty0 = self._dirty_jobs
+            self._dirty_jobs = set()
+            tombs0 = self._dirty_tombstones
+            self._dirty_tombstones = set()
+            jobs = {u: _job_dict(self.jobs[u])
+                    for u in dirty0 if u in self.jobs}
+            groups = {u: asdict(g) for u, g in self.groups.items()}
+            rcfg = dict(self.rebalancer_config)
+        body = json.dumps(
+            {"base_id": base_id, "seq": seq, "log_lines": lines0,
+             "log_genesis": genesis, "jobs": jobs,
+             "tombstones": sorted(tombs0), "groups": groups,
+             "rebalancer_config": rcfg},
+            separators=(",", ":"))
+        delta_path = "%s.delta-%d" % (path, seq)
+        tmp = delta_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(body)
+                b = body.encode()
+                f.write("\n#crc %08x %d\n" % (zlib.crc32(b), len(b)))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, delta_path)
+            _fsync_dir(os.path.dirname(os.path.abspath(delta_path)))
+        except BaseException:
+            with self._lock:
+                self._dirty_jobs |= dirty0
+                self._dirty_tombstones |= tombs0
+            raise
+        with self._lock:
+            self._delta_seq = seq + 1
+        return lines0
+
+    def delta_chain_length(self) -> int:
+        """Deltas written against the current base (0 right after a
+        full snapshot) — the server's chain-cap trigger."""
+        with self._lock:
+            return self._delta_seq - 1 if self._delta_base_id else 0
+
+    def _sweep_deltas(self, path: str) -> None:
+        """Drop the delta files a fresh full snapshot just obsoleted.
+        Stale survivors (crash between rename and sweep) are harmless:
+        their base_id no longer matches and restore ignores them."""
+        import glob
+        for p in glob.glob(glob.escape(path) + ".delta-*"):
+            if p.endswith(".tmp"):
+                continue
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def state_hash(self) -> str:
+        """Deterministic digest of the durable state (jobs, groups,
+        rebalancer config) — the restore-equivalence oracle: a store
+        rebuilt from snapshot+deltas+tail must hash identically to one
+        rebuilt from the log alone."""
+        with self._lock:
+            doc = {
+                "jobs": {u: _job_dict(self.jobs[u])
+                         for u in sorted(self.jobs)},
+                "groups": {u: asdict(self.groups[u])
+                           for u in sorted(self.groups)},
+                "rebalancer_config": self.rebalancer_config,
+            }
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True, separators=(",", ":"),
+                       default=str).encode()).hexdigest()
 
     # -- off-critical-path checkpointing ------------------------------
     def _ensure_snap_thread(self) -> None:
@@ -1016,6 +1232,15 @@ class JobStore:
         self._ensure_snap_thread()
         ticket = SnapshotTicket()
         self._snap_q.put((lambda: self.snapshot(path), ticket))
+        return ticket
+
+    def snapshot_delta_async(self, path: str) -> SnapshotTicket:
+        """snapshot_delta on the dedicated snapshot thread (same
+        ordering contract as snapshot_async). Falls back to a full
+        snapshot inside when no chain base exists yet."""
+        self._ensure_snap_thread()
+        ticket = SnapshotTicket()
+        self._snap_q.put((lambda: self.snapshot_delta(path), ticket))
         return ticket
 
     def drain_snapshots(self, timeout: Optional[float] = None) -> None:
@@ -1111,6 +1336,11 @@ class JobStore:
                     self._log = _FailedLogWriter(self._log_path)
                 raise
             self._log_genesis = genesis
+        # mid-rotation kill point: the step-1→2 crash window — segment
+        # swapped, covering checkpoint not yet taken. Restore must
+        # replay the .pre-<genesis> chain (tests/test_crash_soak.py
+        # arms this site)
+        procfault.kill_point("store.rotate")
         # 2) checkpoint against the fresh incarnation (chunked lock;
         # write transactions interleave). Durable (file+dir fsync)
         # before step 3 destroys the pre-segment it covers.
@@ -1164,25 +1394,63 @@ class JobStore:
         sharing the log) may be mid-append: truncating under its
         O_APPEND writer would glue its continuation to the preceding
         line and corrupt the log. The replay simply stops before an
-        unterminated final line instead."""
+        unterminated final line instead.
+
+        Corruption tolerance (snapshot side): the snapshot's CRC
+        frame is verified before anything loads; a torn/corrupt
+        primary falls back to `<path>.prev` (the previous good
+        snapshot, kept as a hardlink), and failing that to an empty
+        store + full log replay — recovery degrades to slower, never
+        to wrong. After the base loads, the delta chain
+        (`<path>.delta-<seq>`, written by snapshot_delta) applies in
+        sequence order while base_id matches and frames verify; the
+        log tail then replays from the last good recorded position."""
+        t0 = time.perf_counter()
         offset = 0
         snap_genesis = None
         store = cls()
-        if path and os.path.exists(path):
-            with open(path) as f:
-                data = json.load(f)
+        store._restored_from = None
+        store._restore_deltas = 0
+        data = None
+        if path:
+            for cand in (path, path + ".prev"):
+                if not os.path.exists(cand):
+                    continue
+                try:
+                    data = _load_framed_json(cand)
+                    if not isinstance(data.get("jobs"), dict):
+                        raise ValueError("snapshot missing jobs table")
+                except Exception:
+                    log.warning(
+                        "restore: snapshot %s unreadable or fails its "
+                        "CRC frame; falling back", cand, exc_info=True)
+                    data = None
+                    continue
+                store._restored_from = cand
+                break
+            if data is None and os.path.exists(path):
+                log.warning("restore: no loadable snapshot at %s; "
+                            "replaying the full log from empty", path)
+        header_genesis = None
+        if data is not None:
             offset = int(data.get("log_lines", 0))
-            snap_genesis = data.get("log_genesis")
+            snap_genesis = header_genesis = data.get("log_genesis")
             for u, jd in data["jobs"].items():
                 job = _job_from_dict(jd)
                 store.jobs[u] = job
                 for inst in job.instances:
                     store.task_to_job[inst.task_id] = u
                 store._reindex(job)
-            for u, gd in data["groups"].items():
+            for u, gd in data.get("groups", {}).items():
                 store.groups[u] = Group(**gd)
             store.rebalancer_config = dict(
                 data.get("rebalancer_config", {}))
+            # delta chain: always probed against the PRIMARY path —
+            # base_id matching makes stale or other-chain deltas
+            # no-ops (and lets a .prev fallback correctly pick up the
+            # chain that was written against it)
+            offset, snap_genesis = store._apply_delta_chain(
+                path, data.get("snap_id"), offset, snap_genesis)
         consumed = offset
         if log_path and os.path.exists(log_path):
             if trim_tail:
@@ -1223,7 +1491,8 @@ class JobStore:
                         # object on the retry)
                         pass
                 if not pre_replayed and path and _retries > 0 and \
-                        _read_snapshot_genesis(path) != snap_genesis:
+                        store._restored_from == path and \
+                        _read_snapshot_genesis(path) != header_genesis:
                     # TOCTOU: the rotation COMPLETED between our
                     # snapshot load (seconds at 100k jobs) and the pre
                     # read — the pre-segment is gone because the fresh
@@ -1253,7 +1522,59 @@ class JobStore:
             store._log_path = log_path
             if open_writer:
                 store._log = _make_log_writer(log_path, trim=trim_tail)
+        # recovery-time evidence for /debug and the crash-soak gate
+        store.restore_ms = (time.perf_counter() - t0) * 1e3
         return store
+
+    def _apply_delta_chain(self, path: str, snap_id: Optional[str],
+                           offset: int, snap_genesis):
+        """Apply `<path>.delta-<seq>` files in sequence order on top of
+        the loaded base snapshot. The chain ends at the first missing
+        seq, CRC/parse failure, or base_id mismatch — whatever the
+        deltas did not cover, the log tail replay does (the caller
+        replays from the returned position), so ending early is always
+        correct. Returns the (log offset, log genesis) recorded by the
+        last applied delta."""
+        if not snap_id:
+            return offset, snap_genesis
+        seq = 1
+        while True:
+            dp = "%s.delta-%d" % (path, seq)
+            if not os.path.exists(dp):
+                break
+            try:
+                d = _load_framed_json(dp)
+            except Exception:
+                log.warning("restore: delta %s torn/corrupt; ending "
+                            "chain (log replay covers the rest)", dp,
+                            exc_info=True)
+                break
+            if d.get("base_id") != snap_id or d.get("seq") != seq:
+                log.warning("restore: delta %s belongs to another "
+                            "chain; ignoring it and the rest", dp)
+                break
+            for u, jd in d.get("jobs", {}).items():
+                job = _job_from_dict(jd)
+                old = self.jobs.get(u)
+                if old is not None:
+                    self._deindex(old)
+                self.jobs[u] = job
+                for inst in job.instances:
+                    self.task_to_job[inst.task_id] = u
+                self._reindex(job)
+            for u in d.get("tombstones", ()):
+                self._retire_job(u)
+            # groups/rebalancer config ride whole in every delta, so
+            # the LAST applied delta's copy is authoritative
+            self.groups = {u: Group(**gd)
+                           for u, gd in d.get("groups", {}).items()}
+            self.rebalancer_config = dict(
+                d.get("rebalancer_config", {}))
+            offset = int(d.get("log_lines", offset))
+            snap_genesis = d.get("log_genesis", snap_genesis)
+            self._restore_deltas = seq
+            seq += 1
+        return offset, snap_genesis
 
     def reload_from(self, snapshot_path: Optional[str] = None) -> None:
         """Re-replay snapshot + log INTO this store, in place.
@@ -1290,6 +1611,13 @@ class JobStore:
             self._usage_jobs = fresh._usage_jobs
             self._replay_max_epoch = fresh._replay_max_epoch
             self._log = fresh._log
+            # the wholesale state swap invalidates any in-process
+            # delta chain: force the next checkpoint to be full
+            self._dirty_jobs = set()
+            self._dirty_tombstones = set()
+            self._delta_base_id = None
+            self._delta_base_path = None
+            self._delta_seq = 1
         if old_log is not None:
             try:
                 old_log.close()
@@ -1638,6 +1966,31 @@ def _job_from_dict(d: dict) -> Job:
     d["state"] = JobState(d["state"])
     job = Job(**{**d, "instances": insts})
     return job
+
+
+def _load_framed_json(path: str) -> dict:
+    """Load a snapshot/delta file, verifying the `#crc <hex> <len>`
+    trailer when present. The document body is newline-free JSON, so
+    the trailer's leading newline is unambiguous. Files from before
+    the framing (no trailer) load unchecked — json parsing itself
+    still rejects truncation. Raises ValueError on CRC mismatch,
+    length mismatch, or unparsable content."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    body = raw
+    tail = raw.rfind(b"\n#crc ")
+    if tail != -1:
+        parts = raw[tail + 1:].split()
+        if len(parts) == 3:
+            body = raw[:tail]
+            want_crc = int(parts[1], 16)
+            want_len = int(parts[2])
+            if len(body) != want_len:
+                raise ValueError("%s: framed length %d != actual %d"
+                                 % (path, want_len, len(body)))
+            if zlib.crc32(body) != want_crc:
+                raise ValueError("%s: CRC mismatch" % path)
+    return json.loads(body)
 
 
 def _read_snapshot_genesis(path: str):

@@ -28,11 +28,12 @@ class BreakerOpenError(ConnectionError):
 class CircuitBreaker:
     __slots__ = ("failure_threshold", "reset_timeout_s", "_clock",
                  "_lock", "_failures", "_state", "_opened_at",
-                 "_probing", "trips")
+                 "_probing", "trips", "on_transition")
 
     def __init__(self, failure_threshold: int = 3,
                  reset_timeout_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Callable[[str, str], None] = None):
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout_s = float(reset_timeout_s)
         self._clock = clock
@@ -42,6 +43,10 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probing = False  # a half-open probe is already in flight
         self.trips = 0  # lifetime CLOSED/HALF_OPEN -> OPEN transitions
+        # fn(old_state, new_state), invoked OUTSIDE the lock on every
+        # state change — the observability hook (/debug transition log,
+        # Prometheus counters); must not raise into the RPC path
+        self.on_transition = on_transition
 
     @property
     def state(self) -> str:
@@ -72,12 +77,20 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            old = self._state_locked()
             self._failures = 0
             self._state = CLOSED
             self._probing = False
+        if old != CLOSED and self.on_transition is not None:
+            try:
+                self.on_transition(old, CLOSED)
+            except Exception:
+                pass
 
     def record_failure(self) -> None:
+        fired = None
         with self._lock:
+            old = self._state_locked()
             self._failures += 1
             if self._state == HALF_OPEN or \
                     self._failures >= self.failure_threshold:
@@ -86,6 +99,13 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self._probing = False
+                if old != OPEN:
+                    fired = (old, OPEN)
+        if fired is not None and self.on_transition is not None:
+            try:
+                self.on_transition(*fired)
+            except Exception:
+                pass
 
     def snapshot(self) -> dict:
         with self._lock:

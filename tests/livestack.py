@@ -1,9 +1,23 @@
 """Shared live-server harness for integration-tier tests.
 
-One embedded HTTP server + coordinator + mock virtual-clock cluster,
-REST-addressable — the testutil.clj run-test-server-in-thread role for
-suites that drive the stack over the wire.
+Two tiers:
+
+- ``Stack``: one embedded HTTP server + coordinator + mock
+  virtual-clock cluster, in-process — the testutil.clj
+  run-test-server-in-thread role for suites that drive the stack over
+  the wire.
+- ``LiveServer``: the real server (``python -m cook_tpu.rest.server``)
+  as a supervised SUBPROCESS over a durable store directory, with
+  procfault kill points armable — the crash-soak harness. A SIGKILL
+  takes the whole process (no atexit, no flushes), exactly like an OOM
+  kill; the supervisor restarts it against the same store dir and the
+  test asserts recovery invariants from outside.
 """
+import json
+import os
+import socket
+
+from cook_tpu.chaos import procfault
 from cook_tpu.backends.base import ClusterRegistry
 from cook_tpu.backends.mock import MockCluster
 from cook_tpu.client import JobClient
@@ -58,3 +72,95 @@ class Stack:
 
     def stop(self):
         self.server.stop()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _merge(base: dict, over: dict) -> dict:
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _merge(base[k], v)
+        else:
+            base[k] = v
+    return base
+
+
+class LiveServer:
+    """Supervised out-of-process server over a durable store dir.
+
+    Agents are expected to run in the TEST process (so launch-count
+    evidence survives server kills); the server subprocess owns the
+    store, the coordinator, and the armed kill points. Small intervals
+    compress a production day's checkpoint/rotation cadence into the
+    soak's seconds.
+    """
+
+    AGENT_TOKEN = "livestack-secret"
+
+    def __init__(self, store_dir, sites=None, seed=0, max_kills=2,
+                 overrides=None):
+        self.store_dir = str(store_dir)
+        os.makedirs(self.store_dir, exist_ok=True)
+        self.port = free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        cfg = {
+            "port": self.port,
+            "url": self.url,
+            "dev_mode": True,
+            "log_path": os.path.join(self.store_dir, "events.log"),
+            "snapshot_path": os.path.join(self.store_dir,
+                                          "snapshot.json"),
+            "snapshot_interval_s": 0.5,
+            "snapshot_delta_chain": 6,
+            "log_rotate_lines": 10_000,
+            "restart_reconcile_timeout_s": 5.0,
+            "auth": {"scheme": "header",
+                     "agent_token": self.AGENT_TOKEN},
+            "clusters": [{"kind": "agent", "name": "agents",
+                          "agent_heartbeat_timeout_s": 3.0}],
+            "scheduler": {"match_interval_s": 0.1,
+                          "launch_ack_timeout_s": 3.0,
+                          "resident_match": False,
+                          "use_pallas": False,
+                          "status_shards": 0},
+        }
+        _merge(cfg, overrides or {})
+        self.config_path = os.path.join(self.store_dir, "config.json")
+        with open(self.config_path, "w") as f:
+            json.dump(cfg, f, indent=1)
+        self.budget_file = os.path.join(self.store_dir, "kills.jsonl")
+        self.server_log = os.path.join(self.store_dir, "server.log")
+        self.sup = procfault.ServerSupervisor(
+            self.config_path, self.url, sites=sites, seed=seed,
+            max_kills=max_kills, budget_file=self.budget_file,
+            log_path=self.server_log)
+
+    def start(self, ready_timeout_s: float = 120.0) -> "LiveServer":
+        self.sup.start(ready_timeout_s)
+        return self
+
+    def ensure_alive(self, ready_timeout_s: float = 120.0) -> bool:
+        return self.sup.ensure_alive(ready_timeout_s)
+
+    def client(self, user: str) -> JobClient:
+        return JobClient(self.url, user=user, timeout=5.0)
+
+    def debug(self) -> dict:
+        import urllib.request
+        with urllib.request.urlopen(self.url + "/debug",
+                                    timeout=5.0) as r:
+            return json.loads(r.read())
+
+    def kills(self) -> list:
+        try:
+            with open(self.budget_file) as f:
+                return [json.loads(l) for l in f if l.strip()]
+        except OSError:
+            return []
+
+    def stop(self) -> None:
+        self.sup.stop()

@@ -1,0 +1,298 @@
+"""Crash soak: the REAL server process under seeded SIGKILL chaos.
+
+Where test_chaos_soak.py injects transport faults into an in-process
+stack, this tier kills the actual coordinator PROCESS — the failure an
+OOM killer or a preempted control-plane VM delivers — and asserts the
+durable-store + delta-snapshot + restart-reconciliation machinery puts
+the world back together. The server runs as a supervised subprocess
+(`tests.livestack.LiveServer`) over a durable store directory; agents
+run in the test process so executor launch counts survive the kills.
+
+Each schedule arms `cook_tpu.chaos.procfault` at a different kill
+point:
+
+  A  cycle.mid        mid match-cycle (scheduler decisions in flight)
+  B  store.launch_txn after the launch txn is durable, BEFORE the
+                      backend launch — the restart sees UNKNOWN
+                      instances and must reconcile them (5003
+                      mea-culpa requeue or adoption, never a burn)
+  C  store.rotate     mid log-rotation (segment swap durability)
+  D  mixed            all of the above plus mid-snapshot-rotate
+
+Traffic is a compressed production day: `cook_tpu.sim.generate_trace`
+with diurnal=True produces two workday bursts whose submit times are
+scaled from 24 h down to seconds.
+
+Invariants (the scheduler's crash-survival promises):
+
+  - no lost jobs: every submitted uuid reaches completed/success;
+  - at-most-once launch: each task_id hits an executor at most once,
+    across ALL server incarnations;
+  - no stuck instances: every instance ends success or failed;
+  - monotone history: a restart never loses instances a poll already
+    observed (per-uuid instance counts never decrease);
+  - bounded recovery: every restart is ready within READY_BOUND_S and
+    reports a sane restore_ms.
+
+The disabled-chaos baseline pins the harness: zero kills, one clean
+instance per job — the armed runs owe their churn to SIGKILL alone.
+
+On failure the server log, the kill ledger, and the store dir listing
+are copied to $CHAOS_ARTIFACTS_DIR for post-mortem replay.
+"""
+import json
+import os
+import shutil
+import time
+import uuid as uuidlib
+
+import pytest
+
+from cook_tpu.agent.daemon import AgentDaemon
+from cook_tpu.sim.gen import generate_trace
+from cook_tpu.state.model import (InstanceStatus, Job, JobState,
+                                  new_uuid)
+from cook_tpu.state.store import JobStore
+from tests.livestack import LiveServer
+
+TERMINAL = ("success", "failed")
+READY_BOUND_S = 20.0
+SOAK_WALL_S = 75.0
+JOBS = 10
+WINDOW_S = 5.0          # the compressed "day" the bursts land in
+
+# seed + site schedule per scenario; probabilities tuned so the kill
+# lands while work is in flight (validated against the live harness)
+SCHEDULES = {
+    "A-cycle": dict(seed=11, max_kills=2,
+                    sites={"cycle.mid": 0.25}),
+    "B-launch-txn": dict(seed=23, max_kills=2,
+                         sites={"store.launch_txn": 0.5}),
+    "C-rotate": dict(seed=37, max_kills=1,
+                     sites={"store.rotate": 1.0},
+                     overrides={"log_rotate_lines": 20}),
+    "D-mixed": dict(seed=5, max_kills=3,
+                    sites={"cycle.mid": 0.10,
+                           "store.launch_txn": 0.20,
+                           "store.snapshot": 0.30,
+                           "store.rotate": 0.50},
+                    overrides={"log_rotate_lines": 30}),
+}
+
+
+def _dump_artifacts(live, tag):
+    out = os.environ.get("CHAOS_ARTIFACTS_DIR")
+    if not out:
+        return
+    os.makedirs(out, exist_ok=True)
+    for src, name in ((live.server_log, f"crash-{tag}-server.log"),
+                      (live.budget_file, f"crash-{tag}-kills.jsonl")):
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(out, name))
+    with open(os.path.join(out, f"crash-{tag}-store-ls.txt"), "w") as f:
+        for entry in sorted(os.listdir(live.store_dir)):
+            st = os.stat(os.path.join(live.store_dir, entry))
+            f.write(f"{entry}\t{st.st_size}\n")
+
+
+def _diurnal_submissions(seed):
+    """A day of diurnal traffic compressed into WINDOW_S seconds:
+    (delay_s, user, priority) per job, sorted by arrival."""
+    trace = generate_trace(n_jobs=JOBS, n_users=3, seed=seed,
+                           submit_window_ms=86_400_000, diurnal=True)
+    scale = WINDOW_S / 86_400_000
+    subs = [(t["submit-time-ms"] * scale, t["job/user"],
+             t["job/priority"]) for t in trace]
+    return sorted(subs)
+
+
+def _soak(tmp_path, tag, sites=None, seed=0, max_kills=2,
+          overrides=None):
+    live = LiveServer(tmp_path / "store", sites=sites, seed=seed,
+                      max_kills=max_kills, overrides=overrides)
+    launch_counts = {}       # task_id -> count, survives server kills
+    daemons = []
+    seen_instances = {}      # uuid -> max instance count observed
+    try:
+        live.start()
+        for i in range(2):
+            d = AgentDaemon(live.url, hostname=f"{tag}-a{i}",
+                            mem=4096.0, cpus=8.0,
+                            sandbox_root=str(tmp_path / f"sbx{i}"),
+                            heartbeat_interval_s=0.5,
+                            agent_token=LiveServer.AGENT_TOKEN)
+            orig = d.executor.launch
+
+            def counted(task_id, *a, _orig=orig, **kw):
+                launch_counts[task_id] = \
+                    launch_counts.get(task_id, 0) + 1
+                return _orig(task_id, *a, **kw)
+
+            d.executor.launch = counted
+            d.start()
+            daemons.append(d)
+
+        clients = {}
+        uuids = []           # (uuid, user) in submit order
+        t0 = time.time()
+        for delay, user, priority in _diurnal_submissions(seed):
+            now = time.time() - t0
+            if delay > now:
+                time.sleep(delay - now)
+            cli = clients.setdefault(user, live.client(user))
+            u = str(uuidlib.uuid4())
+            # submit survives a server kill: on failure, check whether
+            # the write landed before the crash, else respawn + retry
+            for _ in range(8):
+                try:
+                    cli.submit(command="sleep 0.4", mem=64.0, cpus=1.0,
+                               uuid=u, priority=priority, max_retries=4)
+                    break
+                except Exception:
+                    try:
+                        if cli.query_jobs([u]):
+                            break
+                    except Exception:
+                        pass
+                    live.ensure_alive(READY_BOUND_S)
+                    time.sleep(0.25)
+            else:
+                raise AssertionError(f"submit of {u} never landed")
+            uuids.append((u, user))
+
+        def poll():
+            by_user = {}
+            for u, user in uuids:
+                by_user.setdefault(user, []).append(u)
+            out = {}
+            for user, us in by_user.items():
+                for j in clients[user].query_jobs(us):
+                    out[j.uuid] = j
+            return out
+
+        deadline = time.time() + SOAK_WALL_S
+        jobs = {}
+        while time.time() < deadline:
+            live.ensure_alive(READY_BOUND_S)
+            try:
+                jobs = poll()
+            except Exception:
+                continue
+            for u, j in jobs.items():
+                n = len(j.instances)
+                # monotone history: restore never loses instances a
+                # previous poll already observed
+                assert n >= seen_instances.get(u, 0), \
+                    f"{u} instance count shrank across restart"
+                seen_instances[u] = max(n, seen_instances.get(u, 0))
+            if len(jobs) == len(uuids) and \
+                    all(j.status == "completed" for j in jobs.values()):
+                break
+            time.sleep(0.4)
+
+        try:
+            assert len(jobs) == len(uuids), "lost jobs across restarts"
+            for j in jobs.values():
+                assert j.status == "completed", \
+                    f"{j.uuid} stuck in {j.status}"
+                assert j.state == "success", \
+                    f"{j.uuid} completed unsuccessfully ({j.state})"
+                for inst in j.instances:
+                    assert inst.status in TERMINAL, \
+                        f"{inst.task_id} non-terminal: {inst.status}"
+                assert len(j.instances) <= 12, \
+                    f"{j.uuid} churned {len(j.instances)} instances"
+            doubled = {t: n for t, n in launch_counts.items() if n > 1}
+            assert not doubled, f"double-launched task_ids: {doubled}"
+            for t in live.sup.ready_times_s:
+                assert t <= READY_BOUND_S, f"restart took {t:.1f}s"
+        except AssertionError:
+            _dump_artifacts(live, tag)
+            raise
+        if sites:
+            # a seeded kill may land just AFTER the last job finishes
+            # (e.g. the post-completion log rotation): give the
+            # schedule a short settle window so the supervisor observes
+            # the death and the restart before we snapshot /debug
+            settle = time.time() + 10.0
+            while time.time() < settle and \
+                    not (live.kills() and live.sup.deaths):
+                live.ensure_alive(READY_BOUND_S)
+                time.sleep(0.3)
+        live.ensure_alive(READY_BOUND_S)
+        dbg = live.debug()
+        return live, jobs, dbg
+    finally:
+        for d in daemons:
+            d.stop()
+        live.stop()
+
+
+@pytest.mark.parametrize("tag", sorted(SCHEDULES))
+def test_crash_soak_invariants(tmp_path, tag):
+    sched = SCHEDULES[tag]
+    live, jobs, dbg = _soak(tmp_path, tag, sites=sched["sites"],
+                            seed=sched["seed"],
+                            max_kills=sched["max_kills"],
+                            overrides=sched.get("overrides"))
+    # the schedule must actually have bitten: at least one recorded
+    # SIGKILL and one observed death, else this silently degrades into
+    # the baseline test
+    kills = live.kills()
+    assert kills, f"{tag}: no kill ever fired"
+    assert live.sup.deaths, f"{tag}: supervisor observed no death"
+    assert all(k["site"] in sched["sites"] for k in kills)
+    # every restart restored and reconciled: /debug reports recovery.
+    # restored_from may be None when the kill landed before the first
+    # full snapshot (log-only replay) — restore_ms is always stamped.
+    rec = dbg.get("recovery", {})
+    assert rec.get("restore_ms", -1) >= 0
+    assert "restart_reconcile" in rec
+
+
+def test_mea_culpa_5003_accounting_survives_restart(tmp_path):
+    """The restart-reconciliation requeue (5003 launch-ack-timeout) is
+    a mea-culpa failure: free up to its failure_limit, and the
+    accounting must come out identical after snapshot + restore — a
+    crash must never silently burn (or refund) user retries."""
+    log = str(tmp_path / "events.log")
+    snap = str(tmp_path / "snapshot.json")
+    store = JobStore(log_path=log)
+    job = Job(uuid=new_uuid(), user="alice", command="echo x",
+              mem=10.0, cpus=1.0, max_retries=2)
+    store.create_jobs([job])
+    for _ in range(3):            # failure_limit for 5003 is 3
+        inst = store.create_instance(job.uuid, "h0", "agents")
+        store.update_instance(inst.task_id, InstanceStatus.FAILED,
+                              reason_code=5003)
+    assert job.attempts_consumed() == 0, \
+        "mea-culpa 5003 failures within the limit must be free"
+    assert job.retries_remaining() == job.max_retries
+    store.snapshot(snap)
+
+    restored = JobStore.restore(snap, log_path=log, open_writer=False)
+    rjob = restored.jobs[job.uuid]
+    assert len(rjob.instances) == 3
+    assert [i.reason_code for i in rjob.instances] == [5003] * 3
+    assert rjob.attempts_consumed() == job.attempts_consumed() == 0
+    assert rjob.retries_remaining() == job.max_retries
+    assert rjob.state == JobState.WAITING, \
+        "job must still be requeued after restore, not exhausted"
+
+    # the next 5003 exceeds the failure_limit and burns a real attempt
+    inst = store.create_instance(job.uuid, "h0", "agents")
+    store.update_instance(inst.task_id, InstanceStatus.FAILED,
+                          reason_code=5003)
+    assert job.attempts_consumed() == 1
+
+
+def test_crash_soak_disabled_baseline(tmp_path):
+    """Same harness, no kill sites armed: zero kills, zero deaths, one
+    clean instance per job."""
+    live, jobs, dbg = _soak(tmp_path, "baseline")
+    assert live.kills() == []
+    assert live.sup.deaths == []
+    assert live.sup.incarnation == 0
+    for j in jobs.values():
+        assert len(j.instances) == 1
+        assert j.instances[0].status == "success"
