@@ -571,6 +571,153 @@ def bench_stream(total_jobs=1_000_000, R=10_000, P=100_000, H=10_000,
     }), flush=True)
 
 
+def bench_ingest(n_single=2_000, n_conc=8_000, n_bulk=30_000,
+                 threads=8, batch=256, workers=4, stats_out=None):
+    """Durable REST ingest throughput (the submit half of the
+    kernel<->control-plane gap): jobs/s from POST to 201, where every
+    201 means the job's group-commit fdatasync already ran.
+
+    Three legs over the SAME live HTTP server + durable store:
+
+      single-seq   one client, one job per request — one fsync per
+                   job, the pre-round-7 wire pattern (nothing to
+                   coalesce, so the batcher degenerates to it);
+      single-conc  `threads` concurrent clients, one job per request —
+                   the ingest workers coalesce concurrent singles into
+                   shared group commits;
+      bulk         `threads` concurrent clients posting /jobs/bulk
+                   batches of `batch` — admission queue + coalescing +
+                   one fdatasync per drained batch.
+
+    The run ends with a cold replay of the event log asserting every
+    acked uuid is reconstructable from disk alone — throughput that
+    cheated the barrier would fail here."""
+    import tempfile
+    import threading as th
+    import uuid as uuidlib
+
+    from cook_tpu.backends.base import ClusterRegistry
+    from cook_tpu.backends.mock import MockCluster, MockHost
+    from cook_tpu.client import JobClient
+    from cook_tpu.rest.api import CookApi
+    from cook_tpu.rest.auth import AuthConfig
+    from cook_tpu.rest.ingest import IngestBatcher
+    from cook_tpu.rest.server import ApiServer
+    from cook_tpu.scheduler.coordinator import Coordinator, SchedulerConfig
+    from cook_tpu.state.store import JobStore
+
+    fd, log_path = tempfile.mkstemp(prefix="cook_ingest_", suffix=".log")
+    os.close(fd)
+    store = JobStore(log_path=log_path)
+    reg = ClusterRegistry()
+    reg.register(MockCluster([MockHost("h0", mem=1000.0, cpus=16.0)]))
+    coord = Coordinator(store, reg, config=SchedulerConfig())
+    ingest = IngestBatcher(store, workers=workers, queue_depth=256,
+                           max_batch=512)
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header"), ingest=ingest)
+    server = ApiServer(api).start()
+    acked = []              # uuids whose 201 we actually received
+    acked_lock = th.Lock()
+    try:
+        def spec():
+            return {"uuid": str(uuidlib.uuid4()), "command": "true",
+                    "mem": 32.0, "cpus": 0.5}
+
+        def run_threads(n, fn):
+            errs = []
+
+            def worker(i):
+                try:
+                    fn(i)
+                except Exception as e:   # surface, don't hang the join
+                    errs.append(e)
+
+            ts = [th.Thread(target=worker, args=(i,)) for i in range(n)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+            return time.perf_counter() - t0
+
+        # leg 1: sequential singles, one fsync per 201
+        cli = JobClient(server.url, user="u0")
+
+        def single_seq(_i):
+            for _ in range(n_single):
+                s = spec()
+                cli.submit(command=s["command"], mem=s["mem"],
+                           cpus=s["cpus"], uuid=s["uuid"])
+                with acked_lock:
+                    acked.append(s["uuid"])
+
+        single_s = n_single / run_threads(1, single_seq)
+
+        # leg 2: concurrent singles — the batcher coalesces across
+        # requests, so fsyncs amortize even at one job per POST
+        per = n_conc // threads
+        clis = [JobClient(server.url, user=f"u{i}") for i in range(threads)]
+
+        def single_conc(i):
+            for _ in range(per):
+                s = spec()
+                clis[i].submit(command=s["command"], mem=s["mem"],
+                               cpus=s["cpus"], uuid=s["uuid"])
+                with acked_lock:
+                    acked.append(s["uuid"])
+
+        conc_s = (per * threads) / run_threads(threads, single_conc)
+
+        # leg 3: bulk batches through /jobs/bulk + admission control
+        nb = n_bulk // (threads * batch)
+
+        def bulk(i):
+            for _ in range(nb):
+                specs = [spec() for _ in range(batch)]
+                got = clis[i].submit_jobs_bulk(specs)
+                with acked_lock:
+                    acked.extend(got)
+
+        bulk_s = (nb * batch * threads) / run_threads(threads, bulk)
+
+        # 201-after-durable, proven cold: replay the log like a
+        # post-crash restart and demand every acked uuid
+        replayed = JobStore.restore(None, log_path=log_path,
+                                    open_writer=False)
+        missing = [u for u in acked if u not in replayed.jobs]
+        out = {
+            "metric": "durable REST ingest, jobs/s at 201-after-fsync",
+            "value": round(bulk_s, 1),
+            "unit": "jobs/sec",
+            "single_seq_jps": round(single_s, 1),
+            "single_conc_jps": round(conc_s, 1),
+            "bulk_jps": round(bulk_s, 1),
+            "coalesce_speedup": round(conc_s / single_s, 2),
+            "bulk_speedup": round(bulk_s / single_s, 2),
+            "threads": threads,
+            "batch": batch,
+            "ingest_workers": workers,
+            "acked_total": len(acked),
+            "durability_check": {"acked_all_durable": not missing,
+                                 "acked": len(acked),
+                                 "replayed": len(replayed.jobs),
+                                 "missing": len(missing)},
+        }
+        if stats_out is not None:
+            stats_out.update(out)
+        print(json.dumps(out), flush=True)
+    finally:
+        server.stop()
+        ingest.stop()
+        try:
+            os.unlink(log_path)
+        except OSError:
+            pass
+
+
 def _drain_trace(coord, into: list) -> None:
     """Move coordinator.consume_trace records into `into` so the
     deque's maxlen can never silently truncate a long run's
@@ -588,7 +735,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
               async_consumer=False, rotate_lines=1_000_000,
               retention_s=120.0,
               label="e2e coordinator @ 100k-pending x 10k-offers",
-              stats_out=None):
+              stats_out=None, durability_check=False, consider=None):
     """END-TO-END production path: Coordinator.match_cycle itself — the
     durable store (100k pending + ~10k running), device-resident
     tensors updated by store-event deltas, the real launch transaction
@@ -650,9 +797,14 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
     # status_shards=19 = the production server default: bulk status
     # writeback applies on the sharded executors, off the consumer
     # thread, exactly as a deployment runs it
-    coord = Coordinator(store, reg, config=SchedulerConfig(
-        sequential_match_threshold=sequential_threshold),
-        status_shards=19)
+    cfg = SchedulerConfig(sequential_match_threshold=sequential_threshold)
+    if consider:
+        # deeper considerable window (fenzo-max-jobs-considered): the
+        # group-commit/batched-wire path amortizes the cycle's fixed
+        # costs (fsync, launch RPC, dispatch overhead) over `consider`
+        # decisions instead of the default 1024
+        cfg.max_jobs_considered = consider
+    coord = Coordinator(store, reg, config=cfg, status_shards=19)
 
     # cleanup in finally: a mid-run failure (tunnel outage,
     # Ctrl-C during a 10-minute run) must not leak the consumer/
@@ -888,6 +1040,35 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
 
         n_pend = len(store.pending_jobs("default"))
         n_run = len(store.running_instances("default"))
+
+        # ack-durability gate (CI e2e-perf-smoke): stop the background
+        # writers, then rebuild the store cold exactly as a post-crash
+        # restart would (snapshot chain if a rotation happened, else
+        # full log replay) and demand every acked-and-still-live job
+        # is reconstructable from disk alone. Throughput that leaked
+        # acked submissions would fail here, not ship.
+        durability = None
+        if durability_check:
+            rot_stop.set()
+            rot_thread.join(timeout=30)
+            ret_thread.join(timeout=30)
+            replayed = JobStore.restore(
+                snap_path if rotations else None,
+                log_path=log_path, open_writer=False)
+            live_pending = {j.uuid for j in store.pending_jobs()}
+            live_running = {i.task_id for i in store.running_instances()}
+            cold_pending = {j.uuid for j in replayed.pending_jobs()}
+            cold_running = {i.task_id
+                            for i in replayed.running_instances()}
+            durability = {
+                "acked_all_durable": (live_pending <= cold_pending
+                                      and live_running <= cold_running),
+                "live_pending": len(live_pending),
+                "cold_pending": len(cold_pending),
+                "live_running": len(live_running),
+                "cold_running": len(cold_running),
+            }
+
         out = {
             "metric": f"sched decisions/sec, {label}",
             "value": round(dps, 1),
@@ -976,6 +1157,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             "wall_s": round(total_s, 1),
             "device": str(jax.devices()[0]),
         }
+        if durability is not None:
+            out["durability_check"] = durability
         if stats_out is not None:
             stats_out.update(out)
         print(json.dumps(out), flush=True)
@@ -1291,9 +1474,20 @@ def main():
         bench_stream()
     elif which == "e2e":
         bench_e2e()
+    elif which == "ingest":
+        # durable REST ingest throughput: sequential singles vs
+        # coalesced concurrent singles vs /jobs/bulk batches, with the
+        # cold-replay ack-durability check
+        bench_ingest()
     elif which == "e2e-small":
         bench_e2e(P0=20_000, H=2_000, cycles=60, warmup=10,
                   label="e2e coordinator @ 20k-pending x 2k-offers")
+    elif which == "e2e-smoke":
+        # CI perf gate: reduced scale, plus the cold-replay ack-
+        # durability self-check (no acked job may exist only in RAM)
+        bench_e2e(P0=20_000, H=2_000, cycles=60, warmup=10,
+                  durability_check=True,
+                  label="e2e perf smoke @ 20k-pending x 2k-offers")
     elif which == "e2e-batched":
         # batched matcher on the resident path (exact head + audited
         # windows instead of the full C-step sequential scan)
@@ -1334,8 +1528,9 @@ def main():
         bench_pallas()
     else:
         raise SystemExit(f"unknown config {which!r}; one of: headline "
-                         "contended small pools rebalance stream e2e "
-                         "e2e-small e2e-batched e2e-async longevity "
+                         "contended small pools rebalance stream e2e ingest "
+                         "e2e-small e2e-smoke e2e-batched e2e-async "
+                         "longevity "
                          "longevity-async trace-overhead chaos-overhead "
                          "crash-soak pallas")
 

@@ -36,6 +36,7 @@ from typing import Optional
 
 from cook_tpu.agent.executor import Executor
 from cook_tpu.agent.file_server import FileServer
+from cook_tpu.backends import specwire
 from cook_tpu.utils.httpjson import json_request
 from cook_tpu.utils.metrics import registry as metrics_registry
 from cook_tpu.utils.retry import RetryPolicy
@@ -126,6 +127,11 @@ class AgentDaemon:
         daemon = self
 
         class Handler(BaseHTTPRequestHandler):
+            # 1.1 keeps the coordinator's pooled connections alive
+            # across launch/kill posts (every response sets
+            # Content-Length, so framing is sound)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):
                 pass
 
@@ -146,11 +152,22 @@ class AgentDaemon:
                     self._json(401, {"error": "bad agent token"})
                     return
                 length = int(self.headers.get("Content-Length", 0))
-                try:
-                    payload = json.loads(self.rfile.read(length) or b"{}")
-                except ValueError:
-                    self._json(400, {"error": "malformed json"})
-                    return
+                body = self.rfile.read(length)
+                ctype = self.headers.get("Content-Type", "")
+                if ctype.split(";", 1)[0].strip() == \
+                        specwire.CONTENT_TYPE:
+                    try:
+                        payload = {"specs": specwire.decode_specs(body)}
+                    except ValueError:
+                        self._json(400,
+                                   {"error": "malformed spec frame"})
+                        return
+                else:
+                    try:
+                        payload = json.loads(body or b"{}")
+                    except ValueError:
+                        self._json(400, {"error": "malformed json"})
+                        return
                 if self.path == "/launch":
                     self._json(200, daemon.handle_launch(payload))
                 elif self.path == "/kill":
@@ -206,6 +223,9 @@ class AgentDaemon:
                 f"http://{self.advertise_host}:{self.file_server.port}",
             "tasks": sorted(self.executor.alive_task_ids()),
             "outbox_dropped": self.outbox_dropped,
+            # binary launch framings this daemon can decode; the
+            # coordinator falls back to JSON when absent
+            "spec_wire": [specwire.WIRE_FORMAT],
         }
 
     def _register(self, block: bool = False) -> None:

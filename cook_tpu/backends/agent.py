@@ -28,11 +28,12 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from cook_tpu import obs
+from cook_tpu.backends import specwire
 from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
 from cook_tpu.state.model import InstanceStatus, now_ms
 from cook_tpu.utils.breaker import (
     BreakerOpenError, CircuitBreaker, CLOSED, OPEN)
-from cook_tpu.utils.httpjson import json_request
+from cook_tpu.utils.httpjson import json_request, raw_request
 from cook_tpu.utils.metrics import registry as metrics_registry
 
 logger = logging.getLogger(__name__)
@@ -57,6 +58,9 @@ class AgentInfo:
     # outbox overflowed and dropped (reported on register/heartbeat);
     # surfaced in /debug + Prometheus so silent status loss is visible
     outbox_dropped: int = 0
+    # binary launch framings the daemon advertised at registration
+    # (e.g. ("cks1",)); empty for old daemons -> JSON launch body
+    spec_wire: tuple = ()
 
 
 class AgentCluster(ComputeCluster):
@@ -125,7 +129,8 @@ class AgentCluster(ComputeCluster):
             pool=payload.get("pool", "default"),
             attributes=dict(payload.get("attributes", {})),
             file_server_url=payload.get("file_server_url", ""),
-            last_heartbeat_ms=now_ms())
+            last_heartbeat_ms=now_ms(),
+            spec_wire=tuple(payload.get("spec_wire", ())))
         reported = set(payload.get("tasks", []))
         grace_cutoff = now_ms() - int(self.lost_task_grace_s * 1000)
         info.outbox_dropped = int(payload.get("outbox_dropped", 0))
@@ -385,10 +390,20 @@ class AgentCluster(ComputeCluster):
                     self.emit_status(s.task_id, InstanceStatus.FAILED,
                                      REASON_HOST_LOST)
                 continue
+            wire = [_spec_wire(s) for s in host_specs]
             try:
-                self._post(info.url + "/launch", {
-                    "specs": [_spec_wire(s) for s in host_specs]},
-                    hostname=hostname, chaos_site="backend.launch")
+                # agents that advertised the binary framing get the
+                # compact frame; everyone else the legacy JSON body
+                if specwire.WIRE_FORMAT in info.spec_wire:
+                    self._post(info.url + "/launch", None,
+                               hostname=hostname,
+                               chaos_site="backend.launch",
+                               raw=specwire.encode_specs(wire),
+                               content_type=specwire.CONTENT_TYPE)
+                else:
+                    self._post(info.url + "/launch", {"specs": wire},
+                               hostname=hostname,
+                               chaos_site="backend.launch")
             except Exception as e:
                 logger.warning("launch to agent %s failed: %s", hostname, e)
                 for s in host_specs:
@@ -563,8 +578,10 @@ class AgentCluster(ComputeCluster):
                 self._breakers[hostname] = br
             return br
 
-    def _post(self, url: str, payload: dict, hostname: str = "",
-              chaos_site: str = "") -> dict:
+    def _post(self, url: str, payload: Optional[dict],
+              hostname: str = "", chaos_site: str = "",
+              raw: Optional[bytes] = None,
+              content_type: str = "") -> dict:
         br = self._breaker(hostname) if hostname else None
         if br is not None and not br.allow():
             raise BreakerOpenError(f"agent {hostname}: circuit open")
@@ -572,9 +589,18 @@ class AgentCluster(ComputeCluster):
         if self.agent_token:
             headers["X-Cook-Agent-Token"] = self.agent_token
         try:
-            resp = json_request("POST", url, payload, headers=headers,
-                                timeout=self.request_timeout_s,
-                                chaos_site=chaos_site)
+            if raw is not None:
+                # pre-encoded body (binary spec frame); same breaker +
+                # chaos semantics as the JSON path
+                resp = raw_request("POST", url, raw, content_type,
+                                   headers=headers,
+                                   timeout=self.request_timeout_s,
+                                   chaos_site=chaos_site)
+            else:
+                resp = json_request("POST", url, payload,
+                                    headers=headers,
+                                    timeout=self.request_timeout_s,
+                                    chaos_site=chaos_site)
         except Exception:
             if br is not None:
                 before = br.trips

@@ -1,0 +1,125 @@
+"""Compact binary framing for the coordinator->agent launch RPC.
+
+The launch hot path ships every matched task's ``LaunchSpec`` to its
+agent inside one POST per host per cycle. At bench scale (1k+ matches
+per cycle) the JSON encode/decode of those spec lists is a measurable
+slice of the dispatch phase, and most of the bytes are repeated field
+names. This module frames the exact ``_spec_wire`` dict shape as a
+length-prefixed binary record instead:
+
+    frame  := magic "CKS1" | u32 count | spec*
+    spec   := str task_id | str job_uuid | str hostname | str command
+            | f64 mem | f64 cpus | f64 gpus
+            | u32 nenv | (str key, str value)*
+            | jstr container              # JSON object, empty = null
+            | str progress_regex | str progress_output_file
+            | u32 nports | u32 port*
+            | jstr uris                   # JSON list (possibly "[]")
+            | str traceparent
+    str    := u32 byte_length | utf-8 bytes
+    jstr   := str carrying a JSON document (rare/nested fields keep
+              JSON so the frame format never chases their schema)
+
+All integers are little-endian. The format is *negotiated*, never
+assumed: the agent daemon advertises ``"spec_wire": ["cks1"]`` in its
+register payload, and the coordinator falls back to the JSON body for
+agents that never advertised it (old daemons keep working unmodified).
+Decode failures raise ``ValueError`` so the server side can answer 400
+exactly like malformed JSON.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+MAGIC = b"CKS1"
+WIRE_FORMAT = "cks1"              # capability token in register payload
+CONTENT_TYPE = "application/x-cook-specs"
+
+_U32 = struct.Struct("<I")
+_F64x3 = struct.Struct("<ddd")
+
+
+def _pack_str(out: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    out += _U32.pack(len(b))
+    out += b
+
+
+def encode_specs(specs: list[dict]) -> bytes:
+    """Frame a list of ``_spec_wire`` dicts (the JSON body's "specs")."""
+    out = bytearray(MAGIC)
+    out += _U32.pack(len(specs))
+    for d in specs:
+        _pack_str(out, d.get("task_id", ""))
+        _pack_str(out, d.get("job_uuid", ""))
+        _pack_str(out, d.get("hostname", ""))
+        _pack_str(out, d.get("command", ""))
+        out += _F64x3.pack(float(d.get("mem", 0.0)),
+                           float(d.get("cpus", 0.0)),
+                           float(d.get("gpus", 0.0)))
+        env = d.get("env") or {}
+        out += _U32.pack(len(env))
+        for k, v in env.items():
+            _pack_str(out, str(k))
+            _pack_str(out, str(v))
+        container = d.get("container")
+        _pack_str(out, "" if container is None
+                  else json.dumps(container, separators=(",", ":")))
+        _pack_str(out, d.get("progress_regex", ""))
+        _pack_str(out, d.get("progress_output_file", ""))
+        ports = d.get("ports") or []
+        out += _U32.pack(len(ports))
+        for p in ports:
+            out += _U32.pack(int(p))
+        _pack_str(out, json.dumps(list(d.get("uris") or []),
+                                  separators=(",", ":")))
+        _pack_str(out, d.get("traceparent", ""))
+    return bytes(out)
+
+
+class _Cursor:
+    __slots__ = ("data", "off")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def take(self, n: int) -> bytes:
+        end = self.off + n
+        if n < 0 or end > len(self.data):
+            raise ValueError("spec frame truncated")
+        b = self.data[self.off:end]
+        self.off = end
+        return b
+
+    def u32(self) -> int:
+        return _U32.unpack(self.take(4))[0]
+
+    def s(self) -> str:
+        return self.take(self.u32()).decode("utf-8")
+
+
+def decode_specs(data: bytes) -> list[dict]:
+    """Inverse of :func:`encode_specs`; raises ValueError when the
+    frame is malformed (bad magic, truncation, trailing bytes)."""
+    cur = _Cursor(data)
+    if cur.take(4) != MAGIC:
+        raise ValueError("bad spec frame magic")
+    specs = []
+    for _ in range(cur.u32()):
+        d: dict = {"task_id": cur.s(), "job_uuid": cur.s(),
+                   "hostname": cur.s(), "command": cur.s()}
+        d["mem"], d["cpus"], d["gpus"] = _F64x3.unpack(cur.take(24))
+        d["env"] = {cur.s(): cur.s() for _ in range(cur.u32())}
+        raw = cur.s()
+        d["container"] = json.loads(raw) if raw else None
+        d["progress_regex"] = cur.s()
+        d["progress_output_file"] = cur.s()
+        d["ports"] = [cur.u32() for _ in range(cur.u32())]
+        d["uris"] = json.loads(cur.s())
+        d["traceparent"] = cur.s()
+        specs.append(d)
+    if cur.off != len(data):
+        raise ValueError("trailing bytes after spec frame")
+    return specs

@@ -133,12 +133,19 @@ class SchedulerSettings:
     # acknowledged RUNNING within this window fails 5003 (mea-culpa)
     # and requeues; must exceed the worst honest fetch+start time
     launch_ack_timeout_s: float = 300.0
+    # async consume executor: how many keyed in-order workers drain
+    # matched prefixes (cycle consume/launch). Each pool's work stays
+    # on one worker (per-pool ordering preserved); multiple pools
+    # drain concurrently. 1 = the old single shared consumer thread.
+    consume_workers: int = 4
 
     def validate(self) -> None:
         if self.max_jobs_considered < 1:
             raise ConfigError("max_jobs_considered must be >= 1")
         if self.launch_ack_timeout_s <= 0:
             raise ConfigError("launch_ack_timeout_s must be > 0")
+        if self.consume_workers < 1:
+            raise ConfigError("consume_workers must be >= 1")
         if not 0 < self.scaleback <= 1:
             raise ConfigError("scaleback must be in (0, 1]")
         if self.rebalancer_candidate_cap < 0:
@@ -254,6 +261,13 @@ class Settings:
     # :default-checkpoint-config): merged under each job's checkpoint
     # config by the matcher and the kube backend
     checkpoint: dict = field(default_factory=dict)
+    # coalescing ingest (rest/ingest.py): submissions commit through a
+    # bounded queue drained by N workers, one group-commit fdatasync
+    # per drained batch; a full queue answers 429 + Retry-After.
+    # ingest_workers: 0 disables the layer (one txn per request).
+    ingest_workers: int = 2
+    ingest_queue_depth: int = 512
+    ingest_max_batch: int = 512
 
     @classmethod
     def from_dict(cls, raw: dict) -> "Settings":
@@ -306,6 +320,13 @@ class Settings:
         if self.restart_reconcile_timeout_s < 0:
             raise ConfigError("restart_reconcile_timeout_s must be "
                               ">= 0 (0 = no match-cycle gate)")
+        if self.ingest_workers < 0:
+            raise ConfigError("ingest_workers must be >= 0 "
+                              "(0 = no ingest batching)")
+        if self.ingest_workers and (self.ingest_queue_depth < 1
+                                    or self.ingest_max_batch < 1):
+            raise ConfigError("ingest_queue_depth and ingest_max_batch "
+                              "must be >= 1 when ingest_workers > 0")
         # a write-capable machine channel must not default open: an
         # agent cluster without an agent token is only a dev setup
         if any(c.kind == "agent" for c in self.clusters) \

@@ -172,6 +172,23 @@ int64_t el_append(int64_t h, const char* s, int64_t len) {
   return log->existing + log->appended;
 }
 
+int64_t el_append_batch(int64_t h, const char* s, int64_t len,
+                        int64_t nlines) {
+  // s is nlines pre-terminated records ('\n' after every record,
+  // including the last): one mutex acquisition and one buffer splice
+  // for the whole batch, so bulk transactions stop paying a lock
+  // round-trip per line. The syncer's durable-watermark accounting
+  // counts '\n' bytes, so it needs no changes.
+  auto log = get(h);
+  if (!log || nlines <= 0) return -1;
+  std::lock_guard<std::mutex> lk(log->mu);
+  log->buf.append(s, (size_t)len);
+  log->buffered += nlines;
+  log->appended += nlines;
+  log->cv_work.notify_one();
+  return log->existing + log->appended;
+}
+
 int64_t el_lines(int64_t h) {
   auto log = get(h);
   if (!log) return -1;

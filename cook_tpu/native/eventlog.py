@@ -31,6 +31,15 @@ def _load():
     lib.el_append.restype = ctypes.c_int64
     lib.el_lines.argtypes = [ctypes.c_int64]
     lib.el_lines.restype = ctypes.c_int64
+    try:
+        lib.el_append_batch.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                        ctypes.c_int64, ctypes.c_int64]
+        lib.el_append_batch.restype = ctypes.c_int64
+        lib._has_append_batch = True
+    except AttributeError:
+        # stale cached .so from before the batch entry point existed;
+        # append_many degrades to per-line appends
+        lib._has_append_batch = False
     lib.el_sync.argtypes = [ctypes.c_int64, ctypes.c_int64]
     lib.el_sync.restype = ctypes.c_int
     lib.el_close.argtypes = [ctypes.c_int64]
@@ -62,6 +71,20 @@ class NativeLogWriter:
         b = line.encode()
         if self._lib.el_append(self._h, b, len(b)) < 0:
             raise OSError("el_append failed")
+
+    def append_many(self, lines) -> None:
+        """Batch append: one native call (one writer-mutex acquisition,
+        one buffer splice) for the whole batch. Durability is unchanged
+        — sync() still waits for the group-commit watermark."""
+        if not lines:
+            return
+        if not getattr(self._lib, "_has_append_batch", False):
+            for ln in lines:
+                self.append(ln)
+            return
+        b = ("\n".join(lines) + "\n").encode()
+        if self._lib.el_append_batch(self._h, b, len(b), len(lines)) < 0:
+            raise OSError("el_append_batch failed")
 
     def lines(self) -> int:
         return int(self._lib.el_lines(self._h))

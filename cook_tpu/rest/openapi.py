@@ -19,6 +19,7 @@ from typing import Any
 # job schema lives in docs/api.md)
 _BODY_HINTS = {
     ("POST", "/jobs"): "JobSubmission",
+    ("POST", "/jobs/bulk"): "JobSubmission",
     ("POST", "/rawscheduler"): "JobSubmission",
     ("POST", "/retry"): "RetryRequest",
     ("POST", "/share"): "LimitUpdate",

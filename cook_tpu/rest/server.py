@@ -351,7 +351,8 @@ def build_scheduler(config, read_only=False):
             sequential_match_threshold=s.sequential_match_threshold,
             use_pallas=_resolve_use_pallas(s.use_pallas,
                                            s.max_jobs_considered),
-            launch_ack_timeout_s=s.launch_ack_timeout_s),
+            launch_ack_timeout_s=s.launch_ack_timeout_s,
+            consume_workers=s.consume_workers),
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,
@@ -402,6 +403,17 @@ def build_scheduler(config, read_only=False):
             interval_s=float(opt_cfg.get("interval_s", 30.0)))
 
     monitor = StatsMonitor(store, coord.shares, metrics_mod.registry)
+    # coalescing ingest between the REST handlers and the store: one
+    # group-commit fdatasync per drained batch of submissions, bounded
+    # queue -> 429 + Retry-After when the front door saturates. A
+    # read-only replica never commits, so it gets no batcher.
+    ingest = None
+    if config.ingest_workers > 0 and not read_only:
+        from cook_tpu.rest.ingest import IngestBatcher
+        ingest = IngestBatcher(store,
+                               workers=config.ingest_workers,
+                               queue_depth=config.ingest_queue_depth,
+                               max_batch=config.ingest_max_batch)
     api = CookApi(
         store, coordinator=coord,
         auth=AuthConfig(scheme=config.auth.scheme,
@@ -419,7 +431,8 @@ def build_scheduler(config, read_only=False):
             max_gpus=config.task_constraints.max_gpus,
             max_retries=config.task_constraints.max_retries),
         submission_rate_limiter=make_rl("user_submit"),
-        settings=config.public(), leader_url=config.url)
+        settings=config.public(), leader_url=config.url,
+        ingest=ingest)
     coord.monitor = monitor
     return store, coord, api
 
@@ -686,6 +699,8 @@ def main(argv=None) -> None:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.stop()
+        if api.ingest is not None:
+            api.ingest.stop()
         coord.stop()
         if elector is not None:
             elector.stop()

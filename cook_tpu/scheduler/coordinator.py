@@ -106,6 +106,12 @@ class SchedulerConfig:
     # honest fetch+start time (image pulls, uri downloads); reconcile()
     # can't cover this case because it only resyncs RUNNING instances
     launch_ack_timeout_s: float = 300.0
+    # async consume executor width: keyed in-order workers draining
+    # matched prefixes (readback -> launch txn -> backend hand-off).
+    # One pool's cycles always land on the same worker (ordering), but
+    # different pools drain concurrently instead of serializing on the
+    # single consumer thread this replaced.
+    consume_workers: int = 4
 
 
 @dataclass
@@ -513,13 +519,22 @@ class Coordinator:
                                  name=f"resident-launcher-{pool}")
             t.start()
             self._threads.append(t)
-        if not synchronous and not hasattr(self, "_consume_q"):
-            import queue
-            self._consume_q: "queue.Queue" = queue.Queue(maxsize=2)
-            t = threading.Thread(target=self._consume_loop, daemon=True,
-                                 name="resident-consumer")
-            t.start()
-            self._threads.append(t)
+        if not synchronous:
+            # per-pool consume backpressure (the role the old shared
+            # maxsize=2 queue played, now per pool): at most 2 cycles
+            # outstanding between dispatch and consumed
+            rp._consume_slots = threading.BoundedSemaphore(2)
+        if not synchronous and getattr(self, "_consume_shards",
+                                       None) is None:
+            # keyed in-order consume executor: cycles of ONE pool stay
+            # on one worker (per-pool ordering — launch txns of cycle N
+            # commit before cycle N+1's), while different pools drain
+            # concurrently instead of serializing on a single consumer
+            # thread
+            from cook_tpu.scheduler.shards import InOrderShards
+            self._consume_shards = InOrderShards(
+                max(1, self.config.consume_workers),
+                self._consume_one, name="resident-consumer")
 
     def _resident_listener(self, kind: str, data: dict) -> None:
         # snapshot: enable_resident pops/re-inserts entries from the
@@ -558,25 +573,25 @@ class Coordinator:
             finally:
                 rp._launch_q.task_done()
 
-    def _consume_loop(self) -> None:
-        while True:
-            item = self._consume_q.get()
-            if item is None:
-                return
-            pool, rp, out = item
-            try:
-                self._consume_cycle(pool, rp, out)
-            except Exception:
-                # the device already depleted this cycle's matched
-                # capacity and invalidated the matched rows; without a
-                # successful readback we cannot credit them back row by
-                # row — rebuild from the store/backend truth instead
-                log.exception("resident consume failed; scheduling "
-                              "full resync")
-                rp.consumed_through = out.cycle_no
-                if rp._inflight and rp._inflight[0] is out:
-                    rp._inflight.popleft()
-                rp.request_resync()
+    def _consume_one(self, pool: str, rp, out) -> None:
+        """Consume-shard handler: one cycle's readback + launch txn +
+        backend hand-off, releasing the pool's backpressure slot when
+        done (success or failure)."""
+        try:
+            self._consume_cycle(pool, rp, out)
+        except Exception:
+            # the device already depleted this cycle's matched
+            # capacity and invalidated the matched rows; without a
+            # successful readback we cannot credit them back row by
+            # row — rebuild from the store/backend truth instead
+            log.exception("resident consume failed; scheduling "
+                          "full resync")
+            rp.consumed_through = out.cycle_no
+            if rp._inflight and rp._inflight[0] is out:
+                rp._inflight.popleft()
+            rp.request_resync()
+        finally:
+            rp._consume_slots.release()
 
     def drain_resident(self, pool: Optional[str] = None) -> None:
         """Block until every in-flight resident cycle is consumed AND
@@ -784,13 +799,14 @@ class Coordinator:
                     stats.matched = last["matched"]
                     stats.head_matched = last["head_matched"]
         else:
-            # backpressure at queue depth 2: the time spent blocked here
-            # is the consumer lagging the producer — a co-located
-            # deployment with a keeping-up consumer pays ~0, so the
-            # metric lets the bench (and /debug) separate dispatch work
-            # from backpressure in the cycle wall
+            # backpressure at 2 outstanding cycles PER POOL: the time
+            # spent blocked here is this pool's consumer lagging the
+            # producer — a keeping-up consumer pays ~0, so the metric
+            # lets the bench (and /debug) separate dispatch work from
+            # backpressure in the cycle wall
             t_q = time.perf_counter()
-            self._consume_q.put((pool, rp, out))
+            rp._consume_slots.acquire()
+            self._consume_shards.submit(pool, pool, rp, out)
             self.metrics[f"match.{pool}.queue_wait_ms"] = \
                 (time.perf_counter() - t_q) * 1e3
             last = rp.stats_last
@@ -2206,9 +2222,9 @@ class Coordinator:
 
     def stop(self) -> None:
         self._stop.set()
-        if hasattr(self, "_consume_q"):
+        if getattr(self, "_consume_shards", None) is not None:
             self.drain_resident()
-            self._consume_q.put(None)
+            self._consume_shards.stop()
         for rp in list(getattr(self, "_resident", {}).values()):
             q = getattr(rp, "_launch_q", None)
             if q is not None:

@@ -51,7 +51,7 @@ def test_snapshot_async_ticket_round_trip(tmp_path):
     t2 = s.snapshot_async(snap)       # serialized behind t1, same path
     p1, p2 = t1.wait(10), t2.wait(10)
     assert t1.done() and t2.done()
-    assert p2 >= p1 == 20
+    assert p2 >= p1 == s.log_lines() > 0
     r = JobStore.restore(snap, log_path=log, open_writer=False)
     assert _state_fingerprint(r) == _state_fingerprint(s)
 

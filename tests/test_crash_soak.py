@@ -18,6 +18,10 @@ point:
                       mea-culpa requeue or adoption, never a burn)
   C  store.rotate     mid log-rotation (segment swap durability)
   D  mixed            all of the above plus mid-snapshot-rotate
+  E  store.ingest_txn mid ingest batch: after the (possibly coalesced)
+                      "jobs" event is appended, BEFORE the group
+                      commit's barrier acks anyone — no acked job may
+                      be lost, no unacked one double-launched
 
 Traffic is a compressed production day: `cook_tpu.sim.generate_trace`
 with diurnal=True produces two workday bursts whose submit times are
@@ -77,6 +81,8 @@ SCHEDULES = {
                            "store.snapshot": 0.30,
                            "store.rotate": 0.50},
                     overrides={"log_rotate_lines": 30}),
+    "E-ingest-txn": dict(seed=41, max_kills=2,
+                         sites={"store.ingest_txn": 0.3}),
 }
 
 

@@ -1,0 +1,211 @@
+"""Binary launch wire (backends/specwire.py): CKS1 frame + negotiation.
+
+The coordinator ships LaunchSpec batches to agents either as the
+legacy JSON body or — when the daemon advertised ``"spec_wire":
+["cks1"]`` at registration — as the compact length-prefixed binary
+frame. Covered here:
+
+  - codec: golden-frame byte stability, round-trip equivalence with
+    the JSON wire shape, malformed-frame rejection;
+  - negotiation e2e: a live daemon advertises the capability, the
+    cluster launches over the binary frame, and the task completes
+    with its traceparent intact on the daemon;
+  - fallback: an agent that never advertised gets the JSON body and
+    everything still works (old daemons keep working);
+  - server side: a garbage frame answers 400, like malformed JSON.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from cook_tpu.agent.daemon import AgentDaemon
+from cook_tpu.backends import specwire
+from cook_tpu.backends.agent import AgentCluster, _spec_wire
+from cook_tpu.backends.base import ClusterRegistry, LaunchSpec
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.state.model import Job, JobState, new_uuid
+from cook_tpu.state.store import JobStore
+from cook_tpu.utils.httpjson import HttpJsonError, raw_request
+
+
+def wait_until(fn, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"condition not met within {timeout}s")
+
+
+# -- codec -------------------------------------------------------------
+def _rich_specs():
+    return [
+        LaunchSpec(task_id="t-1", job_uuid="j-1", hostname="h0",
+                   command="echo hi", mem=128.0, cpus=1.5, gpus=0.0,
+                   env={"A": "b", "PORT_HINT": "1"},
+                   ports=[31000, 31001],
+                   traceparent="00-" + "a" * 32 + "-" + "b" * 16 + "-01"),
+        LaunchSpec(task_id="t-2", job_uuid="j-2", hostname="h0",
+                   command="sleep 1", mem=1.0, cpus=0.1, gpus=2.0,
+                   container={"type": "docker", "image": "x:1"},
+                   progress_regex=r"prog (\d+)",
+                   progress_output_file="out.txt",
+                   uris=[{"value": "http://u/f", "extract": True}]),
+    ]
+
+
+def test_round_trip_equals_json_wire_shape():
+    wire = [_spec_wire(s) for s in _rich_specs()]
+    decoded = specwire.decode_specs(specwire.encode_specs(wire))
+    # the frame must reproduce EXACTLY what the JSON body would carry
+    assert decoded == json.loads(json.dumps({"specs": wire}))["specs"]
+
+
+def test_golden_frame_bytes_are_stable():
+    """Any byte-level change to the encoder is a protocol break for
+    in-flight deployments (coordinator and agents upgrade separately):
+    this golden frame must only ever change together with a new
+    WIRE_FORMAT token."""
+    spec = {"task_id": "t", "job_uuid": "j", "hostname": "h",
+            "command": "run", "mem": 1.0, "cpus": 2.0, "gpus": 0.0,
+            "env": {"K": "v"}, "container": None,
+            "progress_regex": "", "progress_output_file": "",
+            "ports": [7], "uris": [], "traceparent": "tp"}
+    golden = (
+        b"CKS1\x01\x00\x00\x00"
+        b"\x01\x00\x00\x00t" b"\x01\x00\x00\x00j"
+        b"\x01\x00\x00\x00h" b"\x03\x00\x00\x00run"
+        b"\x00\x00\x00\x00\x00\x00\xf0?"       # mem = 1.0
+        b"\x00\x00\x00\x00\x00\x00\x00@"       # cpus = 2.0
+        b"\x00\x00\x00\x00\x00\x00\x00\x00"    # gpus = 0.0
+        b"\x01\x00\x00\x00"                    # 1 env pair
+        b"\x01\x00\x00\x00K" b"\x01\x00\x00\x00v"
+        b"\x00\x00\x00\x00"                    # container: null
+        b"\x00\x00\x00\x00" b"\x00\x00\x00\x00"  # progress fields
+        b"\x01\x00\x00\x00\x07\x00\x00\x00"    # ports [7]
+        b"\x02\x00\x00\x00[]"                  # uris
+        b"\x02\x00\x00\x00tp")
+    assert specwire.encode_specs([spec]) == golden
+    assert specwire.decode_specs(golden) == [spec]
+
+
+def test_malformed_frames_rejected():
+    frame = specwire.encode_specs([_spec_wire(s) for s in _rich_specs()])
+    for bad in (frame[:-3], frame + b"\x00", b"NOPE" + frame[4:],
+                b"", b"CKS1"):
+        with pytest.raises(ValueError):
+            specwire.decode_specs(bad)
+
+
+def test_empty_spec_list_round_trips():
+    assert specwire.decode_specs(specwire.encode_specs([])) == []
+
+
+# -- live daemon <-> cluster -------------------------------------------
+@pytest.fixture
+def stack(tmp_path):
+    from cook_tpu.rest.api import CookApi
+    from cook_tpu.rest.auth import AuthConfig
+    from cook_tpu.rest.server import ApiServer
+
+    store = JobStore()
+    cluster = AgentCluster(heartbeat_timeout_s=2.0, agent_token="hunter2")
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header", agent_token="hunter2"))
+    server = ApiServer(api, port=0).start()
+    daemons = []
+
+    def add_agent(hostname, mem=1000.0, cpus=4.0):
+        d = AgentDaemon(server.url, hostname=hostname, mem=mem, cpus=cpus,
+                        sandbox_root=str(tmp_path / hostname),
+                        heartbeat_interval_s=0.3,
+                        agent_token="hunter2").start()
+        daemons.append(d)
+        return d
+
+    yield store, cluster, coord, server, add_agent
+    for d in daemons:
+        d.stop()
+    server.stop()
+
+
+def _count_raw_posts(monkeypatch):
+    """Patch the cluster module's raw_request with a counting wrapper
+    so tests can prove which wire a launch actually used."""
+    import cook_tpu.backends.agent as agent_mod
+    calls = []
+    orig = agent_mod.raw_request
+
+    def counted(method, url, data, content_type, **kw):
+        calls.append((url, content_type, bytes(data)))
+        return orig(method, url, data, content_type, **kw)
+
+    monkeypatch.setattr(agent_mod, "raw_request", counted)
+    return calls
+
+
+def test_daemon_advertises_and_launch_uses_binary_frame(
+        stack, monkeypatch):
+    store, cluster, coord, server, add_agent = stack
+    calls = _count_raw_posts(monkeypatch)
+    d = add_agent("a1")
+    wait_until(lambda: "a1" in cluster.agents)
+    assert cluster.agents["a1"].spec_wire == (specwire.WIRE_FORMAT,)
+
+    tp = "00-" + "c" * 32 + "-" + "d" * 16 + "-01"
+    job = Job(uuid=new_uuid(), user="alice", command="true", mem=100,
+              cpus=1, traceparent=tp)
+    store.create_jobs([job])
+    assert coord.match_cycle().matched == 1
+    wait_until(lambda: job.state == JobState.COMPLETED)
+    assert job.success
+
+    launches = [c for c in calls if c[0].endswith("/launch")]
+    assert launches, "launch never used the binary wire"
+    assert launches[0][1] == specwire.CONTENT_TYPE
+    sent = specwire.decode_specs(launches[0][2])
+    assert [s["task_id"] for s in sent] == \
+        [job.instances[0].task_id]
+    # the trace context rode the frame: same trace id as the job's
+    # root (the scheduler mints a fresh span id per launch)
+    assert sent[0]["traceparent"].split("-")[1] == tp.split("-")[1]
+
+
+def test_agent_without_capability_falls_back_to_json(
+        stack, monkeypatch):
+    store, cluster, coord, server, add_agent = stack
+    calls = _count_raw_posts(monkeypatch)
+    d = add_agent("a1")
+    wait_until(lambda: "a1" in cluster.agents)
+    # simulate an OLD daemon: re-register without the capability token
+    payload = d._register_payload()
+    del payload["spec_wire"]
+    cluster.register_agent(payload)
+    assert cluster.agents["a1"].spec_wire == ()
+
+    job = Job(uuid=new_uuid(), user="alice", command="true", mem=100,
+              cpus=1)
+    store.create_jobs([job])
+    assert coord.match_cycle().matched == 1
+    wait_until(lambda: job.state == JobState.COMPLETED)
+    assert job.success
+    assert not [c for c in calls if c[0].endswith("/launch")], \
+        "fallback launch must use the JSON body"
+
+
+def test_daemon_rejects_garbage_frame_with_400(stack):
+    store, cluster, coord, server, add_agent = stack
+    d = add_agent("a1")
+    wait_until(lambda: "a1" in cluster.agents)
+    with pytest.raises(HttpJsonError) as exc:
+        raw_request("POST", d.url + "/launch", b"CKS1\xff\xff\xff\xff",
+                    specwire.CONTENT_TYPE,
+                    headers={"X-Cook-Agent-Token": "hunter2"})
+    assert exc.value.status == 400
+    assert json.loads(exc.value.body)["error"] == "malformed spec frame"
