@@ -88,7 +88,7 @@ class AgentDaemon:
         # a coordinator outage longer than outbox_max terminal events
         # drops the OLDEST (the coordinator's heartbeat-diff safety net
         # will eventually fail those tasks anyway); drops are counted
-        # in agent.outbox_dropped and self.outbox_dropped.
+        # in agent_outbox_dropped_total and self.outbox_dropped.
         self._outbox: list[dict] = []
         self._outbox_lock = threading.Lock()
         self.outbox_max = int(outbox_max)
@@ -308,7 +308,7 @@ class AgentDaemon:
         while len(self._outbox) > self.outbox_max:
             dropped = self._outbox.pop(0)
             self.outbox_dropped += 1
-            metrics_registry.counter("agent.outbox_dropped").inc()
+            metrics_registry.counter("agent_outbox_dropped_total").inc()
             logger.warning("outbox full (%d): dropped oldest status for "
                            "%s", self.outbox_max,
                            dropped.get("task_id"))

@@ -32,7 +32,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m cook_tpu.analysis",
         description="cookcheck: trace-purity (R1), lock discipline (R2), "
                     "async hygiene (R3), REST/OpenAPI drift (R4), "
-                    "span discipline (R5), retry discipline (R6)")
+                    "span discipline (R5), retry discipline (R6), "
+                    "metrics discipline (R7)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the cook_tpu "
                          "package)")

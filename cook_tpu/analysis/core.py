@@ -22,7 +22,7 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
 
 # which rule families run over which package subdirectories when
 # scanning a tree (explicit file arguments get every AST rule)
@@ -34,6 +34,8 @@ RULE_DIRS = {
            "utils"),
     "R6": ("agent", "backends", "scheduler", "rest", "state", "utils",
            "integrations", "plugins", "obs"),
+    "R7": ("scheduler", "rest", "backends", "agent", "plugins", "obs",
+           "state", "utils", "integrations"),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -163,12 +165,13 @@ def diff_baseline(findings: list[Finding], baseline: dict[str, int]
 # analysis drivers
 
 def analyze_source(source: str, path: str,
-                   rules: Iterable[str] = ("R1", "R2", "R3", "R5", "R6"),
+                   rules: Iterable[str] = ("R1", "R2", "R3", "R5", "R6",
+                                           "R7"),
                    apply_suppressions: bool = True) -> list[Finding]:
     """Run the per-module AST rules over one source text."""
     from cook_tpu.analysis import (async_hygiene, lock_discipline,
-                                   retry_discipline, span_discipline,
-                                   trace_purity)
+                                   metrics_discipline, retry_discipline,
+                                   span_discipline, trace_purity)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -187,6 +190,8 @@ def analyze_source(source: str, path: str,
         findings += span_discipline.check(mod)
     if "R6" in rules:
         findings += retry_discipline.check(mod)
+    if "R7" in rules:
+        findings += metrics_discipline.check(mod)
     if apply_suppressions:
         sup = collect_suppressions(source)
         findings = [f for f in findings if not suppressed(f, sup)]

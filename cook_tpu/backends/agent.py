@@ -452,7 +452,7 @@ class AgentCluster(ComputeCluster):
         old = prev.outbox_dropped if prev is not None else 0
         if new_count > old:
             metrics_registry.counter(
-                "agent.outbox_dropped_reported").inc(new_count - old)
+                "agent_outbox_dropped_reported_total").inc(new_count - old)
 
     def query_agent_tasks(self, timeout_s: Optional[float] = None):
         """GET every alive agent's /state for its live task_ids — the
@@ -564,7 +564,7 @@ class AgentCluster(ComputeCluster):
             {"hostname": hostname, "from": old, "to": new,
              "t_ms": now_ms()})
         metrics_registry.counter(
-            "agent.breaker_transitions.%s" % new).inc()
+            "agent_breaker_transitions_total", state=new).inc()
 
     def _breaker(self, hostname: str) -> CircuitBreaker:
         with self._lock:
@@ -606,7 +606,8 @@ class AgentCluster(ComputeCluster):
                 before = br.trips
                 br.record_failure()
                 if br.trips > before:
-                    metrics_registry.counter("agent.breaker_trips").inc()
+                    metrics_registry.counter(
+                        "agent_breaker_trips_total").inc()
                     logger.warning("circuit breaker OPEN for agent %s",
                                    hostname)
             raise

@@ -138,6 +138,13 @@ class SchedulerSettings:
     # on one worker (per-pool ordering preserved); multiple pools
     # drain concurrently. 1 = the old single shared consumer thread.
     consume_workers: int = 4
+    # per-job decision provenance: read back the device cycle's
+    # reason-code tensor and record it in the DecisionBook that backs
+    # GET /unscheduled and /debug/decisions. The codes are computed on
+    # device either way (pure epilogue arithmetic); this gates only the
+    # extra host readback + bookkeeping — disable to shave the last
+    # percent off cycle latency on hot clusters.
+    decision_provenance: bool = True
 
     def validate(self) -> None:
         if self.max_jobs_considered < 1:

@@ -28,6 +28,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from cook_tpu.obs import decisions as why_codes
 from cook_tpu.ops import dru as dru_ops
 from cook_tpu.ops import match as match_ops
 from cook_tpu.ops.segments import segment_cumsum
@@ -58,6 +59,16 @@ class CycleResult(NamedTuple):
     # is what bounds the sync readback on a PCIe/tunnel link.
     mat_idx: jnp.ndarray         # (C,) pending-row index, matched prefix
     mat_host: jnp.ndarray        # (C,) assigned host, matched prefix
+    # decision provenance (obs/decisions.py codes): why each of the
+    # first W = min(C, P) fair-queue positions did or didn't launch.
+    # Queue-ordered and produced by the same epilogue pass, so the
+    # consumer's existing readback picks them up with no extra
+    # device->host sync; positions beyond W are answered host-side as
+    # rank-beyond-window.
+    why_idx: jnp.ndarray         # (W,) pending-row index at queue pos, -1
+    why_code: jnp.ndarray        # (W,) i32 reason code (0 = pad)
+    why_amt: jnp.ndarray         # (W,) f32 code-specific datum (host id,
+                                 # rank ordinal, or quota overage)
 
 
 @functools.partial(jax.jit, static_argnames=("num_considerable", "num_groups",
@@ -180,10 +191,15 @@ def rank_and_match(
                    jnp.where(q_valid, pend_cpus[queue_perm], 0.0)[uperm],
                    q_valid[uperm].astype(jnp.float32)], -1), su)
     uid = jnp.clip(su, 0, U - 1)
-    within = ((u_mem[uid] + cum[:, 0] <= user_quota_mem[uid])
-              & (u_cpus[uid] + cum[:, 1] <= user_quota_cpus[uid])
-              & (u_cnt[uid] + cum[:, 2] <= user_quota_count[uid]))
+    # signed per-dimension overage (positive = this dim would exceed the
+    # user's quota): the quota gate AND the provenance datum in one pass
+    over = jnp.stack(
+        [u_mem[uid] + cum[:, 0] - user_quota_mem[uid],
+         u_cpus[uid] + cum[:, 1] - user_quota_cpus[uid],
+         u_cnt[uid] + cum[:, 2] - user_quota_count[uid]], -1)
+    within = (over[:, 0] <= 0) & (over[:, 1] <= 0) & (over[:, 2] <= 0)
     within_q = jnp.zeros(P, bool).at[uperm].set(within)      # queue order
+    over_q = jnp.zeros((P, 3)).at[uperm].set(over)           # queue order
     considerable_q = q_valid & within_q
     # cap at num_considerable (static, sets the compact batch shape) and
     # at considerable_limit (dynamic, the scaleback feedback value —
@@ -281,6 +297,43 @@ def rank_and_match(
         cons_idx, mode="drop")[:C]
     mat_host = jnp.full(C + 1, -1, jnp.int32).at[mslot].set(
         res.job_host.astype(jnp.int32), mode="drop")[:C]
+
+    # ---- 4. decision provenance --------------------------------------
+    # Reason code per fair-queue position over the window W = min(C, P)
+    # (static: queue-order vectors are (P,), the compact batch is (C,)).
+    # Every input below already exists in queue order — this is pure
+    # epilogue arithmetic, no new gathers over (P, H).
+    W = min(C, P)
+    wqp = queue_perm[:W]
+    wvalid = q_valid[:W]
+    whost = job_host[wqp]                 # host the position matched, -1
+    wcons = considerable_q[:W]            # survived quota AND cap
+    wwithin = within_q[:W]
+    wtaken = taken[:W]                    # pre-cap considerable ordinal
+    wover = over_q[:W]
+    # first-failing quota dimension, mem -> cpus -> count priority
+    quota_code = jnp.where(
+        wover[:, 0] > 0, why_codes.QUOTA_MEM,
+        jnp.where(wover[:, 1] > 0, why_codes.QUOTA_CPUS,
+                  why_codes.QUOTA_COUNT))
+    quota_amt = jnp.where(
+        wover[:, 0] > 0, wover[:, 0],
+        jnp.where(wover[:, 1] > 0, wover[:, 1], wover[:, 2]))
+    why_code = jnp.where(
+        ~wvalid, why_codes.INVALID,
+        jnp.where(wcons,
+                  jnp.where(whost >= 0, why_codes.MATCHED,
+                            why_codes.NO_HOST_FIT),
+                  jnp.where(~wwithin, quota_code,
+                            why_codes.RANK_CUTOFF))).astype(jnp.int32)
+    why_amt = jnp.where(
+        ~wvalid, 0.0,
+        jnp.where(wcons, jnp.where(whost >= 0, whost.astype(jnp.float32),
+                                   0.0),
+                  jnp.where(~wwithin, quota_amt,
+                            wtaken.astype(jnp.float32))))
+    why_idx = jnp.where(wvalid, wqp, -1).astype(jnp.int32)
+
     return CycleResult(pending_dru=pending_dru, queue_rank=queue_rank,
                        considerable=considerable, job_host=job_host,
                        mem_left=res.mem_left, cpus_left=res.cpus_left,
@@ -289,4 +342,6 @@ def rank_and_match(
                        head_matched=head_matched,
                        n_matched=matched_slot.sum().astype(jnp.int32),
                        n_considerable=in_use.sum().astype(jnp.int32),
-                       mat_idx=mat_idx, mat_host=mat_host)
+                       mat_idx=mat_idx, mat_host=mat_host,
+                       why_idx=why_idx, why_code=why_code,
+                       why_amt=why_amt)

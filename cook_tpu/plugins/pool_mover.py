@@ -45,7 +45,7 @@ class PoolMoverAdjuster(JobAdjuster):
             logger.info("moving job %s (%s) from pool %s to %s "
                         "(pool-mover)", job.uuid, job.user, job.pool,
                         destination)
-            metrics_registry.counter("plugins.pool_mover.jobs_migrated") \
+            metrics_registry.counter("pool_mover_jobs_migrated_total") \
                 .inc()
             job.pool = destination
         return job

@@ -186,6 +186,7 @@ class CookApi:
                 if blocked is not None:
                     return blocked
             elif path not in ("/info", "/debug", "/debug/flight",
+                              "/debug/decisions",
                               "/metrics"):  # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)
             if method in ("POST", "PUT", "DELETE") \
@@ -267,6 +268,10 @@ class CookApi:
         r.add("GET", "/settings", self.get_settings)
         r.add("GET", "/pools", self.get_pools)
         r.add("GET", "/unscheduled_jobs", self.unscheduled_jobs)
+        # Cook-parity decision provenance: device-sourced reason codes
+        # per (job, cycle) from the coordinator's DecisionBook
+        r.add("GET", "/unscheduled", self.unscheduled)
+        r.add("GET", "/debug/decisions", self.get_debug_decisions)
         r.add("GET", "/stats/instances", self.stats_instances)
         r.add("POST", "/progress/:uuid", self.post_progress)
         r.add("GET", "/queue", self.get_queue)
@@ -306,9 +311,11 @@ class CookApi:
     def get_metrics(self, req: Request) -> Response:
         """Prometheus text exposition of the metric registry (the
         modern stand-in for the reference's Graphite/JMX reporters,
-        reporter.clj:32-82)."""
-        from cook_tpu.utils.metrics import registry, render_prometheus
-        return Response(200, render_prometheus(registry.snapshot()),
+        reporter.clj:32-82). One code path: the process-wide obs
+        registry renders every family — labeled histograms/counters
+        and legacy dotted names alike."""
+        from cook_tpu.utils.metrics import registry
+        return Response(200, registry.render(),
                         headers={"Content-Type":
                                  "text/plain; version=0.0.4"})
 
@@ -931,6 +938,106 @@ class CookApi:
                     (-job.priority, job.submit_time_ms):
                 ahead += 1
         return ahead
+
+    def unscheduled(self, req: Request) -> Response:
+        """Why isn't this job running? Device-sourced decision
+        provenance per job (Cook's /unscheduled, with the reasons the
+        match cycle itself computed: rank vs cutoff, which quota and by
+        how much, no-host-fit), joined with trace context and the
+        static analyzers' fallback reasons."""
+        from cook_tpu.obs import decisions as dprov
+        uuids = req.qlist("job", "uuid")
+        if not uuids:
+            raise ApiError(400, "job parameter is required")
+        book = getattr(self.coord, "decisions", None)
+        cfg = getattr(self.coord, "config", None)
+        cutoff = getattr(cfg, "max_jobs_considered", 0)
+        out = []
+        for u in uuids:
+            job = self._authorized_job(req, u)
+            reasons = []
+            history = book.job_decisions(job.uuid) if book else []
+            if _job_status(job) != "waiting":
+                reasons.append({
+                    "reason": f"The job is {_job_status(job)}.",
+                    "code": _job_status(job), "data": {}})
+            elif history:
+                # newest decision is THE answer; older ones ride along
+                reasons.append(dprov.explain(history[0],
+                                             num_considerable=cutoff))
+            else:
+                qpos = self._queue_position(job)
+                reasons.append({
+                    "reason": "The job has not been considered by a "
+                              "match cycle yet (queued beyond the "
+                              "decision window, or no cycle has run).",
+                    "code": "rank_beyond_window",
+                    "data": {"queue_position": qpos,
+                             "window": cutoff}})
+            # degraded backends starve jobs without the cycle ever
+            # seeing them: surface circuit-broken / skipped clusters
+            broken = []
+            clusters = getattr(self.coord, "clusters", None)
+            for cluster in clusters.all() if clusters else []:
+                describe = getattr(cluster, "describe_agents", None)
+                if describe is None:
+                    continue
+                for a in describe():
+                    st = a.get("breaker", {}).get("state")
+                    if st and st != "closed":
+                        broken.append({"hostname": a["hostname"],
+                                       "cluster": cluster.name,
+                                       "state": st})
+            if broken:
+                reasons.append({
+                    "reason": "Some backends are degraded "
+                              "(circuit breaker open): their offers "
+                              "are not participating in matching.",
+                    "code": "backend_degraded",
+                    "data": {"agents": broken}})
+            # clusters whose offer fetch failed recently were skipped
+            # whole cycles — the pool ran degraded
+            skipped = getattr(self.coord, "skipped_clusters", {}) \
+                .get(job.pool, {})
+            recent = [c for c, ts in skipped.items()
+                      if time.monotonic() - ts < 300.0]
+            if recent:
+                reasons.append({
+                    "reason": "Some compute clusters failed to offer "
+                              "resources recently and were skipped "
+                              "from match cycles.",
+                    "code": "cluster_degraded",
+                    "data": {"clusters": sorted(recent)}})
+            # classic host-side analysis (quota math, rate limits,
+            # placement-failure cache) for Cook parity and for causes
+            # the device window can't see
+            rl = getattr(self.coord, "user_launch_rl", None)
+            for r, d in unscheduled.reasons(
+                    self.store, job, self.quotas, self.shares,
+                    user_launch_rl=rl,
+                    queue_position=self._queue_position(job)):
+                reasons.append({"reason": r, "data": d})
+            out.append({
+                "uuid": job.uuid,
+                "traceparent": job.traceparent or None,
+                "decisions": history,
+                "reasons": reasons,
+            })
+        return Response(200, out)
+
+    def get_debug_decisions(self, req: Request) -> Response:
+        """Decision-provenance ring: newest-first per-cycle outcome
+        summaries (matched / quota / rank-cutoff / no-fit counts per
+        pool cycle) plus book stats; joins the flight recorder on
+        (pool, cycle)."""
+        book = getattr(self.coord, "decisions", None)
+        if book is None:
+            return Response(200, {"cycles": [], "stats": {}})
+        limit = int(req.qp("limit", 64) or 64)
+        pool = req.qp("pool")
+        return Response(200, {"cycles": book.cycles(limit=limit,
+                                                    pool=pool),
+                              "stats": book.stats()})
 
     def stats_instances(self, req: Request) -> Response:
         require_authorized(self.auth, req.user, "read", None)

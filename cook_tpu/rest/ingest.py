@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Iterable, Optional
 
 from cook_tpu.state.store import TransactionError
@@ -50,7 +51,7 @@ class IngestQueueFull(Exception):
 class _Pending:
     """One validated submission waiting for its batch to become durable."""
 
-    __slots__ = ("jobs", "groups", "done", "result", "error")
+    __slots__ = ("jobs", "groups", "done", "result", "error", "ts")
 
     def __init__(self, jobs, groups):
         self.jobs = jobs
@@ -58,6 +59,7 @@ class _Pending:
         self.done = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.ts = time.monotonic()
 
     def resolve(self, uuids) -> None:
         self.result = uuids
@@ -100,8 +102,9 @@ class IngestBatcher:
         try:
             self._q.put_nowait(p)
         except queue.Full:
-            registry.counter("ingest.rejected").inc()
+            registry.counter("ingest_rejected_total").inc()
             raise IngestQueueFull(self.retry_after_s)
+        registry.gauge("ingest_queue_depth").set(self._q.qsize())
         if not p.done.wait(timeout_s):
             # the latch never resolving means a worker died mid-commit
             # (process-level fault); surface loudly rather than hang
@@ -136,6 +139,11 @@ class IngestBatcher:
                     batch.append(self._q.get_nowait())
                 except queue.Empty:
                     break
+            registry.gauge("ingest_queue_depth").set(self._q.qsize())
+            now = time.monotonic()
+            wait = registry.histogram("ingest_wait_ms")
+            for p in batch:
+                wait.observe(max(0.0, (now - p.ts) * 1e3))
             try:
                 self._commit(batch)
             except BaseException:   # never let a worker die silently
@@ -164,9 +172,9 @@ class IngestBatcher:
             jobs = [j for p in coalesce for j in p.jobs]
             try:
                 self.store.create_jobs(jobs, committed=True)
-                registry.histogram("ingest.batch_requests").update(
+                registry.histogram("ingest_batch_requests").update(
                     len(coalesce))
-                registry.histogram("ingest.batch_jobs").update(len(jobs))
+                registry.histogram("ingest_batch_jobs").update(len(jobs))
                 for p in coalesce:
                     p.resolve([j.uuid for j in p.jobs])
                 coalesce = []

@@ -352,7 +352,8 @@ def build_scheduler(config, read_only=False):
             use_pallas=_resolve_use_pallas(s.use_pallas,
                                            s.max_jobs_considered),
             launch_ack_timeout_s=s.launch_ack_timeout_s,
-            consume_workers=s.consume_workers),
+            consume_workers=s.consume_workers,
+            decision_provenance=s.decision_provenance),
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,

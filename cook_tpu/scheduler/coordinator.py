@@ -48,6 +48,7 @@ from cook_tpu.parallel import federation
 from cook_tpu.state.pools import DruMode, PoolRegistry
 from cook_tpu.utils.metrics import registry as metrics_registry
 from cook_tpu import obs
+from cook_tpu.obs import decisions as dprov
 from cook_tpu.state.store import JobStore, TransactionError
 
 
@@ -112,6 +113,13 @@ class SchedulerConfig:
     # different pools drain concurrently instead of serializing on the
     # single consumer thread this replaced.
     consume_workers: int = 4
+    # decision provenance: read back the device cycle's per-queue-slot
+    # reason codes (ops/cycle.py why_*) and record them in the
+    # DecisionBook behind GET /unscheduled. The device computes the
+    # codes either way (they are epilogue arithmetic inside the fused
+    # cycle); this flag gates the host-side readback + ring recording,
+    # which is what `bench.py decision-overhead` A/Bs.
+    decision_provenance: bool = True
 
 
 @dataclass
@@ -217,6 +225,17 @@ class Coordinator:
         # threads' writes (same reader-vs-writer contract as
         # consume_trace_snapshot: /debug must copy, never iterate live)
         self._metrics_lock = threading.Lock()
+        # decision provenance ring: per-(job, cycle) reason codes
+        # decoded from the device cycle's why_* window, behind
+        # GET /unscheduled and GET /debug/decisions
+        self.decisions = obs.DecisionBook()
+        # legacy match_cycle has no device-resident cycle counter; the
+        # DecisionBook still needs a per-pool sequence to join on
+        self._legacy_cycle_seq: dict[str, int] = {}
+        # pool -> {cluster name -> monotonic ts} of clusters whose
+        # offer fetch failed and were skipped a cycle; /unscheduled
+        # surfaces recent entries as a degraded-pool starvation cause
+        self.skipped_clusters: dict[str, dict[str, float]] = {}
         self.progress_aggregator = progress_aggregator
         self.heartbeats = heartbeats
         self.plugins = plugins
@@ -677,8 +696,8 @@ class Coordinator:
                 self.metrics[f"match.{pool}.resync_ms"] = swap_ms
                 self.metrics[f"match.{pool}.rebuild_build_ms"] = \
                     getattr(rp, "last_build_ms", 0.0)
-                metrics_registry.timer(
-                    f"match.{pool}.resync_swap_ms").update(swap_ms)
+                metrics_registry.histogram(
+                    "resync_swap_ms", pool=pool).observe(swap_ms)
             elif not rp.rebuilding():
                 rp.start_background_rebuild()
             reason = None   # handled (or deferred until the build lands)
@@ -726,8 +745,8 @@ class Coordinator:
                     rp.resync()
             self.metrics[f"match.{pool}.resync_ms"] = \
                 (time.perf_counter() - t_rs) * 1e3
-            metrics_registry.timer(
-                f"match.{pool}.resync_{reason}_ms").update(
+            metrics_registry.histogram(
+                "resync_ms", pool=pool, reason=str(reason)).observe(
                 (time.perf_counter() - t_rs) * 1e3)
         try:
             deltas = rp.drain()
@@ -820,10 +839,11 @@ class Coordinator:
         self.metrics[f"match.{pool}.ship_ms"] = (t_ship - t_drain) * 1e3
         self.metrics[f"match.{pool}.dispatch_ms"] = \
             (t_dispatch - t_ship) * 1e3
-        metrics_registry.timer(f"match.{pool}.cycle_ms").update(
+        metrics_registry.histogram("match_cycle_ms", pool=pool).observe(
             stats.cycle_ms)
-        metrics_registry.meter(f"match.{pool}.matched").mark(stats.matched)
-        metrics_registry.counter(f"match.{pool}.cycles").inc()
+        metrics_registry.counter("match_matched_total", pool=pool).inc(
+            stats.matched)
+        metrics_registry.counter("match_cycles_total", pool=pool).inc()
         if obs.tracer.enabled:
             # flight-recorder entry: this cycle with the phase stamps it
             # already took — the tail segment is the inline consume for
@@ -880,6 +900,15 @@ class Coordinator:
                 (out.mat_idx, out.mat_host))
             cons_idx = np.asarray(cons_idx)[:n_matched]
             cons_host = np.asarray(cons_host)[:n_matched]
+        why_rows = None
+        if (self.config.decision_provenance
+                and getattr(out, "why_idx", None) is not None):
+            # provenance window: in pipelined/async mode these arrays
+            # were already copy_to_host_async'd at dispatch, so this is
+            # a local trim; inline mode pays the one extra pull the
+            # decision-overhead bench measures
+            why_rows = jax.device_get(
+                (out.why_idx, out.why_code, out.why_amt))
         t_rb1 = time.perf_counter()
         self.metrics[f"match.{pool}.readback_ms"] = (t_rb1 - t_rb0) * 1e3
         items = []        # (uuid, hostname, cluster_name)
@@ -926,6 +955,33 @@ class Coordinator:
                     rp.queue_credit(*credit, as_of=out.cycle_no)
                     continue
                 candidates.append((uuid, h, job, credit))
+            why_entries = []
+            if why_rows is not None:
+                # decode the provenance window against the same row
+                # mirror (rows are stable until consumed_through
+                # advances, so this join can't dangle)
+                wi = np.asarray(why_rows[0])
+                wsel = np.flatnonzero(wi >= 0)
+                for pos, row, code, amt in zip(
+                        wsel.tolist(), wi[wsel].tolist(),
+                        np.asarray(why_rows[1])[wsel].tolist(),
+                        np.asarray(why_rows[2])[wsel].tolist()):
+                    u = row_uuid[row]
+                    if u:
+                        why_entries.append((u, code, amt, pos))
+        if why_rows is not None:
+            self.decisions.record_cycle(
+                pool, out.cycle_no, why_entries,
+                considered=n_considerable, matched=n_matched)
+            counts = np.bincount(
+                np.asarray(why_rows[1])[np.asarray(why_rows[0]) >= 0],
+                minlength=8)
+            for code, n in enumerate(counts.tolist()):
+                if n:
+                    metrics_registry.counter(
+                        "decisions_total", pool=pool,
+                        outcome=dprov.CODE_NAMES.get(code, str(code)),
+                    ).inc(n)
         # policy pass OUTSIDE the mirror lock: a slow launch plugin or
         # port allocator must not block the cycle thread's drain (the
         # same rule _maybe_refresh_locality follows for cost fetches)
@@ -996,6 +1052,9 @@ class Coordinator:
             span_id=txn_sid) if items else []
         self.metrics[f"match.{pool}.launch_txn_ms"] = \
             (time.perf_counter() - t_loop) * 1e3
+        if items:
+            metrics_registry.histogram("launch_txn_ms", pool=pool) \
+                .observe(self.metrics[f"match.{pool}.launch_txn_ms"])
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
         traced = []   # (trace_id, root_sid, launch_sid, task_id)
@@ -1037,6 +1096,10 @@ class Coordinator:
                            ports=ports, uris=job.uris,
                            traceparent=tp_launch))
             launched += 1
+            if inst.start_time_ms and job.submit_time_ms:
+                metrics_registry.histogram(
+                    "e2e_submit_launch_ms", pool=pool).observe(
+                        max(0, inst.start_time_ms - job.submit_time_ms))
             if self.heartbeats is not None:
                 self.heartbeats.track(inst.task_id)
             self.launch_rl.spend("global")
@@ -1091,6 +1154,9 @@ class Coordinator:
         self.metrics[f"match.{pool}.backend_launch_ms"] = \
             (time.perf_counter() - t_loop) * 1e3 \
             - self.metrics[f"match.{pool}.launch_txn_ms"]
+        if by_cluster:
+            metrics_registry.histogram("backend_launch_ms", pool=pool) \
+                .observe(self.metrics[f"match.{pool}.backend_launch_ms"])
         stats = {"matched": launched, "considerable": n_considerable,
                  "head_matched": head_matched}
         rp.stats_last = stats
@@ -1197,7 +1263,9 @@ class Coordinator:
                 log.exception("cluster %s offers failed; skipping it "
                               "this cycle", cluster.name)
                 metrics_registry.counter(
-                    f"match.{pool}.cluster_skipped").inc()
+                    "cluster_skipped_total", pool=pool).inc()
+                self.skipped_clusters.setdefault(pool, {})[
+                    cluster.name] = time.monotonic()
                 continue
             for o in cluster_offers:
                 offers.append(o)
@@ -1305,6 +1373,30 @@ class Coordinator:
         job_host = np.asarray(res.job_host)
         considerable = np.asarray(res.considerable)
         queue_rank = np.asarray(res.queue_rank)
+        if self.config.decision_provenance:
+            # legacy path reads P-sized vectors anyway; the why window
+            # is one more small pull on an already-synchronous cycle
+            cyc = self._legacy_cycle_seq[pool] = \
+                self._legacy_cycle_seq.get(pool, -1) + 1
+            wi = np.asarray(res.why_idx)
+            wc = np.asarray(res.why_code)
+            wa = np.asarray(res.why_amt)
+            sel = np.flatnonzero((wi >= 0) & (wi < len(pending)))
+            self.decisions.record_cycle(
+                pool, cyc,
+                [(pending[row].uuid, code, amt, pos)
+                 for pos, row, code, amt in zip(
+                     sel.tolist(), wi[sel].tolist(), wc[sel].tolist(),
+                     wa[sel].tolist())],
+                considered=int(considerable[:len(pending)].sum()),
+                matched=int((job_host[:len(pending)] >= 0).sum()))
+            for code, n in enumerate(
+                    np.bincount(wc[sel], minlength=8).tolist()):
+                if n:
+                    metrics_registry.counter(
+                        "decisions_total", pool=pool,
+                        outcome=dprov.CODE_NAMES.get(code, str(code)),
+                    ).inc(n)
         stats.considerable = int(considerable[:len(pending)].sum())
         if not sequential:
             # sampled head-window inversion audit feeding the adaptive
@@ -1411,7 +1503,7 @@ class Coordinator:
                     errors += 1
             if errors:
                 metrics_registry.counter(
-                    f"match.{pool}.cluster_launch_errors").inc(errors)
+                    "cluster_launch_errors_total", pool=pool).inc(errors)
         stats.matched = launched
         t_launch1 = time.perf_counter()
         if traced:
@@ -1480,13 +1572,14 @@ class Coordinator:
         stats.cycle_ms = (time.perf_counter() - t0) * 1e3
         self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
         self.metrics[f"match.{pool}.matched"] = launched
-        # registry timers/meters — the codahale instrumentation of the
+        # registry families — the codahale instrumentation of the
         # reference match loop (handle-resource-offer!-* timers
-        # scheduler.clj:857-868, matched/launched meters)
-        metrics_registry.timer(f"match.{pool}.cycle_ms").update(
+        # scheduler.clj:857-868, matched/launched meters), pool-labeled
+        metrics_registry.histogram("match_cycle_ms", pool=pool).observe(
             stats.cycle_ms)
-        metrics_registry.meter(f"match.{pool}.matched").mark(launched)
-        metrics_registry.counter(f"match.{pool}.cycles").inc()
+        metrics_registry.counter("match_matched_total", pool=pool).inc(
+            launched)
+        metrics_registry.counter("match_cycles_total", pool=pool).inc()
         if obs.tracer.enabled:
             end, t_now = obs.now_ms(), time.perf_counter()
             w = lambda t: end - (t_now - t) * 1e3
@@ -1561,7 +1654,7 @@ class Coordinator:
                 0.5 * pred[gen] + 0.5 * dur
         self.metrics["gc.refreeze_ms"] = dur
         self.metrics["gc.refreeze_gen"] = gen
-        metrics_registry.timer("gc.refreeze_ms").update(dur)
+        metrics_registry.timer("gc_refreeze_ms").update(dur)
 
     def _audit_head_window(self, jb, hosts, forbidden, job_host,
                            queue_rank, considerable,
@@ -1905,10 +1998,18 @@ class Coordinator:
         n_killed = 0
         for row in preempted_rows:
             task_id = tb.task_ids[row]
+            inst = self.store.get_instance(task_id)
+            victim = self.store.get_job(inst.job_uuid) if inst else None
             self.store.update_instance(task_id, InstanceStatus.FAILED,
                                        reason_code=2000, preempted=True)
             self._backend_kill(task_id, preempt=True)
             n_killed += 1
+            if victim is not None:
+                # fairness telemetry: who is paying for the rebalance
+                # (user cardinality is bounded by the registry cap)
+                metrics_registry.counter(
+                    "user_preemptions_total", pool=pool,
+                    user=victim.user).inc()
 
         # reserve hosts for jobs whose decision preempted >1 task
         # (reserve-hosts! rebalancer.clj:413-426); single-kill decisions
@@ -1929,9 +2030,10 @@ class Coordinator:
                 self.reservations[job_uuid] = hostname
 
         self.metrics[f"rebalance.{pool}.preempted"] = n_killed
-        metrics_registry.meter(f"rebalance.{pool}.preempted").mark(n_killed)
-        metrics_registry.timer(f"rebalance.{pool}.cycle_ms").update(
-            (time.perf_counter() - t_reb0) * 1e3)
+        metrics_registry.counter("preemptions_total", pool=pool).inc(
+            n_killed)
+        metrics_registry.histogram("rebalance_cycle_ms", pool=pool) \
+            .observe((time.perf_counter() - t_reb0) * 1e3)
         return {"preempted": n_killed, "placed": int(placed.sum()),
                 "decisions": decisions}
 
@@ -2000,10 +2102,37 @@ class Coordinator:
         # tools.clj:757-774: nuke uncommitted jobs older than a few
         # days so they don't clutter the pending scan)
         gced = self.store.gc_uncommitted(self.config.uncommitted_gc_age_ms)
+        self.publish_fairness_metrics()
         return {"lingering": killed_lingering,
                 "stragglers": killed_straggler,
                 "launch_ack": killed_unacked,
                 "uncommitted_gced": gced}
+
+    def publish_fairness_metrics(self) -> None:
+        """Per-(pool, user) fairness gauges on the registry: dominant
+        resource usage score (max of mem/cpus usage over the configured
+        share — the scalar the DRU rank orders by) and the raw usage
+        dimensions.  Piggybacks on the watchdog cadence; also callable
+        directly (tests, /debug refresh)."""
+        for pool in [p.name for p in self.pools.all()]:
+            users = set(self.shares.users())
+            usage = self.store.user_usage(pool)
+            users |= set(usage)
+            for user in users:
+                u = usage.get(user, {})
+                share = self.shares.get(user, pool)
+                mem_share = share.get("mem", float("inf"))
+                cpus_share = share.get("cpus", float("inf"))
+                dru = max(
+                    (u.get("mem", 0.0) / mem_share) if mem_share > 0
+                    else 0.0,
+                    (u.get("cpus", 0.0) / cpus_share) if cpus_share > 0
+                    else 0.0)
+                metrics_registry.gauge(
+                    "user_dru_score", pool=pool, user=user).set(dru)
+                metrics_registry.gauge(
+                    "user_running_jobs", pool=pool, user=user).set(
+                        u.get("jobs", 0))
 
     def _backend_kill(self, task_id: str, preempt: bool = False) -> None:
         """Idempotent backend kill. When async launchers run, the kill

@@ -265,7 +265,8 @@ def _device_cycle(state, deltas, qm, qc, qn, considerable_limit, now_s,
         want, jnp.where(matched, res.cons_host, H), num_segments=H + 1)[:H]
     new_state = {**state, "pend": pend, "host": host}
     out = (res.cons_idx, res.cons_host, res.head_matched, res.n_matched,
-           res.n_considerable, res.mat_idx, res.mat_host)
+           res.n_considerable, res.mat_idx, res.mat_host,
+           res.why_idx, res.why_code, res.why_amt)
     return new_state, out
 
 
@@ -300,6 +301,9 @@ class _CycleOut:
     n_considerable: jnp.ndarray
     mat_idx: jnp.ndarray         # matched rows compacted to the prefix
     mat_host: jnp.ndarray        # (queue order; -1 pad past n_matched)
+    why_idx: jnp.ndarray = None  # decision provenance (ops/cycle.py
+    why_code: jnp.ndarray = None  # "why" window): pend row / reason
+    why_amt: jnp.ndarray = None  # code / datum per queue position
     t_dispatch: float = 0.0
     row_uuid: Optional[list] = None   # not snapshotted; rows are stable
                                       # until consumed_through advances
@@ -1395,8 +1399,14 @@ class ResidentPool:
         # tunneled link — the consume path does a bucketed prefix
         # slice instead (see coordinator._consume_cycle).
         if not self.synchronous or self.pipeline_depth > 0:
-            for arr in (co.head_matched, co.n_matched, co.n_considerable,
-                        co.mat_idx, co.mat_host):
+            arrs = [co.head_matched, co.n_matched, co.n_considerable,
+                    co.mat_idx, co.mat_host]
+            if getattr(self.coord.config, "decision_provenance", False):
+                # provenance rides the same early copy: by consume time
+                # the why-window is already host-side, costing link
+                # bandwidth concurrent with dispatch, not consume RTT
+                arrs += [co.why_idx, co.why_code, co.why_amt]
+            for arr in arrs:
                 copy_async = getattr(arr, "copy_to_host_async", None)
                 if copy_async is not None:
                     try:

@@ -204,8 +204,14 @@ def render_prometheus(snapshot: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
-# process-wide default registry (the codahale default-registry role)
-registry = MetricRegistry()
+# Process-wide default registry (the codahale default-registry role).
+# Since PR 8 this IS the obs registry instance: every producer that
+# imports `registry` from here lands on the same labeled-family
+# registry /metrics renders, so exposition has exactly one code path
+# (obs/metrics.py Registry.render). The MetricRegistry class above and
+# render_prometheus below remain for standalone registries
+# (StatsMonitor, tests) and for Graphite snapshot rendering.
+from cook_tpu.obs.metrics import registry  # noqa: E402
 
 
 class Reporter:

@@ -7,9 +7,11 @@ Boots the shared tests/livestack harness (REST server + coordinator +
 mock virtual-clock cluster), submits a job over HTTP, pumps match
 cycles until it completes, then HTTP-scrapes:
 
-  - ``/metrics``        — Prometheus text exposition
-  - ``/trace/<uuid>``   — the job's assembled lifecycle span tree
-  - ``/debug/flight``   — the cycle flight recorder
+  - ``/metrics``          — Prometheus text exposition (histograms)
+  - ``/trace/<uuid>``     — the job's assembled lifecycle span tree
+  - ``/debug/flight``     — the cycle flight recorder
+  - ``/unscheduled``      — decision provenance for a starved job
+  - ``/debug/decisions``  — the per-cycle decision ring
 
 and writes them (plus a Chrome-trace conversion of the trace, openable
 directly in Perfetto) into ``artifact_dir`` for the workflow's
@@ -60,9 +62,18 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
             time.sleep(0.05)
         print(f"job {uuid} completed")
 
+        # starve a job on purpose (nothing has 9999 GB) and pump one
+        # more cycle so the decision ring holds a no-host-fit verdict
+        starved = client.submit(command="true", mem=9999, cpus=1)
+        stack.coord.match_cycle()
+        unsched = json.loads(scrape(
+            stack.server.url + f"/unscheduled?job={starved}"))
+
         metrics = scrape(stack.server.url + "/metrics").decode()
         trace = json.loads(scrape(stack.server.url + f"/trace/{uuid}"))
         flight = json.loads(scrape(stack.server.url + "/debug/flight"))
+        decisions = json.loads(scrape(
+            stack.server.url + "/debug/decisions"))
 
         with open(os.path.join(artifact_dir, "metrics.txt"), "w") as f:
             f.write(metrics)
@@ -70,14 +81,30 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
             json.dump(trace, f, indent=1)
         with open(os.path.join(artifact_dir, "flight.json"), "w") as f:
             json.dump(flight, f, indent=1)
+        with open(os.path.join(artifact_dir,
+                               "decisions.json"), "w") as f:
+            json.dump({"unscheduled": unsched, "ring": decisions},
+                      f, indent=1)
         chrome = obs.to_chrome_trace(trace["spans"] + flight["spans"])
         with open(os.path.join(artifact_dir,
                                "chrome_trace.json"), "w") as f:
             json.dump(chrome, f)
 
         failures = []
-        if "cook_match_default_cycle_ms" not in metrics:
-            failures.append("/metrics missing match cycle timer")
+        if 'cook_match_cycle_ms_bucket{pool="default"' not in metrics:
+            failures.append("/metrics missing match cycle histogram")
+        if 'le="+Inf"} ' not in metrics:
+            failures.append("/metrics histograms have no buckets")
+        if 'cook_decisions_total{outcome="matched",pool="default"}' \
+                not in metrics:
+            failures.append("/metrics missing decision outcome counter")
+        codes = [r.get("code") for r in unsched[0]["reasons"]]
+        if "no_host_fit" not in codes:
+            failures.append(
+                f"/unscheduled lacks no_host_fit for starved job "
+                f"(got {codes})")
+        if not decisions.get("cycles"):
+            failures.append("/debug/decisions ring is empty")
         names = {sp["name"] for sp in trace["spans"]}
         for required in ("job.submit", "store.create_jobs",
                          "match.cycle", "launch_txn", "backend_launch",

@@ -268,15 +268,16 @@ def test_daemon_outbox_bounded_drops_oldest(tmp_path, monkeypatch):
 
     d = _dead_daemon(tmp_path, outbox_max=3)
     monkeypatch.setattr(d, "_post_retry", lambda *a, **kw: False)
-    before = metrics_registry.counter("agent.outbox_dropped").value
+    before = \
+        metrics_registry.counter("agent_outbox_dropped_total").value
     for i in range(5):
         d._on_status(f"t-{i}", "exited", {"exit_code": 0, "sandbox": ""})
     # oldest two dropped (the coordinator's heartbeat-diff safety net
     # eventually fails those tasks anyway); newest three retained
     assert [p["task_id"] for p in d._outbox] == ["t-2", "t-3", "t-4"]
     assert d.outbox_dropped == 2
-    assert metrics_registry.counter("agent.outbox_dropped").value == \
-        before + 2
+    assert metrics_registry.counter("agent_outbox_dropped_total").value \
+        == before + 2
 
 
 def test_daemon_outbox_flush_preserves_arrival_order(tmp_path,

@@ -569,6 +569,89 @@ def test_r6_suppression_on_loop_line():
     assert fs == []
 
 
+# ----------------------------------------------------------------------
+# R7 metrics discipline
+
+def test_r7_dynamic_name_flagged():
+    fs = run("""
+        from cook_tpu.utils.metrics import registry
+
+        def report(state, pool):
+            registry.counter(f"{state}.users.pool-{pool}").set(1)
+    """, rules=("R7",))
+    assert rules_of(fs) == ["R7"]
+    assert "string literal" in fs[0].message
+    assert fs[0].symbol == "report"
+
+
+def test_r7_non_snake_case_name_flagged():
+    fs = run("""
+        from cook_tpu.utils.metrics import registry
+
+        def report():
+            registry.counter("agent.outbox_dropped").inc()
+            registry.timer("launchTxnMs").update(1.0)
+    """, rules=("R7",))
+    assert rules_of(fs) == ["R7", "R7"]
+    assert all("snake_case" in f.message for f in fs)
+
+
+def test_r7_per_job_label_and_splat_flagged():
+    fs = run("""
+        from cook_tpu.obs.metrics import registry
+
+        def report(job, labels):
+            registry.counter("launches_total", uuid=job.uuid).inc()
+            registry.counter("launches_total", **labels).inc()
+    """, rules=("R7",))
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 2
+    assert any("per-job/task identity" in m for m in msgs)
+    assert any("splat" in m for m in msgs)
+
+
+def test_r7_direct_instantiation_flagged_registry_module_exempt():
+    bad = """
+        from cook_tpu.obs.metrics import Histogram
+
+        def make():
+            return Histogram()
+    """
+    fs = run(bad, rules=("R7",))
+    assert rules_of(fs) == ["R7"]
+    assert "through a registry" in fs[0].message
+    # the registry modules construct the value classes themselves
+    assert run(bad, rules=("R7",),
+               path="cook_tpu/obs/metrics.py") == []
+
+
+def test_r7_clean_labeled_families_pass():
+    fs = run("""
+        from cook_tpu.utils.metrics import registry as metrics_registry
+
+        def report(pool, user, ms):
+            metrics_registry.histogram(
+                "match_cycle_ms", pool=pool).observe(ms)
+            metrics_registry.counter(
+                "decisions_total", pool=pool, outcome="matched").inc()
+            metrics_registry.gauge(
+                "user_dru_score", pool=pool, user=user).set(1.0)
+            metrics_registry.histogram(
+                "ingest_wait_ms", buckets=(1.0, 2.0)).observe(ms)
+    """, rules=("R7",))
+    assert fs == []
+
+
+def test_r7_suppression():
+    fs = run("""
+        from cook_tpu.utils.metrics import registry
+
+        def report(state):
+            registry.counter(f"{state}.users").set(1)  # cookcheck: disable=R7
+    """, rules=("R7",))
+    assert fs == []
+
+
 def test_syntax_error_reports_r0():
     fs = analyze_source("def broken(:\n", "bad.py")
     assert rules_of(fs) == ["R0"]

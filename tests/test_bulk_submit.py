@@ -305,6 +305,66 @@ def test_bulk_429_maps_retry_after_header(tmp_path):
         ingest.stop()
 
 
+def test_bulk_submit_continues_inbound_trace(stack):
+    """Bulk submissions get the same trace treatment as single ones: a
+    per-job root span continuing the caller's traceparent, stamped into
+    the job so /jobs/<uuid>/trace can assemble the lifecycle."""
+    from cook_tpu import obs
+    trace_id = obs.trace.new_trace_id()
+    inbound = obs.trace.make_traceparent(trace_id, obs.trace.new_span_id())
+    specs = _specs(3)
+    resp = stack.api.handle(
+        "POST", "/jobs/bulk", {}, {"jobs": specs},
+        {"x-cook-user": "alice", "traceparent": inbound})
+    assert resp.status == 201, resp.body
+    for s in specs:
+        job = stack.store.jobs[s["uuid"]]
+        ctx = obs.trace.parse_traceparent(job.traceparent)
+        assert ctx and ctx[0] == trace_id
+    spans = obs.tracer.trace(trace_id)
+    assert sum(1 for sp in spans if sp["name"] == "job.submit") == 3
+
+
+def test_ingest_metrics_rejections_and_queue_depth(tmp_path):
+    """Admission control is observable: a 429 bumps the rejection
+    counter, queue depth is exported as a gauge, and drained requests
+    record their queue wait in the ingest_wait_ms histogram."""
+    from cook_tpu.utils.metrics import registry
+    rejected = registry.counter("ingest_rejected_total")
+    wait_hist = registry.histogram("ingest_wait_ms")
+    r0, w0 = rejected.value, wait_hist.count
+    store = GatedStore(log_path=str(tmp_path / "events.log"))
+    ingest = IngestBatcher(store, workers=1, queue_depth=1, max_batch=4,
+                           retry_after_s=1)
+    try:
+        store.gate.clear()
+        blocked = []
+        for i in range(2):
+            jobs = [Job(uuid=new_uuid(), user="u", command="true",
+                        mem=1.0, cpus=0.1)]
+            t = threading.Thread(target=ingest.submit_and_wait,
+                                 args=(jobs,))
+            t.start()
+            blocked.append(t)
+            deadline = time.time() + 5.0
+            want = 0 if i == 0 else 1
+            while ingest._q.qsize() != want and time.time() < deadline:
+                time.sleep(0.01)
+        assert registry.gauge("ingest_queue_depth").value == 1
+        with pytest.raises(IngestQueueFull):
+            ingest.submit_and_wait([Job(uuid=new_uuid(), user="u",
+                                        command="true", mem=1.0,
+                                        cpus=0.1)])
+        assert rejected.value == r0 + 1
+        store.gate.set()
+        for t in blocked:
+            t.join(10.0)
+        # both drained requests observed their time-in-queue
+        assert wait_hist.count >= w0 + 2
+    finally:
+        ingest.stop()
+
+
 def test_differential_oracle_batched_vs_sequential(stack, tmp_path):
     """Concurrent batched ingest must reach exactly the state
     sequential per-request ingest reaches: same jobs, same essential

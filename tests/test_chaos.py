@@ -280,14 +280,15 @@ def test_agent_cluster_breaker_excludes_open_host():
     cluster.register_agent(reg)
     assert [o.hostname for o in cluster.pending_offers("default")] == \
         ["h1"]
-    trips_before = metrics_registry.counter("agent.breaker_trips").value
+    trips_before = \
+        metrics_registry.counter("agent_breaker_trips_total").value
     for _ in range(2):                       # nothing listens on :1
         with pytest.raises(Exception):
             cluster._post("http://127.0.0.1:1/kill", {}, hostname="h1")
     snap = cluster.breaker_snapshots()["h1"]
     assert snap["state"] == OPEN and snap["trips"] == 1
-    assert metrics_registry.counter("agent.breaker_trips").value == \
-        trips_before + 1
+    assert metrics_registry.counter("agent_breaker_trips_total").value \
+        == trips_before + 1
     # open host: no offers, and calls short-circuit without the wire
     assert cluster.pending_offers("default") == []
     with pytest.raises(BreakerOpenError):

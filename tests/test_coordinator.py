@@ -284,12 +284,12 @@ def test_degraded_cluster_offers_skipped_not_fatal():
     jobs = [mkjob() for _ in range(2)]
     store.create_jobs(jobs)
     before = metrics_registry.counter(
-        "match.default.cluster_skipped").value
+        "cluster_skipped_total", pool="default").value
     stats = coord.match_cycle()
     assert stats.matched == 2
     assert {j.instances[0].hostname for j in jobs} == {"g0"}
     assert metrics_registry.counter(
-        "match.default.cluster_skipped").value == before + 1
+        "cluster_skipped_total", pool="default").value == before + 1
 
 
 def test_degraded_cluster_launch_error_does_not_wedge_cycle():
@@ -313,11 +313,11 @@ def test_degraded_cluster_launch_error_does_not_wedge_cycle():
     jobs = [mkjob(mem=100, cpus=1, max_retries=1) for _ in range(2)]
     store.create_jobs(jobs)
     before = metrics_registry.counter(
-        "match.default.cluster_launch_errors").value
+        "cluster_launch_errors_total", pool="default").value
     stats = coord.match_cycle()             # must not raise
     assert stats.matched == 2
     assert metrics_registry.counter(
-        "match.default.cluster_launch_errors").value == before + 1
+        "cluster_launch_errors_total", pool="default").value == before + 1
     by_host = {j.instances[0].hostname: j for j in jobs}
     assert by_host["g0"].instances[0].status == InstanceStatus.RUNNING
     swallowed = by_host["b0"]

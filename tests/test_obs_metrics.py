@@ -1,0 +1,196 @@
+"""Unit tests for the labeled-family metrics registry
+(cook_tpu.obs.metrics): metric kinds, label handling, cardinality
+bounds, Prometheus exposition, and the snapshot shape the
+Graphite/JSONL reporters flatten."""
+import pytest
+
+from cook_tpu.obs.metrics import (DEFAULT_BUCKETS, Histogram, Registry,
+                                  Timer)
+
+
+@pytest.fixture
+def reg():
+    return Registry()
+
+
+# ---------------------------------------------------------------------
+# families, labels, identity
+
+def test_same_labels_same_child(reg):
+    a = reg.counter("launches_total", pool="default")
+    b = reg.counter("launches_total", pool="default")
+    c = reg.counter("launches_total", pool="gpu")
+    a.inc(2)
+    assert a is b and a is not c
+    assert b.value == 2 and c.value == 0
+
+
+def test_label_order_does_not_matter(reg):
+    a = reg.gauge("user_dru_score", pool="p", user="u")
+    b = reg.gauge("user_dru_score", user="u", pool="p")
+    assert a is b
+
+
+def test_kind_conflict_rejected(reg):
+    reg.counter("thing_total")
+    with pytest.raises(ValueError, match="counter"):
+        reg.gauge("thing_total")
+
+
+def test_label_name_set_must_be_consistent(reg):
+    reg.counter("decisions_total", pool="p", outcome="matched")
+    with pytest.raises(ValueError, match="label names"):
+        reg.counter("decisions_total", pool="p")
+
+
+def test_labeled_names_must_be_snake_case(reg):
+    with pytest.raises(ValueError, match="snake_case"):
+        reg.counter("bad.dotted", pool="p")
+    with pytest.raises(ValueError, match="snake_case"):
+        reg.counter("fine_total", **{"Pool": "p"})
+    # legacy dotted names stay accepted when unlabeled
+    reg.counter("agent.legacy_name").inc()
+
+
+def test_cardinality_cap_collapses_to_overflow(reg):
+    small = Registry(label_cap=3)
+    for i in range(3):
+        small.counter("c_total", user=f"u{i}").inc()
+    spill_a = small.counter("c_total", user="u99")
+    spill_b = small.counter("c_total", user="u100")
+    assert spill_a is spill_b          # one overflow child, not new ones
+    spill_a.inc()
+    assert small.counter(
+        "metrics_label_overflow_total", metric="c_total").value == 2
+    text = small.render()
+    assert 'cook_c_total{overflow="true"} 1' in text
+    assert 'user="u99"' not in text
+
+
+# ---------------------------------------------------------------------
+# histogram semantics
+
+def test_histogram_buckets_cumulative_and_sum():
+    h = Histogram(buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["sum"] == 105.0
+    lines = []
+    h.render_into(lines, "cook_x_ms", "")
+    assert 'cook_x_ms_bucket{le="1"} 1' in lines
+    assert 'cook_x_ms_bucket{le="2"} 2' in lines
+    assert 'cook_x_ms_bucket{le="4"} 3' in lines
+    assert 'cook_x_ms_bucket{le="+Inf"} 4' in lines
+    assert "cook_x_ms_count 4" in lines
+
+
+def test_histogram_boundary_value_lands_in_its_bucket():
+    h = Histogram(buckets=(1.0, 2.0))
+    h.observe(2.0)          # le="2" is inclusive (Prometheus semantics)
+    lines = []
+    h.render_into(lines, "m", "")
+    assert 'm_bucket{le="1"} 0' in lines
+    assert 'm_bucket{le="2"} 1' in lines
+
+
+def test_histogram_quantile_interpolation():
+    h = Histogram(buckets=(10.0, 20.0))
+    for _ in range(100):
+        h.observe(15.0)     # all in the (10, 20] bucket
+    snap = h.snapshot()
+    assert 10.0 < snap["p50"] <= 20.0
+    assert 10.0 < snap["p99"] <= 20.0
+
+
+def test_histogram_labeled_bucket_lines(reg):
+    reg.histogram("lat_ms", buckets=(1.0,), pool="p").observe(0.5)
+    text = reg.render()
+    assert 'cook_lat_ms_bucket{pool="p",le="1"} 1' in text
+    assert 'cook_lat_ms_sum{pool="p"} 0.5' in text
+    assert "# TYPE cook_lat_ms histogram" in text
+
+
+def test_default_buckets_are_log_spaced():
+    assert DEFAULT_BUCKETS[0] == 0.25
+    ratios = {DEFAULT_BUCKETS[i + 1] / DEFAULT_BUCKETS[i]
+              for i in range(len(DEFAULT_BUCKETS) - 1)}
+    assert ratios == {2.0}
+
+
+# ---------------------------------------------------------------------
+# timer / meter legacy shapes
+
+def test_timer_exact_quantiles_and_summary_lines():
+    t = Timer()
+    for v in (10.0, 12.5, 15.0):
+        t.update(v)
+    snap = t.snapshot()
+    assert snap["p50"] == 12.5 and snap["count"] == 3
+    lines = []
+    t.render_into(lines, "cook_t", "")
+    assert 'cook_t{quantile="0.5"} 12.5' in lines
+
+
+def test_meter_renders_total_and_rate(reg):
+    m = reg.meter("events")
+    m.mark(5)
+    text = reg.render()
+    assert "# TYPE cook_events_total counter" in text
+    assert "cook_events_total 5" in text
+    assert "cook_events_rate" in text
+
+
+def test_histogram_time_context(reg):
+    h = reg.histogram("span_ms")
+    with h.time():
+        pass
+    assert h.count == 1
+
+
+# ---------------------------------------------------------------------
+# exposition / snapshot plumbing
+
+def test_render_counter_integral_and_dotted_sanitation(reg):
+    reg.counter("agent.breaker.trips").inc(3)
+    text = reg.render()
+    # historical sanitation: dots -> underscores, integral floats
+    # render without ".0" (test_rest_api pins this shape)
+    assert "cook_agent_breaker_trips 3" in text
+
+
+def test_snapshot_uses_graphite_tag_keys(reg):
+    reg.counter("decisions_total", pool="p", outcome="matched").inc()
+    reg.gauge("depth").set(7)
+    snap = reg.snapshot()
+    assert snap["decisions_total;outcome=matched;pool=p"] == {
+        "type": "counter", "value": 1.0}
+    assert snap["depth"]["type"] == "gauge"
+
+
+def test_graphite_reporter_flattens_labeled_snapshot(reg):
+    from cook_tpu.utils.metrics import GraphiteReporter
+    reg.histogram("h_ms", pool="p").observe(3.0)
+    out = []
+    GraphiteReporter._flatten("cook", reg.snapshot(), out)
+    names = [n for n, _ in out]
+    assert any("h_ms;pool=p" in n and n.endswith(".count")
+               for n in names)
+
+
+def test_label_value_escaping(reg):
+    reg.counter("r_total", reason='say "hi"\n').inc()
+    text = reg.render()
+    assert r'reason="say \"hi\"\n"' in text
+
+
+def test_clear_for_test_isolation(reg):
+    reg.counter("x_total").inc()
+    reg.clear()
+    assert reg.snapshot() == {}
+
+
+def test_process_registry_is_shared_with_utils():
+    from cook_tpu.obs.metrics import registry as obs_registry
+    from cook_tpu.utils.metrics import registry as utils_registry
+    assert obs_registry is utils_registry

@@ -1,0 +1,366 @@
+"""Decision provenance end to end: the device cycle's why-codes, the
+DecisionBook, and GET /unscheduled / /debug/decisions.
+
+One test per synthesized starvation cause (quota-capped, rank-cutoff,
+no-host-fit, degraded pool, breaker-open backend) asserting the
+structured reason, plus a NumPy oracle that recomputes the reason-code
+classification for random fused cycles."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cook_tpu.backends.agent import AgentCluster
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.obs import decisions as dprov
+from cook_tpu.ops import cycle as cycle_ops
+from cook_tpu.ops import match as match_ops
+from cook_tpu.rest.api import CookApi
+from cook_tpu.rest.auth import AuthConfig
+from cook_tpu.scheduler.coordinator import Coordinator
+from cook_tpu.state.model import new_uuid
+from cook_tpu.state.store import JobStore
+from tests.test_cycle_parallel import make_cycle_inputs
+
+
+@pytest.fixture
+def stack():
+    store = JobStore()
+    cluster = MockCluster([MockHost("h0", mem=1000, cpus=16)])
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg)
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header", admins={"admin"}))
+    return store, cluster, coord, api
+
+
+def call(api, method, path, user="alice", body=None, query=None):
+    q = {k: v if isinstance(v, list) else [v]
+         for k, v in (query or {}).items()}
+    return api.handle(method, path, q, body, {"x-cook-user": user})
+
+
+def submit(api, user="alice", n=1, **job_kw):
+    jobs = [{"uuid": new_uuid(), "command": "sleep 1", "mem": 100,
+             "cpus": 1, **job_kw} for _ in range(n)]
+    resp = call(api, "POST", "/jobs", user=user, body={"jobs": jobs})
+    assert resp.status == 201, resp.body
+    return resp.body["jobs"]
+
+
+def why(api, uuid, user="alice"):
+    resp = call(api, "GET", "/unscheduled", user=user,
+                query={"job": uuid})
+    assert resp.status == 200, resp.body
+    (entry,) = resp.body
+    assert entry["uuid"] == uuid
+    return entry
+
+
+# ---------------------------------------------------------------------
+# one structured reason per synthesized starvation cause
+
+def test_quota_count_capped(stack):
+    store, _, coord, api = stack
+    coord.quotas.set("alice", "default", count=0)
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    entry = why(api, uuid)
+    top = entry["reasons"][0]
+    assert top["code"] == "quota_count"
+    assert top["data"]["quota"] == "count"
+    assert top["data"]["exceeded_by"] == 1.0
+    assert entry["decisions"][0]["reason"] == "quota_count"
+
+
+def test_quota_mem_capped_reports_overage(stack):
+    store, _, coord, api = stack
+    coord.quotas.set("alice", "default", mem=60.0)
+    (uuid,) = submit(api)          # mem=100 > quota 60
+    coord.match_cycle()
+    top = why(api, uuid)["reasons"][0]
+    assert top["code"] == "quota_mem"
+    assert top["data"]["quota"] == "mem"
+    assert top["data"]["exceeded_by"] == pytest.approx(40.0)
+
+
+def test_rank_cutoff(stack):
+    store, _, coord, api = stack
+    submit(api, n=3)
+    # scaleback lowered the dynamic considerable limit to 1: only the
+    # fair-queue head is considered, the rest are rank-cutoff
+    coord._num_considerable["default"] = 1
+    coord.match_cycle()
+    waiting = store.pending_jobs("default")
+    assert waiting, "one job should match, the rest stay pending"
+    entry = why(api, waiting[0].uuid)
+    top = entry["reasons"][0]
+    assert top["code"] == "rank_cutoff"
+    assert top["data"]["rank"] >= 2        # pre-cap considerable ordinal
+    assert "cutoff" in top["data"]
+
+
+def test_no_host_fit(stack):
+    store, _, coord, api = stack
+    (uuid,) = submit(api, mem=5000)        # no host has 5000 mem
+    coord.match_cycle()
+    top = why(api, uuid)["reasons"][0]
+    assert top["code"] == "no_host_fit"
+    assert "couldn't be placed" in top["reason"]
+
+
+def test_matched_job_reports_decision_history(stack):
+    store, _, coord, api = stack
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    entry = why(api, uuid)
+    assert entry["reasons"][0]["code"] == "running"
+    d = entry["decisions"][0]
+    assert d["reason"] == "matched" and d["amount"] >= 0
+
+
+def test_degraded_pool_cluster_skipped(stack):
+    store, _, coord, api = stack
+
+    class FailingCluster:
+        name = "broken"
+
+        def pending_offers(self, pool):
+            raise ConnectionError("backend down")
+
+        def all_offers(self):
+            return []
+
+        def autoscale(self, pool, count, pending_sizes=None):
+            pass
+
+        def describe_agents(self):
+            return []
+
+    coord.clusters.register(FailingCluster())
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    entry = why(api, uuid)
+    codes = [r.get("code") for r in entry["reasons"]]
+    assert "cluster_degraded" in codes
+    deg = next(r for r in entry["reasons"]
+               if r.get("code") == "cluster_degraded")
+    assert deg["data"]["clusters"] == ["broken"]
+
+
+def test_breaker_open_backend_degraded():
+    store = JobStore()
+    agents = AgentCluster(breaker_failures=1, breaker_reset_s=60.0,
+                          request_timeout_s=0.2)
+    agents.register_agent({"hostname": "h1", "url": "http://127.0.0.1:1",
+                           "mem": 100, "cpus": 4})
+    with pytest.raises(Exception):      # nothing listens on :1
+        agents._post("http://127.0.0.1:1/kill", {}, hostname="h1")
+    reg = ClusterRegistry()
+    reg.register(agents)
+    coord = Coordinator(store, reg)
+    api = CookApi(store, coordinator=coord,
+                  auth=AuthConfig(scheme="header"))
+    (uuid,) = submit(api)
+    entry = why(api, uuid)
+    deg = next(r for r in entry["reasons"]
+               if r.get("code") == "backend_degraded")
+    assert deg["data"]["agents"] == [
+        {"hostname": "h1", "cluster": agents.name, "state": "open"}]
+
+
+def test_unconsidered_job_reports_window(stack):
+    store, _, coord, api = stack
+    (uuid,) = submit(api)                  # no cycle has run
+    top = why(api, uuid)["reasons"][0]
+    assert top["code"] == "rank_beyond_window"
+    assert "window" in top["data"]
+
+
+def test_unscheduled_requires_job_param_and_auth(stack):
+    _, _, coord, api = stack
+    assert call(api, "GET", "/unscheduled").status == 400
+    (uuid,) = submit(api, user="alice")
+    resp = call(api, "GET", "/unscheduled", user="mallory",
+                query={"job": uuid})
+    assert resp.status == 403
+
+
+def test_debug_decisions_ring(stack):
+    store, _, coord, api = stack
+    submit(api, n=2)
+    coord.match_cycle()
+    resp = call(api, "GET", "/debug/decisions", user="admin")
+    assert resp.status == 200
+    cyc = resp.body["cycles"][0]
+    assert cyc["pool"] == "default"
+    assert cyc["outcomes"].get("matched", 0) >= 1
+    assert resp.body["stats"]["cycles_recorded"] >= 1
+
+
+def test_decisions_total_counter_incremented(stack):
+    from cook_tpu.utils.metrics import registry as metrics_registry
+    store, _, coord, api = stack
+    before = metrics_registry.counter(
+        "decisions_total", pool="default", outcome="matched").value
+    submit(api)
+    coord.match_cycle()
+    after = metrics_registry.counter(
+        "decisions_total", pool="default", outcome="matched").value
+    assert after == before + 1
+
+
+def test_provenance_disabled_records_nothing(stack):
+    store, _, coord, api = stack
+    coord.config.decision_provenance = False
+    (uuid,) = submit(api)
+    coord.match_cycle()
+    assert coord.decisions.job_decisions(uuid) == []
+    # the endpoint still answers, from the host-side fallbacks
+    assert why(api, uuid)["reasons"][0]["code"] == "running"
+
+
+# ---------------------------------------------------------------------
+# NumPy oracle: recompute the classification for random fused cycles
+
+def _oracle_codes(inp, res, C, cap):
+    """Recompute why codes from primitive inputs + the device's queue
+    order and host assignment (ops/cycle.py provenance epilogue)."""
+    P = len(inp["pend_valid"])
+    U = len(inp["user_quota_mem"])
+    perm = np.argsort(np.asarray(res.queue_rank))   # pos -> pending row
+    job_host = np.asarray(res.job_host)
+    # running usage per user
+    u_mem = np.zeros(U)
+    u_cpus = np.zeros(U)
+    u_cnt = np.zeros(U)
+    for i in range(len(inp["run_valid"])):
+        if inp["run_valid"][i]:
+            u = inp["run_user"][i]
+            u_mem[u] += inp["run_mem"][i]
+            u_cpus[u] += inp["run_cpus"][i]
+            u_cnt[u] += 1
+    W = min(C, P)
+    codes = np.zeros(W, np.int32)
+    amts = np.zeros(W, np.float64)
+    cum = np.zeros((U, 3))
+    taken = 0
+    for pos in range(P):
+        row = perm[pos]
+        valid = bool(inp["pend_valid"][row])
+        if valid:
+            u = int(inp["pend_user"][row])
+            cum[u] += (inp["pend_mem"][row], inp["pend_cpus"][row], 1.0)
+            over = np.array([
+                u_mem[u] + cum[u, 0] - inp["user_quota_mem"][u],
+                u_cpus[u] + cum[u, 1] - inp["user_quota_cpus"][u],
+                u_cnt[u] + cum[u, 2] - inp["user_quota_count"][u]])
+            within = bool((over <= 0).all())
+            if within:
+                taken += 1
+        if pos >= W:
+            continue
+        if not valid:
+            codes[pos], amts[pos] = dprov.INVALID, 0.0
+        elif within and taken <= cap:
+            if job_host[row] >= 0:
+                codes[pos] = dprov.MATCHED
+                amts[pos] = float(job_host[row])
+            else:
+                codes[pos], amts[pos] = dprov.NO_HOST_FIT, 0.0
+        elif not within:
+            dim = int(np.argmax(over > 0))   # mem -> cpus -> count
+            codes[pos] = (dprov.QUOTA_MEM, dprov.QUOTA_CPUS,
+                          dprov.QUOTA_COUNT)[dim]
+            amts[pos] = over[dim]
+        else:
+            codes[pos], amts[pos] = dprov.RANK_CUTOFF, float(taken)
+    return perm, codes, amts
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_why_codes_match_numpy_oracle(seed):
+    rng = np.random.default_rng(seed)
+    inp = make_cycle_inputs(rng, R=12, Pn=24, H=4, U=3)
+    # finite quotas + a dynamic cap so every code can appear
+    inp["user_quota_mem"] = rng.uniform(5, 40, 3).astype(np.float32)
+    inp["user_quota_cpus"] = rng.uniform(2, 16, 3).astype(np.float32)
+    inp["user_quota_count"] = rng.integers(1, 5, 3).astype(np.float32)
+    C, cap = 16, 5
+    res = cycle_ops.rank_and_match(
+        **{k: (jnp.asarray(v) if not isinstance(v, match_ops.Hosts)
+               else v) for k, v in inp.items()},
+        num_considerable=C, considerable_limit=cap)
+    perm, want_codes, want_amts = _oracle_codes(inp, res, C, cap)
+    W = len(want_codes)
+    got_idx = np.asarray(res.why_idx)
+    got_codes = np.asarray(res.why_code)
+    got_amts = np.asarray(res.why_amt)
+    valid_pos = np.asarray(inp["pend_valid"])[perm[:W]]
+    np.testing.assert_array_equal(
+        got_idx, np.where(valid_pos, perm[:W], -1))
+    np.testing.assert_array_equal(got_codes, want_codes)
+    np.testing.assert_allclose(got_amts, want_amts, rtol=1e-5,
+                               atol=1e-4)
+
+
+def test_oracle_random_cycles_exercise_quota_codes():
+    """The parametrized seeds above are only meaningful if the random
+    tight-quota cycles actually produce quota starvation codes."""
+    seen = set()
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        inp = make_cycle_inputs(rng, R=12, Pn=24, H=4, U=3)
+        inp["user_quota_mem"] = rng.uniform(5, 40, 3).astype(np.float32)
+        inp["user_quota_cpus"] = rng.uniform(2, 16, 3).astype(np.float32)
+        inp["user_quota_count"] = rng.integers(1, 5, 3).astype(
+            np.float32)
+        res = cycle_ops.rank_and_match(
+            **{k: (jnp.asarray(v) if not isinstance(v, match_ops.Hosts)
+                   else v) for k, v in inp.items()},
+            num_considerable=16, considerable_limit=5)
+        seen |= set(np.asarray(res.why_code).tolist())
+    assert dprov.MATCHED in seen
+    assert seen & {dprov.QUOTA_MEM, dprov.QUOTA_CPUS,
+                   dprov.QUOTA_COUNT}
+
+
+def test_oracle_rank_cutoff_cycle():
+    """INF quotas + a dynamic cap of 2: everything past the first two
+    taken jobs is RANK_CUTOFF. Checked against the oracle."""
+    rng = np.random.default_rng(7)
+    inp = make_cycle_inputs(rng, R=4, Pn=24, H=4, U=3)
+    inp["pend_valid"] = np.ones(24, bool)
+    C, cap = 16, 2
+    res = cycle_ops.rank_and_match(
+        **{k: (jnp.asarray(v) if not isinstance(v, match_ops.Hosts)
+               else v) for k, v in inp.items()},
+        num_considerable=C, considerable_limit=cap)
+    _, want_codes, want_amts = _oracle_codes(inp, res, C, cap)
+    got = np.asarray(res.why_code)
+    np.testing.assert_array_equal(got, want_codes)
+    np.testing.assert_allclose(np.asarray(res.why_amt), want_amts,
+                               rtol=1e-5, atol=1e-4)
+    assert (got == dprov.RANK_CUTOFF).sum() == len(got) - cap
+
+
+def test_oracle_invalid_rows_inside_window():
+    """With only a handful of valid pending rows, the padding rows land
+    inside the decision window and must read INVALID / idx -1."""
+    rng = np.random.default_rng(11)
+    inp = make_cycle_inputs(rng, R=4, Pn=24, H=4, U=3)
+    valid = np.zeros(24, bool)
+    valid[:5] = True
+    inp["pend_valid"] = valid
+    C = 16
+    res = cycle_ops.rank_and_match(
+        **{k: (jnp.asarray(v) if not isinstance(v, match_ops.Hosts)
+               else v) for k, v in inp.items()},
+        num_considerable=C, considerable_limit=C)
+    perm, want_codes, want_amts = _oracle_codes(inp, res, C, C)
+    got_codes = np.asarray(res.why_code)
+    np.testing.assert_array_equal(got_codes, want_codes)
+    assert (got_codes == dprov.INVALID).any()
+    got_idx = np.asarray(res.why_idx)
+    assert (got_idx[got_codes == dprov.INVALID] == -1).all()
