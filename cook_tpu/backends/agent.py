@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -66,6 +68,12 @@ class AgentInfo:
 class AgentCluster(ComputeCluster):
     """ComputeCluster over registered network agents."""
 
+    # consume lanes pre-encode each spec's CKS1 segment at match time
+    # (LaunchSpec.wire_segment) so the launch POST splices bytes
+    # instead of re-encoding; backends without a binary wire leave
+    # this False and skip that work entirely
+    spec_wire_eager = True
+
     def __init__(self, name: str = "agents",
                  heartbeat_timeout_s: float = 30.0,
                  progress_aggregator=None, heartbeats=None,
@@ -74,7 +82,8 @@ class AgentCluster(ComputeCluster):
                  agent_token: str = "",
                  task_lookup=None,
                  breaker_failures: int = 3,
-                 breaker_reset_s: float = 30.0):
+                 breaker_reset_s: float = 30.0,
+                 fanout_workers: int = 8):
         self.name = name
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.request_timeout_s = request_timeout_s
@@ -82,6 +91,12 @@ class AgentCluster(ComputeCluster):
         self.agent_token = agent_token
         self.breaker_failures = breaker_failures
         self.breaker_reset_s = breaker_reset_s
+        # parallel launch fan-out width: one worker posts to one host;
+        # <=1 keeps the serial loop (Settings.scheduler
+        # launch_fanout_workers). The executor is lazy so clusters
+        # that never launch (read replicas) start no threads.
+        self.fanout_workers = max(1, int(fanout_workers))
+        self._fanout: Optional[ThreadPoolExecutor] = None
         # hostname -> CircuitBreaker over coordinator->agent RPCs: a
         # host that black-holes requests stops receiving offers (OPEN)
         # instead of costing a request_timeout_s stall per launch cycle
@@ -99,6 +114,10 @@ class AgentCluster(ComputeCluster):
         self.agents: dict[str, AgentInfo] = {}
         # task -> (spec, host, launched_ms)
         self._specs: dict[str, tuple[LaunchSpec, str, int]] = {}
+        # hostname -> [mem, cpus, gpus, task_count] consumed by tracked
+        # specs, maintained incrementally by _track/_untrack so
+        # pending_offers is O(agents), not O(tracked specs × agents)
+        self._used: dict[str, list] = {}
         # heartbeat-diff strike counts: a task is only failed lost after
         # missing from TWO consecutive heartbeats, so an in-flight
         # terminal status post (executor pops the task before POSTing)
@@ -192,9 +211,42 @@ class AgentCluster(ComputeCluster):
                           hostname=hostname, command=job.command,
                           mem=job.mem, cpus=job.cpus, gpus=job.gpus)
         with self._lock:
-            self._specs.setdefault(task_id, (spec, hostname, now_ms()))
+            if task_id not in self._specs:
+                self._track_locked(spec, hostname, now_ms())
         logger.info("adopted running task %s on %s", task_id, hostname)
         return True
+
+    def _track_locked(self, spec: LaunchSpec, hostname: str,
+                      t0: int) -> None:
+        """Record a tracked spec + fold its resources into the per-host
+        used aggregate (caller holds the lock; the ONLY writer of
+        _specs additions, so _used can never drift from _specs)."""
+        self._specs[spec.task_id] = (spec, hostname, t0)
+        u = self._used.get(hostname)
+        if u is None:
+            u = self._used[hostname] = [0.0, 0.0, 0.0, 0]
+        u[0] += spec.mem
+        u[1] += spec.cpus
+        u[2] += spec.gpus
+        u[3] += 1
+
+    def _untrack_locked(self, task_id: str):
+        """Inverse of _track_locked; returns the popped entry (or
+        None). Un-counts the exact resources counted in, and drops the
+        host row at zero tasks so _used stays O(hosts with work) — a
+        float-drift residue cannot accumulate across task churn."""
+        entry = self._specs.pop(task_id, None)
+        if entry is not None:
+            spec, h, _ = entry
+            u = self._used.get(h)
+            if u is not None:
+                u[0] -= spec.mem
+                u[1] -= spec.cpus
+                u[2] -= spec.gpus
+                u[3] -= 1
+                if u[3] <= 0:
+                    self._used.pop(h, None)
+        return entry
 
     def agent_heartbeat(self, payload: dict) -> dict:
         """POST /agents/heartbeat: {hostname, tasks: [alive ids]}.
@@ -354,12 +406,13 @@ class AgentCluster(ComputeCluster):
                     # posts to an idle agent, so withholding offers
                     # would leave the breaker half-open forever)
                     continue
-                used_mem = used_cpus = used_gpus = 0.0
-                for spec, h, _ in self._specs.values():
-                    if h == info.hostname:
-                        used_mem += spec.mem
-                        used_cpus += spec.cpus
-                        used_gpus += spec.gpus
+                # incremental per-host aggregate (maintained by
+                # _track/_untrack) — the old per-agent rescan of every
+                # tracked spec made offer generation O(specs × agents)
+                used = self._used.get(info.hostname)
+                used_mem, used_cpus, used_gpus = \
+                    (used[0], used[1], used[2]) if used \
+                    else (0.0, 0.0, 0.0)
                 mem = info.mem - used_mem
                 cpus = info.cpus - used_cpus
                 if mem <= 0 and cpus <= 0:
@@ -373,53 +426,106 @@ class AgentCluster(ComputeCluster):
         return offers
 
     def launch_tasks(self, pool: str, specs: list[LaunchSpec]) -> None:
+        """One POST per host per call (per-host ordering), fanned out
+        across a bounded executor when several hosts are addressed —
+        the serial per-host loop made backend_launch scale with host
+        count × RTT on the cycle thread. Every per-host outcome is
+        folded back before returning (futures joined), so the
+        at-most-once contract is unchanged: by the time this returns,
+        every spec is either tracked on its agent or already failed
+        through the status callback (REASON_HOST_LOST /
+        REASON_LAUNCH_FAILED with best-effort kill), exactly as the
+        serial loop left it."""
         by_host: dict[str, list[LaunchSpec]] = {}
         for spec in specs:
             by_host.setdefault(spec.hostname, []).append(spec)
-        for hostname, host_specs in by_host.items():
-            with self._lock:
-                info = self.agents.get(hostname)
-                if info is None or not info.alive:
-                    info = None
-                else:
-                    t0 = now_ms()
-                    for s in host_specs:
-                        self._specs[s.task_id] = (s, hostname, t0)
-            if info is None:
+        if not by_host:
+            return
+        t0 = time.perf_counter()
+        if len(by_host) == 1 or self.fanout_workers <= 1:
+            for hostname, host_specs in by_host.items():
+                self._launch_host(hostname, host_specs)
+        else:
+            futs = [self._fanout_pool().submit(
+                        self._launch_host, hostname, host_specs)
+                    for hostname, host_specs in by_host.items()]
+            err = None
+            for f in futs:
+                try:
+                    f.result()
+                except BaseException as e:   # noqa: BLE001
+                    # per-task launch failures are handled INSIDE
+                    # _launch_host; anything escaping it is a
+                    # programming error — join every host first, then
+                    # surface it like the serial loop would have
+                    err = err or e
+            if err is not None:
+                raise err
+        metrics_registry.histogram("launch_fanout_ms", pool=pool) \
+            .observe((time.perf_counter() - t0) * 1000.0)
+
+    def _fanout_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._fanout is None:
+                self._fanout = ThreadPoolExecutor(
+                    max_workers=self.fanout_workers,
+                    thread_name_prefix="agent-fanout")
+            return self._fanout
+
+    def _launch_host(self, hostname: str,
+                     host_specs: list[LaunchSpec]) -> None:
+        """Launch one host's specs: track, POST once, and on failure
+        best-effort-kill + FAILED each spec (breaker/chaos semantics
+        identical to the old serial loop — the executor only changes
+        WHERE this runs, not what it does)."""
+        with self._lock:
+            info = self.agents.get(hostname)
+            if info is None or not info.alive:
+                info = None
+            else:
+                t0 = now_ms()
                 for s in host_specs:
-                    self.emit_status(s.task_id, InstanceStatus.FAILED,
-                                     REASON_HOST_LOST)
-                continue
-            wire = [_spec_wire(s) for s in host_specs]
-            try:
-                # agents that advertised the binary framing get the
-                # compact frame; everyone else the legacy JSON body
-                if specwire.WIRE_FORMAT in info.spec_wire:
-                    self._post(info.url + "/launch", None,
+                    self._track_locked(s, hostname, t0)
+        if info is None:
+            for s in host_specs:
+                self.emit_status(s.task_id, InstanceStatus.FAILED,
+                                 REASON_HOST_LOST)
+            return
+        try:
+            # agents that advertised the binary framing get the compact
+            # frame, spliced from the segments the consume lane encoded
+            # at match time (encode once, ship the same bytes);
+            # everyone else the legacy JSON body
+            if specwire.WIRE_FORMAT in info.spec_wire:
+                frame = specwire.frame_segments(
+                    [s.wire_segment or specwire.encode_spec_segment(s)
+                     for s in host_specs])
+                self._post(info.url + "/launch", None,
+                           hostname=hostname,
+                           chaos_site="backend.launch",
+                           raw=frame,
+                           content_type=specwire.CONTENT_TYPE)
+            else:
+                self._post(info.url + "/launch",
+                           {"specs": [_spec_wire(s) for s in host_specs]},
+                           hostname=hostname,
+                           chaos_site="backend.launch")
+        except Exception as e:
+            logger.warning("launch to agent %s failed: %s", hostname, e)
+            for s in host_specs:
+                # the POST may have half-landed (e.g. timed out after
+                # delivery): best-effort kill so no orphan runs on;
+                # the heartbeat orphan reconciliation is the backstop
+                try:
+                    self._post(info.url + "/kill",
+                               {"task_id": s.task_id},
                                hostname=hostname,
-                               chaos_site="backend.launch",
-                               raw=specwire.encode_specs(wire),
-                               content_type=specwire.CONTENT_TYPE)
-                else:
-                    self._post(info.url + "/launch", {"specs": wire},
-                               hostname=hostname,
-                               chaos_site="backend.launch")
-            except Exception as e:
-                logger.warning("launch to agent %s failed: %s", hostname, e)
-                for s in host_specs:
-                    # the POST may have half-landed (e.g. timed out after
-                    # delivery): best-effort kill so no orphan runs on;
-                    # the heartbeat orphan reconciliation is the backstop
-                    try:
-                        self._post(info.url + "/kill",
-                                   {"task_id": s.task_id},
-                                   hostname=hostname,
-                                   chaos_site="backend.kill")
-                    except Exception:
-                        pass
-                    self._forget(s.task_id)
-                    self.emit_status(s.task_id, InstanceStatus.FAILED,
-                                     REASON_LAUNCH_FAILED)
+                               chaos_site="backend.kill")
+                except Exception:
+                    pass
+                self._forget(s.task_id)
+                self.emit_status(s.task_id, InstanceStatus.FAILED,
+                                 REASON_LAUNCH_FAILED)
 
     def kill_task(self, task_id: str) -> None:
         with self._lock:
@@ -524,6 +630,12 @@ class AgentCluster(ComputeCluster):
         clusters that have one)."""
         self.check_agents()
 
+    def shutdown(self) -> None:
+        with self._lock:
+            ex, self._fanout = self._fanout, None
+        if ex is not None:
+            ex.shutdown(wait=True)
+
     # ------------------------------------------------------------------
     def _fail_lost(self, task_id: str, why: str) -> None:
         logger.warning("task %s lost: %s", task_id, why)
@@ -532,7 +644,7 @@ class AgentCluster(ComputeCluster):
 
     def _forget(self, task_id: str) -> None:
         with self._lock:
-            self._specs.pop(task_id, None)
+            self._untrack_locked(task_id)
             self._missing.pop(task_id, None)
         if self.heartbeats is not None:
             self.heartbeats.untrack(task_id)

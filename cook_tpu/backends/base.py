@@ -68,6 +68,11 @@ class LaunchSpec:
     # agents parent their launch/run spans into it and echo it back on
     # status posts.  Empty = untraced.
     traceparent: str = ""
+    # pre-encoded CKS1 wire segment (backends/specwire.py), attached by
+    # the consume lane so the agent POST splices the bytes encoded once
+    # at match time instead of re-encoding per host. Empty = encode on
+    # demand; excluded from equality (it is a cache, not identity).
+    wire_segment: bytes = field(default=b"", compare=False, repr=False)
 
 
 StatusCallback = Callable[..., None]

@@ -46,36 +46,69 @@ def _pack_str(out: bytearray, s: str) -> None:
     out += b
 
 
+def _pack_spec(out: bytearray, task_id, job_uuid, hostname, command,
+               mem, cpus, gpus, env, container, progress_regex,
+               progress_output_file, ports, uris, traceparent) -> None:
+    """One spec's wire segment, appended to ``out`` (shared by the
+    dict and dataclass encoders so the byte shape cannot drift)."""
+    _pack_str(out, task_id)
+    _pack_str(out, job_uuid)
+    _pack_str(out, hostname)
+    _pack_str(out, command)
+    out += _F64x3.pack(float(mem), float(cpus), float(gpus))
+    env = env or {}
+    out += _U32.pack(len(env))
+    for k, v in env.items():
+        _pack_str(out, str(k))
+        _pack_str(out, str(v))
+    _pack_str(out, "" if container is None
+              else json.dumps(container, separators=(",", ":")))
+    _pack_str(out, progress_regex or "")
+    _pack_str(out, progress_output_file or "")
+    ports = ports or []
+    out += _U32.pack(len(ports))
+    for p in ports:
+        out += _U32.pack(int(p))
+    _pack_str(out, json.dumps(list(uris or []), separators=(",", ":")))
+    _pack_str(out, traceparent or "")
+
+
 def encode_specs(specs: list[dict]) -> bytes:
     """Frame a list of ``_spec_wire`` dicts (the JSON body's "specs")."""
     out = bytearray(MAGIC)
     out += _U32.pack(len(specs))
     for d in specs:
-        _pack_str(out, d.get("task_id", ""))
-        _pack_str(out, d.get("job_uuid", ""))
-        _pack_str(out, d.get("hostname", ""))
-        _pack_str(out, d.get("command", ""))
-        out += _F64x3.pack(float(d.get("mem", 0.0)),
-                           float(d.get("cpus", 0.0)),
-                           float(d.get("gpus", 0.0)))
-        env = d.get("env") or {}
-        out += _U32.pack(len(env))
-        for k, v in env.items():
-            _pack_str(out, str(k))
-            _pack_str(out, str(v))
-        container = d.get("container")
-        _pack_str(out, "" if container is None
-                  else json.dumps(container, separators=(",", ":")))
-        _pack_str(out, d.get("progress_regex", ""))
-        _pack_str(out, d.get("progress_output_file", ""))
-        ports = d.get("ports") or []
-        out += _U32.pack(len(ports))
-        for p in ports:
-            out += _U32.pack(int(p))
-        _pack_str(out, json.dumps(list(d.get("uris") or []),
-                                  separators=(",", ":")))
-        _pack_str(out, d.get("traceparent", ""))
+        _pack_spec(out, d.get("task_id", ""), d.get("job_uuid", ""),
+                   d.get("hostname", ""), d.get("command", ""),
+                   d.get("mem", 0.0), d.get("cpus", 0.0),
+                   d.get("gpus", 0.0), d.get("env"),
+                   d.get("container"), d.get("progress_regex", ""),
+                   d.get("progress_output_file", ""), d.get("ports"),
+                   d.get("uris"), d.get("traceparent", ""))
     return bytes(out)
+
+
+def encode_spec_segment(spec) -> bytes:
+    """One ``LaunchSpec``'s wire segment, encoded directly off the
+    dataclass — no ``_spec_wire`` dict in between. The consume lane
+    encodes each matched task ONCE (before the launch transaction) and
+    the same buffer is spliced into every frame that ships it
+    (:func:`frame_segments`), which is the zero-copy half of the
+    launch-pipeline optimization: the old path paid a dict build plus
+    a full JSON (or frame) encode per spec per POST."""
+    out = bytearray()
+    _pack_spec(out, spec.task_id, spec.job_uuid, spec.hostname,
+               spec.command, spec.mem, spec.cpus, spec.gpus, spec.env,
+               spec.container, spec.progress_regex,
+               spec.progress_output_file, spec.ports, spec.uris,
+               spec.traceparent)
+    return bytes(out)
+
+
+def frame_segments(segments: list[bytes]) -> bytes:
+    """Assemble a CKS1 frame from pre-encoded per-spec segments
+    (byte-identical to ``encode_specs`` over the same specs)."""
+    return b"".join((MAGIC, _U32.pack(len(segments)), *segments))
 
 
 class _Cursor:

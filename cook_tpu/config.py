@@ -138,6 +138,11 @@ class SchedulerSettings:
     # on one worker (per-pool ordering preserved); multiple pools
     # drain concurrently. 1 = the old single shared consumer thread.
     consume_workers: int = 4
+    # parallel agent fan-out (backends/agent.py): a launch batch that
+    # spans K hosts ships as K concurrent POSTs on a bounded executor
+    # instead of a serial per-host loop; per-host ordering holds (one
+    # POST per host per batch). 1 = the old serial loop.
+    launch_fanout_workers: int = 8
     # per-job decision provenance: read back the device cycle's
     # reason-code tensor and record it in the DecisionBook that backs
     # GET /unscheduled and /debug/decisions. The codes are computed on
@@ -153,6 +158,9 @@ class SchedulerSettings:
             raise ConfigError("launch_ack_timeout_s must be > 0")
         if self.consume_workers < 1:
             raise ConfigError("consume_workers must be >= 1")
+        if self.launch_fanout_workers < 1:
+            raise ConfigError("launch_fanout_workers must be >= 1 "
+                              "(1 = serial per-host launch)")
         if not 0 < self.scaleback <= 1:
             raise ConfigError("scaleback must be in (0, 1]")
         if self.rebalancer_candidate_cap < 0:
@@ -275,6 +283,11 @@ class Settings:
     ingest_workers: int = 2
     ingest_queue_depth: int = 512
     ingest_max_batch: int = 512
+    # cross-lane launch group-commit (JobStore group_commit): every
+    # lane's launch txn joins a shared fsync barrier, so N concurrent
+    # consume lanes pay ~1 fsync per drain instead of N. Durability is
+    # unchanged — the launch ack still waits for ITS round's fsync.
+    launch_group_commit: bool = True
 
     @classmethod
     def from_dict(cls, raw: dict) -> "Settings":

@@ -254,6 +254,7 @@ def build_scheduler(config, read_only=False):
                              log_path=config.log_path,
                              trim_tail=not ha and not read_only,
                              open_writer=not read_only)
+    store.group_commit = bool(config.launch_group_commit)
     pools = PoolRegistry(config.default_pool)
     for p in config.pools:
         pools.add(Pool(name=p.name, purpose=p.purpose,
@@ -299,7 +300,8 @@ def build_scheduler(config, read_only=False):
                 heartbeat_timeout_s=c.agent_heartbeat_timeout_s,
                 progress_aggregator=progress, heartbeats=heartbeats,
                 agent_token=config.auth.agent_token,
-                task_lookup=_resolve_task))
+                task_lookup=_resolve_task,
+                fanout_workers=config.scheduler.launch_fanout_workers))
         else:
             hosts = [MockHost(hostname=f"{c.name}-host-{i}",
                               mem=c.host_mem, cpus=c.host_cpus,

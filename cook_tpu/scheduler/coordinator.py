@@ -41,8 +41,9 @@ from cook_tpu.scheduler.tensorize import (
     tensorize_tasks)
 from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
 from cook_tpu.backends.kube import checkpoint as cp
+from cook_tpu.backends import specwire
 from cook_tpu.state.model import (REASON_BY_CODE, InstanceStatus, Job,
-                                  JobState, now_ms)
+                                  JobState, new_uuid, now_ms)
 from cook_tpu.chaos import procfault
 from cook_tpu.parallel import federation
 from cook_tpu.state.pools import DruMode, PoolRegistry
@@ -555,10 +556,51 @@ class Coordinator:
                 max(1, self.config.consume_workers),
                 self._consume_one, name="resident-consumer")
 
+    # store event kinds whose payload names the owning job directly
+    # ("obj" = the Job), so delivery can be routed to one pool's mirror
+    _ROUTED_KINDS = frozenset(("job", "commit", "retry", "inst",
+                               "status", "kill"))
+
     def _resident_listener(self, kind: str, data: dict) -> None:
         # snapshot: enable_resident pops/re-inserts entries from the
         # cycle thread while store threads deliver events here
-        for rp in list(self._resident.values()):
+        pools = dict(self._resident)
+        if len(pools) > 1 and self.plugins is None:
+            # Pool-sharded delivery: this runs under the store lock
+            # (store._emit), so with N resident pools the broadcast
+            # makes every launch txn pay N enqueues + N drain-side
+            # pool-filter passes over the same items. A job's store
+            # pool never changes (pool migration deletes + resubmits),
+            # so single-job events route straight to the owning mirror
+            # and batch events split by job.pool. Adjuster plugins can
+            # VIRTUALLY re-pool a job at sync time (_adjusted), in
+            # which case the owning mirror is not knowable here — any
+            # configured plugins keep the broadcast path.
+            if kind in self._ROUTED_KINDS:
+                rp = pools.get(data["obj"].pool)
+                if rp is not None:
+                    rp.on_event(kind, data)
+                return
+            if kind in ("insts", "statuses"):
+                items = data["items"]
+                first = items[0][0].pool if items else None
+                if all(it[0].pool == first for it in items):
+                    # common shape: one lane's batch is one pool
+                    rp = pools.get(first)
+                    if rp is not None:
+                        rp.on_event(kind, data)
+                    return
+                by_pool: dict = {}
+                for it in items:
+                    by_pool.setdefault(it[0].pool, []).append(it)
+                for pl, sub in by_pool.items():
+                    rp = pools.get(pl)
+                    if rp is not None:
+                        rp.on_event(kind, dict(data, items=sub))
+                return
+            # "gc" (uuid only, job already deleted) and any future
+            # kind without an attributable pool: broadcast
+        for rp in pools.values():
             rp.on_event(kind, data)
 
     def _mark_dirty_all(self, uuid: str) -> None:
@@ -911,8 +953,8 @@ class Coordinator:
                 (out.why_idx, out.why_code, out.why_amt))
         t_rb1 = time.perf_counter()
         self.metrics[f"match.{pool}.readback_ms"] = (t_rb1 - t_rb0) * 1e3
-        items = []        # (uuid, hostname, cluster_name)
-        item_jobs = []    # (job, ports, credit_snapshot)
+        items = []        # (uuid, hostname, cluster_name, task_id)
+        item_jobs = []    # (job, ports, credit_snapshot, spec, trace)
         # per-cycle launch plugins run against the compact batch, the
         # resident form of the reference's considerable filtering
         # (plugins/launch.clj:59-121); skipped entirely for the default
@@ -990,6 +1032,13 @@ class Coordinator:
         rl = self.user_launch_rl
         rl_on = rl.enforce
         deferrals = []    # (uuid, until) — applied under the lock below
+        # cluster name -> does the backend want CKS1 segments encoded
+        # here (AgentCluster)? Specs and their wire bytes are built in
+        # THIS loop, before the launch transaction: task ids are
+        # pre-generated so the txn's locked section appends ids it was
+        # handed instead of encoding specs, and the agent POST splices
+        # the segment encoded once here (zero double-encode)
+        eager_wire: dict = {}
         for uuid, h, job, credit in candidates:
             if plug is not None:
                 job = plug.adjuster.adjust_job(job)
@@ -1033,8 +1082,38 @@ class Coordinator:
                                 "launching %s without assigned "
                                 "ports", cluster.name, uuid)
                     ports = []
-            items.append((uuid, hostname, offer_cluster[hostname]))
-            item_jobs.append((job, ports, credit))
+            cname = offer_cluster[hostname]
+            task_id = new_uuid()
+            env = dict(job.env)
+            for k, p in enumerate(ports):
+                env[f"PORT{k}"] = str(p)
+            tr = None
+            tp_launch = ""
+            if job.traceparent and obs.tracer.enabled:
+                ctx = obs.parse_traceparent(job.traceparent)
+                if ctx is not None:
+                    launch_sid = obs.new_span_id()
+                    tp_launch = obs.make_traceparent(ctx[0], launch_sid)
+                    tr = (ctx[0], ctx[1], launch_sid)
+            spec = LaunchSpec(
+                task_id=task_id, job_uuid=uuid,
+                hostname=hostname, command=job.command,
+                mem=job.mem, cpus=job.cpus, gpus=job.gpus,
+                env=env, container=job.container,
+                progress_regex=job.progress_regex_string,
+                progress_output_file=job.progress_output_file,
+                checkpoint=job.checkpoint,
+                prior_failure_reasons=_failure_reason_names(job),
+                ports=ports, uris=job.uris,
+                traceparent=tp_launch)
+            w = eager_wire.get(cname)
+            if w is None:
+                w = eager_wire[cname] = bool(getattr(
+                    self.clusters.get(cname), "spec_wire_eager", False))
+            if w:
+                spec.wire_segment = specwire.encode_spec_segment(spec)
+            items.append((uuid, hostname, cname, task_id))
+            item_jobs.append((job, ports, credit, spec, tr))
         if deferrals:
             with rp.mirror_lock:
                 for uuid, until in deferrals:
@@ -1046,7 +1125,7 @@ class Coordinator:
         # on the durable "insts" log record AND appears (same id) as
         # the launch_txn child in every launched traced job's tree
         txn_sid = obs.new_span_id() if obs.tracer.enabled and any(
-            job.traceparent for job, _p, _c in item_jobs) else ""
+            j.traceparent for j, _p, _c, _s, _t in item_jobs) else ""
         insts = self.store.create_instances_bulk(
             items, origin=("resident", pool, out.cycle_no),
             span_id=txn_sid) if items else []
@@ -1058,12 +1137,14 @@ class Coordinator:
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
         traced = []   # (trace_id, root_sid, launch_sid, task_id)
-        for (uuid, hostname, cname), (job, ports, credit), inst in zip(
+        for (uuid, hostname, cname, _tid), \
+                (job, ports, credit, spec, tr), inst in zip(
                 items, item_jobs, insts):
             if inst is None:
                 # killed/launched since matching: restore the capacity
                 # the device already depleted (the mirror snapshot taken
-                # under the lock, so a concurrent re-fill can't skew it)
+                # under the lock, so a concurrent re-fill can't skew
+                # it); the pre-built spec is simply dropped
                 rp.queue_credit(*credit, as_of=out.cycle_no)
                 rp.mark_job_dirty(uuid)
                 if ports:
@@ -1073,28 +1154,9 @@ class Coordinator:
                         rel(hostname, ports)
                 continue
             inst.ports = ports
-            env = dict(job.env)
-            for k, p in enumerate(ports):
-                env[f"PORT{k}"] = str(p)
-            tp_launch = ""
-            if job.traceparent and obs.tracer.enabled:
-                ctx = obs.parse_traceparent(job.traceparent)
-                if ctx is not None:
-                    launch_sid = obs.new_span_id()
-                    tp_launch = obs.make_traceparent(ctx[0], launch_sid)
-                    traced.append((ctx[0], ctx[1], launch_sid,
-                                   inst.task_id))
-            by_cluster.setdefault(cname, []).append(
-                LaunchSpec(task_id=inst.task_id, job_uuid=uuid,
-                           hostname=hostname, command=job.command,
-                           mem=job.mem, cpus=job.cpus, gpus=job.gpus,
-                           env=env, container=job.container,
-                           progress_regex=job.progress_regex_string,
-                           progress_output_file=job.progress_output_file,
-                           checkpoint=job.checkpoint,
-                           prior_failure_reasons=_failure_reason_names(job),
-                           ports=ports, uris=job.uris,
-                           traceparent=tp_launch))
+            if tr is not None:
+                traced.append((tr[0], tr[1], tr[2], inst.task_id))
+            by_cluster.setdefault(cname, []).append(spec)
             launched += 1
             if inst.start_time_ms and job.submit_time_ms:
                 metrics_registry.histogram(
@@ -1115,7 +1177,7 @@ class Coordinator:
             # transaction and the put above was enqueued BEFORE the
             # launch — re-kill anything already terminal so the queued
             # launch can't resurrect it as a zombie
-            for (uuid, hostname, cname), _ij, inst in zip(
+            for (uuid, hostname, cname, _tid), _ij, inst in zip(
                     items, item_jobs, insts):
                 if inst is None:
                     continue

@@ -61,6 +61,41 @@ _ENC = json.JSONEncoder(separators=(",", ":")).encode
 # dict lookup + concat.
 _STATUS_FRAG = {s: f'","s":"{s.value}","r":' for s in InstanceStatus}
 
+# printable ASCII minus '"' and '\': a string matching this needs no
+# JSON escaping, so the hand-built event lines can splice it verbatim
+_PLAIN_JSON = re.compile(r'^[ !#-\[\]-~]*$').match
+
+
+def _encode_insts_line(t_ms: int, span_id: str, rows, epoch: int) -> str:
+    """Hand-build the "insts" launch event line from (job_uuid,
+    task_id, hostname, backend) rows — the launch-txn half of the
+    fixed-shape fast encoders (see update_instances_bulk). Byte-shape
+    matches the bound-encoder output; any row with a string that would
+    need JSON escaping (hostnames come from agent registration) drops
+    the whole line back to _ENC."""
+    head = f'{{"t":{t_ms},"k":"insts"'
+    if span_id:
+        head += f',"sp":"{span_id}"'
+    tail = (f',"ep":{epoch}' if epoch else "") + "}"
+    if _PLAIN_JSON(span_id):
+        parts = []
+        for j, i, h, b in rows:
+            if not (_PLAIN_JSON(h) and _PLAIN_JSON(b)
+                    and _PLAIN_JSON(j) and _PLAIN_JSON(i)):
+                break
+            parts.append('{"j":"' + j + '","i":"' + i + '","h":"' + h
+                         + '","b":"' + b + '"}')
+        else:
+            return head + ',"items":[' + ",".join(parts) + "]" + tail
+    ev = {"t": t_ms, "k": "insts"}
+    if span_id:
+        ev["sp"] = span_id
+    ev["items"] = [{"j": j, "i": i, "h": h, "b": b}
+                   for j, i, h, b in rows]
+    if epoch:
+        ev["ep"] = epoch
+    return _ENC(ev)
+
 
 _HAVE_SYNC_RANGE = hasattr(os, "sync_file_range")
 
@@ -119,6 +154,93 @@ class SnapshotTicket:
 class NotLeaderError(TransactionError):
     """Write rejected by the leadership fence; the API maps this to 503
     + leader hint so clients fail over transparently."""
+
+
+class _GroupCommitBarrier:
+    """Cross-lane fsync coalescer: leader/follower group commit above a
+    single log writer (the transactor-ack amortization the reference
+    gets for free from Datomic's group commit).
+
+    Every transaction's durability barrier joins a *round*; the first
+    waiter of a round becomes the leader and performs ONE writer.sync()
+    covering every append made before the round started, so N
+    concurrent committers (per-pool consume lanes, ingest workers, the
+    REST pool) pay ~1 fsync per drain instead of one each. A waiter
+    that arrives while a round's sync is already in flight cannot know
+    whether that sync started after its append, so it waits for the
+    NEXT round — never weaker than a direct sync.
+
+    One barrier per writer object (lazily attached by the store):
+    rotation installs a fresh writer and therefore a fresh barrier, so
+    a round can never sync a different writer than the one its waiters
+    appended to. The native writer's el_sync already coalesces on the
+    syncer thread's durable watermark; this barrier extends the same
+    amortization to the pure-Python fallback writer (which otherwise
+    fsyncs once per transaction) and collapses the per-lane sync calls
+    into one.
+
+    Error contract: a failed sync completes its round (waiters must not
+    hang) with the exception recorded; the leader and every follower of
+    that round re-raise it, taking the same still-live-writer verdict
+    path in JobStore._barrier as an un-coalesced failure.
+    """
+
+    __slots__ = ("_cv", "_completed", "_in_flight", "_errs",
+                 "_on_round", "rounds", "waits")
+
+    def __init__(self, on_round: Optional[Callable[[], None]] = None):
+        self._cv = threading.Condition()
+        self._completed = 0        # rounds fully synced
+        self._in_flight = False    # a leader is currently syncing
+        self._errs: dict[int, BaseException] = {}
+        self._on_round = on_round  # metrics hook, called once per round
+        self.rounds = 0            # underlying writer.sync() calls
+        self.waits = 0             # transactions that joined a round
+
+    def sync(self, writer) -> None:
+        cv = self._cv
+        with cv:
+            self.waits += 1
+            # First round whose sync STARTS after this point; its
+            # completion makes this caller's prior appends durable.
+            target = self._completed + (2 if self._in_flight else 1)
+            while self._completed < target:
+                if self._in_flight:
+                    cv.wait()
+                    continue
+                # lead: by construction completed == target - 1 here
+                rnd = self._completed + 1
+                self._in_flight = True
+                cv.release()
+                err: Optional[BaseException] = None
+                try:
+                    writer.sync()
+                except BaseException as e:   # noqa: BLE001 — re-raised
+                    err = e
+                finally:
+                    cv.acquire()
+                    self._completed = rnd
+                    self._in_flight = False
+                    self.rounds += 1
+                    if err is not None:
+                        self._errs[rnd] = err
+                    # errors older than the previous round have no
+                    # live waiters left (every waiter's target is at
+                    # most completed+2 at registration time)
+                    for k in [k for k in self._errs if k < rnd - 1]:
+                        del self._errs[k]
+                    cv.notify_all()
+                if self._on_round is not None:
+                    try:
+                        self._on_round()
+                    except Exception:
+                        pass
+                if err is not None:
+                    raise err
+                return
+            err = self._errs.get(target)
+            if err is not None:
+                raise err
 
 
 @dataclass
@@ -184,6 +306,13 @@ class JobStore:
         self._log = log_writer
         if log_path and log_writer is None:
             self._log = _make_log_writer(log_path)
+        # cross-lane group commit (launch pipeline): when enabled,
+        # _barrier coalesces concurrent committers' sync calls into
+        # leader/follower rounds on a per-writer _GroupCommitBarrier.
+        # Off = one sync per transaction (the pre-coalescing behavior);
+        # wired from Settings.launch_group_commit by the server.
+        self.group_commit: bool = True
+        self._barrier_init_lock = threading.Lock()
         # delta-snapshot bookkeeping: every transaction that mutates a
         # job marks its uuid dirty (through _reindex /
         # update_progress); retirement/GC records a tombstone. A FULL
@@ -406,14 +535,49 @@ class JobStore:
                 elif a.kind:
                     # raised INSIDE the try so the injected fsync
                     # failure takes the same still-live-writer verdict
-                    # path as a real one
+                    # path as a real one — and BEFORE the group
+                    # barrier, so a seeded schedule lands on the same
+                    # transaction it would have hit without coalescing
                     raise OSError("chaos[store.fsync]: injected failure")
-            w.sync()
+            if self.group_commit:
+                self._writer_barrier(w).sync(w)
+            else:
+                w.sync()
         except Exception:
             with self._lock:
                 still_live = w is self._log
             if still_live:
                 raise
+
+    def _writer_barrier(self, w) -> _GroupCommitBarrier:
+        """The writer's group-commit barrier, attached lazily. One
+        barrier per writer OBJECT: rotation/reload install a fresh
+        writer and so a fresh barrier, which keeps a round from ever
+        syncing a different writer than the one its waiters appended
+        to (stragglers on the old segment coalesce among themselves,
+        and the swap already synced the old segment under the lock)."""
+        b = getattr(w, "_gc_barrier", None)
+        if b is None:
+            with self._barrier_init_lock:
+                b = getattr(w, "_gc_barrier", None)
+                if b is None:
+                    b = _GroupCommitBarrier(on_round=self._count_round)
+                    w._gc_barrier = b
+        return b
+
+    @staticmethod
+    def _count_round() -> None:
+        from cook_tpu.obs.metrics import registry as metrics_registry
+        metrics_registry.counter("launch_group_fsyncs_total").inc()
+
+    def group_commit_stats(self) -> dict:
+        """{rounds, waits} of the CURRENT writer's barrier (bench and
+        the CI amortization floor read this; cumulative-across-
+        rotations counts live in launch_group_fsyncs_total)."""
+        b = getattr(self._log, "_gc_barrier", None) if self._log else None
+        if b is None:
+            return {"rounds": 0, "waits": 0}
+        return {"rounds": b.rounds, "waits": b.waits}
 
     def add_listener(self, fn: Callable[[str, dict], None]) -> None:
         """tx-report-queue equivalent: fn(kind, data) after each commit."""
@@ -668,32 +832,48 @@ class JobStore:
             # create_instances_bulk for the recovery contract
             procfault.kill_point("store.launch_txn")
             self._emit("inst", {"obj": job, "inst": inst})
+        # same appended-but-unacked window as the bulk path: the lock
+        # is released, a concurrent lane's round leader may or may not
+        # have synced this line yet (crash-soak schedule F)
+        procfault.kill_point("store.launch_group_commit")
         self._barrier()
         return inst
 
     def create_instances_bulk(self, items, origin=None,
                               span_id: str = "") -> list:
         """Launch transaction for a whole match cycle in ONE store
-        transaction: items is [(job_uuid, hostname, backend), ...];
-        returns a same-length list of Instance | None (None = the
+        transaction: items is [(job_uuid, hostname, backend), ...] or
+        [(job_uuid, hostname, backend, task_id), ...]; returns a
+        same-length list of Instance | None (None = the
         allowed-to-start guard refused that job — it was killed or
         already launched since matching). One log record, one
         durability barrier, one listener emission for the batch — the
         per-cycle writeback cost the reference pays as a single Datomic
         transact of all task txns (launch-matched-tasks!
-        scheduler.clj:762-777)."""
+        scheduler.clj:762-777).
+
+        Caller-supplied task ids (4-tuples) let the consume lane build
+        the LaunchSpec and its CKS1 wire segment BEFORE the
+        transaction, so the locked section stops paying spec encoding
+        and the agent wire reuses the same bytes (zero double-encode).
+        A supplied id that already exists is refused like a failed
+        guard — the pre-encoded spec must never be re-keyed."""
         t_ms = now_ms()
         with self._lock:
             self._check_writable()
             out = []
             created = []
-            log_items = []
-            for job_uuid, hostname, backend in items:
-                if not self.allowed_to_start(job_uuid):
+            log_rows = []
+            for item in items:
+                job_uuid, hostname, backend = item[:3]
+                tid = item[3] if len(item) > 3 and item[3] else None
+                if not self.allowed_to_start(job_uuid) \
+                        or (tid is not None and tid in self.task_to_job):
                     out.append(None)
                     continue
                 job = self.jobs[job_uuid]
-                inst = Instance(task_id=new_uuid(), job_uuid=job_uuid,
+                inst = Instance(task_id=tid or new_uuid(),
+                                job_uuid=job_uuid,
                                 hostname=hostname, backend=backend,
                                 start_time_ms=t_ms)
                 job.instances.append(inst)
@@ -702,21 +882,20 @@ class JobStore:
                 self._reindex(job)
                 out.append(inst)
                 created.append((job, inst))
-                log_items.append({"j": job_uuid, "i": inst.task_id,
-                                  "h": hostname, "b": backend})
-            if log_items:
+                log_rows.append((job_uuid, inst.task_id, hostname,
+                                 backend))
+            if log_rows:
                 # "sp" = the cycle's launch-txn span id: the durable
                 # batch record carries trace context (replay-safe —
-                # _apply_event ignores unknown keys). One bound-encoder
-                # call for the whole batch replaces three json.dumps
-                # per item.
-                ev = {"t": t_ms, "k": "insts"}
-                if span_id:
-                    ev["sp"] = span_id
-                ev["items"] = log_items
-                if self.epoch:
-                    ev["ep"] = self.epoch
-                self._append_raw(_ENC(ev))
+                # _apply_event ignores unknown keys). The line is
+                # hand-built from fixed-shape fragments (same contract
+                # as update_instances_bulk): uuids are hex, but host /
+                # backend names arrive from agent registration, so any
+                # string that could need JSON escaping drops the whole
+                # batch back to the bound encoder.
+                self._append_raw(
+                    _encode_insts_line(t_ms, span_id, log_rows,
+                                       self.epoch))
                 # mid-launch-txn kill point: appended but not yet
                 # fsync'd/acked — on restart these instances replay as
                 # UNKNOWN (or the torn tail drops them) and restart
@@ -725,6 +904,13 @@ class JobStore:
                 procfault.kill_point("store.launch_txn")
             if created:
                 self._emit("insts", {"items": created, "origin": origin})
+        if log_rows:
+            # between the cross-lane append and the shared barrier: a
+            # SIGKILL here leaves the batch appended (possibly synced
+            # by a concurrent lane's round leader) but never acked —
+            # crash-soak schedule F pins zero lost / zero duplicated
+            # instances across restart reconciliation for this window
+            procfault.kill_point("store.launch_group_commit")
         self._barrier()
         return out
 

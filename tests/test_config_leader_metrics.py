@@ -54,10 +54,21 @@ def test_settings_full_roundtrip(tmp_path):
     {"scheduler": {"scaleback": 1.5}},
     {"rate_limits": {"bogus": {}}},
     {"clusters": [{"name": "a"}, {"name": "a"}]},
+    {"scheduler": {"launch_fanout_workers": 0}},
 ])
 def test_settings_validation_errors(bad):
     with pytest.raises(ConfigError):
         Settings.from_dict(bad)
+
+
+def test_launch_pipeline_settings():
+    s = Settings.from_dict({})
+    assert s.scheduler.launch_fanout_workers == 8
+    assert s.launch_group_commit is True
+    s = Settings.from_dict({"launch_group_commit": False,
+                            "scheduler": {"launch_fanout_workers": 1}})
+    assert s.launch_group_commit is False
+    assert s.scheduler.launch_fanout_workers == 1
 
 
 def test_build_scheduler_from_settings():
@@ -68,6 +79,20 @@ def test_build_scheduler_from_settings():
     assert {p.name for p in coord.pools.all()} == {"default", "extra"}
     assert coord.clusters.get("k1") is not None
     assert api.plugins is not None
+
+
+def test_build_scheduler_wires_launch_pipeline():
+    from cook_tpu.rest.server import build_scheduler
+    store, coord, api = build_scheduler({
+        "dev_mode": True,
+        "clusters": [{"kind": "agent", "name": "agents"}],
+        "scheduler": {"launch_fanout_workers": 3}})
+    assert store.group_commit is True
+    assert coord.clusters.get("agents").fanout_workers == 3
+    store2, coord2, _ = build_scheduler({
+        "launch_group_commit": False,
+        "clusters": [{"kind": "mock", "hosts": 1}]})
+    assert store2.group_commit is False
 
 
 def test_build_scheduler_wires_optimizer():

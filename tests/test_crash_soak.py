@@ -22,6 +22,13 @@ point:
                       "jobs" event is appended, BEFORE the group
                       commit's barrier acks anyone — no acked job may
                       be lost, no unacked one double-launched
+  F  store.launch_group_commit
+                      between a launch txn's coalesced append and the
+                      cross-lane shared fsync barrier: the batch may
+                      be on disk (a concurrent lane's round leader
+                      synced it) or torn, but it was never acked — on
+                      restart reconciliation must surface zero lost
+                      and zero duplicated instances
 
 Traffic is a compressed production day: `cook_tpu.sim.generate_trace`
 with diurnal=True produces two workday bursts whose submit times are
@@ -83,6 +90,8 @@ SCHEDULES = {
                     overrides={"log_rotate_lines": 30}),
     "E-ingest-txn": dict(seed=41, max_kills=2,
                          sites={"store.ingest_txn": 0.3}),
+    "F-group-commit": dict(seed=53, max_kills=2,
+                           sites={"store.launch_group_commit": 0.5}),
 }
 
 

@@ -1,0 +1,246 @@
+"""Parallel agent fan-out (AgentCluster.launch_tasks executor path).
+
+A launch batch that spans K hosts ships as K concurrent POSTs on the
+bounded fan-out executor. The contract this tier pins:
+
+  - per-host ordering: each host receives ONE /launch POST per batch,
+    specs in submit order, on both wire formats (cks1 frame + JSON);
+  - at-most-once: across all hosts and all outcomes, no task_id is
+    delivered twice;
+  - fold-back: launch_tasks returns only after every host's outcome
+    landed — each spec is either tracked on its agent or already
+    FAILED through the status callback, never in limbo;
+  - partial death: one host's POST dying mid-fan-out fails exactly
+    that host's specs (REASON_LAUNCH_FAILED + best-effort /kill) and
+    leaves the other hosts' launches untouched — identical semantics
+    to the old serial loop (parametrized over fanout_workers 1 vs 8);
+  - incremental used-resource aggregates: pending_offers reflects
+    launches/completions without the O(specs x agents) rescan.
+
+The agent fleet is in-memory: httpjson._send is monkeypatched to an
+in-process dispatcher, so the REAL request-helper stack (circuit
+breaker in AgentCluster._post, chaos injection in raw_request) stays
+on the wire path — the chaos-seeded test injects faults exactly where
+production sees them."""
+import threading
+import urllib.error
+import urllib.parse
+
+import pytest
+
+from cook_tpu import chaos
+from cook_tpu.backends import specwire
+from cook_tpu.backends.agent import (REASON_HOST_LOST,
+                                     REASON_LAUNCH_FAILED, AgentCluster)
+from cook_tpu.backends.base import LaunchSpec
+from cook_tpu.state.model import InstanceStatus, new_uuid
+
+import json
+
+
+class FakeFleet:
+    """In-memory agent fleet addressed as http://<hostname>.fake:1."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.launch_posts: dict[str, list[list[str]]] = {}
+        self.launch_threads: dict[str, list[str]] = {}
+        self.kill_attempts: dict[str, list[str]] = {}
+        self.dead: set[str] = set()
+
+    def send(self, method, url, data, headers, timeout, context=None):
+        parts = urllib.parse.urlsplit(url)
+        hostname = parts.hostname.removesuffix(".fake")
+        endpoint = parts.path.rsplit("/", 1)[-1]
+        ctype = headers.get("Content-Type", "")
+        if endpoint == "kill":
+            tid = json.loads(data)["task_id"]
+            with self.lock:
+                self.kill_attempts.setdefault(hostname, []).append(tid)
+            if hostname in self.dead:
+                raise urllib.error.URLError("connection reset")
+            return {"ok": True}
+        assert endpoint == "launch", endpoint
+        if hostname in self.dead:
+            raise urllib.error.URLError("connection reset")
+        if ctype == specwire.CONTENT_TYPE:
+            specs = specwire.decode_specs(data)
+        else:
+            assert ctype == "application/json"
+            specs = json.loads(data)["specs"]
+        with self.lock:
+            self.launch_posts.setdefault(hostname, []).append(
+                [s["task_id"] for s in specs])
+            self.launch_threads.setdefault(hostname, []).append(
+                threading.current_thread().name)
+        return {"ok": True}
+
+    def delivered(self) -> list[str]:
+        with self.lock:
+            return [tid for posts in self.launch_posts.values()
+                    for post in posts for tid in post]
+
+
+@pytest.fixture
+def fleet(monkeypatch):
+    f = FakeFleet()
+    monkeypatch.setattr("cook_tpu.utils.httpjson._send", f.send)
+    yield f
+    chaos.controller.reset()
+
+
+def mkcluster(fleet, hosts, fanout_workers=8, json_hosts=()):
+    cluster = AgentCluster(heartbeat_timeout_s=60.0,
+                           fanout_workers=fanout_workers)
+    for h in hosts:
+        payload = {"hostname": h, "url": f"http://{h}.fake:1",
+                   "mem": 1000.0, "cpus": 32.0}
+        if h not in json_hosts:
+            payload["spec_wire"] = [specwire.WIRE_FORMAT]
+        cluster.register_agent(payload)
+    statuses = []
+    cluster.set_status_callback(
+        lambda tid, st, reason=None, **kw: statuses.append(
+            (tid, st, reason)))
+    return cluster, statuses
+
+
+def mkspec(hostname, i=0):
+    return LaunchSpec(task_id=new_uuid(), job_uuid=new_uuid(),
+                      hostname=hostname, command=f"echo {i}",
+                      mem=10.0, cpus=1.0)
+
+
+def interleaved(hosts, per_host):
+    """Specs round-robined across hosts (the consume lane's shape:
+    one cycle's matches are host-interleaved, not host-grouped)."""
+    specs = [[mkspec(h, i) for i in range(per_host)] for h in hosts]
+    return [specs[j][i] for i in range(per_host)
+            for j in range(len(hosts))]
+
+
+def test_fanout_one_post_per_host_in_submit_order(fleet):
+    hosts = [f"h{i}" for i in range(6)]
+    # half the fleet never advertised cks1: fan-out must keep both
+    # wire formats working side by side in one batch
+    cluster, statuses = mkcluster(fleet, hosts,
+                                  json_hosts={"h3", "h4", "h5"})
+    specs = interleaved(hosts, per_host=5)
+    cluster.launch_tasks("default", specs)
+
+    for h in hosts:
+        want = [s.task_id for s in specs if s.hostname == h]
+        assert fleet.launch_posts[h] == [want], \
+            f"{h}: not one in-order POST"
+    delivered = fleet.delivered()
+    assert len(delivered) == len(set(delivered)) == len(specs)
+    assert cluster.known_task_ids() == {s.task_id for s in specs}
+    assert statuses == []
+    # distinct hosts really ran on the fan-out executor
+    assert any(t.startswith("agent-fanout")
+               for ts in fleet.launch_threads.values() for t in ts)
+    cluster.shutdown()
+
+
+@pytest.mark.parametrize("workers", [1, 8])
+def test_partial_host_death_fails_only_that_host(fleet, workers):
+    hosts = ["h0", "h1", "h2", "h3"]
+    cluster, statuses = mkcluster(fleet, hosts, fanout_workers=workers)
+    fleet.dead.add("h2")
+    # and one spec matched onto a host that dropped off the map
+    # entirely between match and launch (registered? never was)
+    specs = interleaved(hosts, per_host=3) + [mkspec("ghost")]
+    cluster.launch_tasks("default", specs)   # must not raise
+
+    by_reason = {}
+    for tid, st, reason in statuses:
+        assert st == InstanceStatus.FAILED
+        by_reason.setdefault(reason, set()).add(tid)
+    h2 = {s.task_id for s in specs if s.hostname == "h2"}
+    assert by_reason.get(REASON_LAUNCH_FAILED) == h2
+    assert by_reason.get(REASON_HOST_LOST) == \
+        {specs[-1].task_id}
+    # best-effort kill attempted for the dead POST's specs — best
+    # effort means the circuit breaker may open mid-sweep (launch
+    # failure + first kills trip it) and suppress the tail, so the
+    # attempts are a non-empty subset, never a superset, of h2's;
+    # ghost got no POST at all (nowhere to send one)
+    attempted = set(fleet.kill_attempts.get("h2", []))
+    assert attempted and attempted <= h2
+    assert "ghost" not in fleet.launch_posts
+    # survivors: launched in order, tracked, full at-most-once
+    survivors = {s.task_id for s in specs
+                 if s.hostname not in ("h2", "ghost")}
+    assert cluster.known_task_ids() == survivors
+    delivered = fleet.delivered()
+    assert len(delivered) == len(set(delivered))
+    # the dead host's capacity is not leaked: _forget untracked its
+    # specs (its offer is withheld anyway while the breaker is open),
+    # and survivors show exactly their tracked usage
+    assert "h2" not in cluster._used
+    offers = {o.hostname: o for o in cluster.pending_offers("default")}
+    assert "h2" not in offers          # breaker OPEN: black-holed
+    assert offers["h0"].mem == 970.0 and offers["h0"].cpus == 29.0
+    cluster.shutdown()
+
+
+def test_chaos_seeded_fanout_invariants(fleet):
+    """Seeded transport faults on the launch POST across many batches:
+    every spec must end tracked XOR failed (no limbo), no task is ever
+    delivered twice, and every launch-failed task got a best-effort
+    kill. This is the fan-out version of the chaos-soak transport
+    tier — same site name production arms ("backend.launch")."""
+    hosts = [f"h{i}" for i in range(6)]
+    cluster, statuses = mkcluster(fleet, hosts,
+                                  json_hosts={"h5"})
+    chaos.controller.configure(seed=7, sites={
+        "backend.launch": {"error": 0.35, "error_status": 503}})
+    all_specs = []
+    for _ in range(10):
+        batch = interleaved(hosts, per_host=3)
+        all_specs.extend(batch)
+        cluster.launch_tasks("default", batch)
+
+    failed = {tid for tid, st, reason in statuses
+              if reason == REASON_LAUNCH_FAILED}
+    assert failed, "chaos never bit — the schedule is dead"
+    tracked = cluster.known_task_ids()
+    assert tracked.isdisjoint(failed)
+    assert tracked | failed == {s.task_id for s in all_specs}
+    delivered = fleet.delivered()
+    assert len(delivered) == len(set(delivered)), "double delivery"
+    kills = {tid for tids in fleet.kill_attempts.values()
+             for tid in tids}
+    # kills are best-effort (an open breaker suppresses them), but
+    # only launch-failed tasks may ever be swept
+    assert kills and kills <= failed
+    # per-host ordering held through the chaos: each host's delivered
+    # ids are a subsequence of its submit order
+    for h in hosts:
+        sub = [s.task_id for s in all_specs if s.hostname == h]
+        got = [tid for post in fleet.launch_posts.get(h, [])
+               for tid in post]
+        it = iter(sub)
+        assert all(tid in it for tid in got), f"{h}: order broken"
+    cluster.shutdown()
+
+
+def test_used_aggregates_track_launch_and_completion(fleet):
+    hosts = ["h0", "h1"]
+    cluster, statuses = mkcluster(fleet, hosts)
+    specs = interleaved(hosts, per_host=4)
+    cluster.launch_tasks("default", specs)
+    offers = {o.hostname: o for o in cluster.pending_offers("default")}
+    assert offers["h0"].mem == 1000.0 - 4 * 10.0
+    assert offers["h0"].cpus == 32.0 - 4 * 1.0
+    # completions release exactly their share, down to a clean zero
+    for s in specs:
+        cluster.status_report({"task_id": s.task_id, "event": "exited",
+                               "exit_code": 0,
+                               "hostname": s.hostname})
+    offers = {o.hostname: o for o in cluster.pending_offers("default")}
+    for h in hosts:
+        assert offers[h].mem == 1000.0 and offers[h].cpus == 32.0
+    # the zero-count row is dropped, not left to accumulate drift
+    assert cluster._used == {}
+    cluster.shutdown()

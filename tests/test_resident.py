@@ -1101,3 +1101,105 @@ def test_sharded_resident_pool_equals_single_device_oracle():
     # tensors really shard: host lanes live across all 8 devices
     assert len(coord_b._resident["default"]
                .state["host"]["mem"].sharding.device_set) == 8
+
+
+def test_resident_listener_shards_by_pool_without_plugins():
+    """With >1 resident pools and no plugins configured, store events
+    route to the owning pool's mirror only — delivery runs under the
+    store lock, so broadcast made every launch txn pay O(pools)
+    enqueues plus drain-side filtering. Unattributable kinds ("gc")
+    still broadcast, and scheduling behavior is unchanged: each pool
+    launches exactly its own jobs."""
+    from cook_tpu.state.pools import Pool, PoolRegistry
+
+    store = JobStore()
+    pools = PoolRegistry("pool0")
+    hosts = []
+    for p in range(2):
+        pools.add(Pool(name=f"pool{p}"))
+        hosts += [MockHost(f"p{p}h{i}", mem=1000, cpus=16,
+                           pool=f"pool{p}") for i in range(2)]
+    cluster = MockCluster(hosts, runtime_fn=lambda s: (5.0, True, None))
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, pools=pools)
+    coord.enable_resident("pool0")
+    coord.enable_resident("pool1")
+
+    seen = {"pool0": [], "pool1": []}
+    for pname, rp in coord._resident.items():
+        orig = rp.on_event
+
+        def rec(kind, data, _p=pname, _orig=orig):
+            seen[_p].append(kind)
+            _orig(kind, data)
+
+        rp.on_event = rec
+
+    a = mkjob(user="alice", pool="pool0")
+    b = mkjob(user="bob", pool="pool1")
+    store.create_jobs([a, b])
+    assert seen["pool0"].count("job") == 1
+    assert seen["pool1"].count("job") == 1
+
+    assert coord.match_cycle("pool0").matched == 1
+    assert coord.match_cycle("pool1").matched == 1
+    assert a.instances[0].hostname.startswith("p0")
+    assert b.instances[0].hostname.startswith("p1")
+    # the launch batches ("insts") went only to their owner
+    assert seen["pool0"].count("insts") == 1
+    assert seen["pool1"].count("insts") == 1
+
+    # a kind with no attributable pool broadcasts to every mirror
+    ghost = mkjob()
+    store.create_jobs([ghost], committed=False)
+    store.gc_uncommitted(older_than_ms=-1)
+    assert seen["pool0"].count("gc") == 1
+    assert seen["pool1"].count("gc") == 1
+
+    # completions still land (sharded "status"/"statuses" delivery)
+    assert cluster.advance(10.0) == 2
+    assert a.state == JobState.COMPLETED and a.success
+    assert b.state == JobState.COMPLETED and b.success
+
+
+def test_resident_listener_broadcasts_with_plugins():
+    """An adjuster can VIRTUALLY migrate a job between pools at sync
+    time (_adjusted), so the owning mirror is unknowable at emit time:
+    any configured plugins must disable sharded delivery and keep the
+    broadcast path."""
+    from cook_tpu.plugins import JobAdjuster, PluginRegistry
+    from cook_tpu.state.pools import Pool, PoolRegistry
+
+    class Identity(JobAdjuster):
+        def adjust_job(self, job):
+            return job
+
+    store = JobStore()
+    pools = PoolRegistry("pool0")
+    hosts = []
+    for p in range(2):
+        pools.add(Pool(name=f"pool{p}"))
+        hosts += [MockHost(f"p{p}h{i}", mem=1000, cpus=16,
+                           pool=f"pool{p}") for i in range(2)]
+    cluster = MockCluster(hosts)
+    reg = ClusterRegistry()
+    reg.register(cluster)
+    coord = Coordinator(store, reg, pools=pools)
+    coord.plugins = PluginRegistry(adjuster=Identity())
+    coord.enable_resident("pool0")
+    coord.enable_resident("pool1")
+
+    seen = {"pool0": [], "pool1": []}
+    for pname, rp in coord._resident.items():
+        orig = rp.on_event
+
+        def rec(kind, data, _p=pname, _orig=orig):
+            seen[_p].append(kind)
+            _orig(kind, data)
+
+        rp.on_event = rec
+
+    store.create_jobs([mkjob(pool="pool0")])
+    assert seen["pool0"].count("job") == 1
+    assert seen["pool1"].count("job") == 1

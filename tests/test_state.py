@@ -1038,3 +1038,203 @@ def test_replay_kill_backfill_only_on_transition(tmp_path):
     kill_ev = dict(kill_ev, t=(kill_ev.get("t") or end0) + 5000)
     s._apply_event(kill_ev)
     assert job.end_time_ms == end0
+
+
+# -- launch group-commit (cross-lane fsync coalescing) -----------------
+def test_group_commit_barrier_coalesces_concurrent_waiters():
+    """Waiters that overlap one in-flight fsync share the NEXT round:
+    total rounds stays well under one per waiter, and every waiter
+    returns only after a round that covers its append."""
+    import threading
+    import time as _time
+
+    from cook_tpu.state.store import _GroupCommitBarrier
+
+    class SlowWriter:
+        def __init__(self):
+            self.syncs = 0
+
+        def sync(self):
+            self.syncs += 1
+            _time.sleep(0.005)
+
+    b = _GroupCommitBarrier()
+    w = SlowWriter()
+    n = 20
+    start = threading.Barrier(n)
+
+    def waiter():
+        start.wait()
+        b.sync(w)
+
+    threads = [threading.Thread(target=waiter) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert b.waits == n
+    assert b.rounds == w.syncs
+    # all 20 released together: a leader's 5 ms round covers everyone
+    # who queued behind it, so round count collapses far below n
+    assert b.rounds <= n // 2, f"no coalescing: {b.rounds} rounds"
+
+
+def test_group_commit_barrier_propagates_round_errors():
+    """A failed fsync round must surface to every waiter it covered —
+    an acked launch whose round failed would be a durability lie —
+    and the barrier must keep working for later rounds."""
+    import threading
+
+    from cook_tpu.state.store import _GroupCommitBarrier
+
+    class GatedFailingWriter:
+        def __init__(self):
+            self.gate = threading.Event()
+            self.syncs = 0
+
+        def sync(self):
+            self.gate.wait(5)
+            self.syncs += 1
+            raise OSError("disk gone")
+
+    b = _GroupCommitBarrier()
+    w = GatedFailingWriter()
+    errors = []
+
+    def waiter():
+        try:
+            b.sync(w)
+        except OSError as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=waiter) for _ in range(2)]
+    for t in threads:
+        t.start()
+    # both registered before any round completes, then open the gate
+    deadline = __import__("time").time() + 5
+    while b.waits < 2 and __import__("time").time() < deadline:
+        pass
+    w.gate.set()
+    for t in threads:
+        t.join()
+    # every waiter whose round failed raised; nobody hung. (Whether
+    # the second waiter shared the failed round or led its own failed
+    # round depends on arrival timing — both raise either way.)
+    assert len(errors) == 2
+    assert all("disk gone" in str(e) for e in errors)
+
+    class GoodWriter:
+        def sync(self):
+            pass
+
+    b.sync(GoodWriter())      # a later round is clean again
+
+
+def test_group_commit_concurrent_lanes_durable_and_replayable(tmp_path):
+    """N concurrent consume lanes push bulk launch txns through one
+    durable store: fsync rounds coalesce across lanes (rounds << txns),
+    and a cold replay reconstructs the exact same state — group commit
+    changes WHEN the fsync happens, never what is durable at ack."""
+    import threading
+
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    lanes, txns, batch = 8, 6, 4
+    lane_jobs = []
+    for ln in range(lanes):
+        jobs = [mkjob(user=f"u{ln}") for _ in range(txns * batch)]
+        s.create_jobs(jobs)
+        lane_jobs.append(jobs)
+    start = threading.Barrier(lanes)
+
+    def lane(ln):
+        start.wait()
+        jobs = lane_jobs[ln]
+        for i in range(txns):
+            chunk = jobs[i * batch:(i + 1) * batch]
+            s.create_instances_bulk(
+                [(j.uuid, f"h{ln}", "agents", new_uuid())
+                 for j in chunk])
+
+    threads = [threading.Thread(target=lane, args=(ln,))
+               for ln in range(lanes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = s.group_commit_stats()
+    assert stats["waits"] >= lanes * txns
+    assert stats["rounds"] < stats["waits"], "no cross-lane coalescing"
+    want = s.state_hash()
+    s._log.sync()
+    s._log.close()
+    cold = JobStore.restore(log_path=log, open_writer=False)
+    assert cold.state_hash() == want
+    assert len(cold.task_to_job) == lanes * txns * batch
+
+
+def test_group_commit_disabled_is_equivalent(tmp_path):
+    """group_commit=False falls back to one fsync per txn with
+    byte-identical log semantics (the config escape hatch)."""
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    s.group_commit = False
+    jobs = [mkjob() for _ in range(4)]
+    s.create_jobs(jobs)
+    insts = s.create_instances_bulk(
+        [(j.uuid, "h0", "agents") for j in jobs])
+    assert all(insts)
+    assert s.group_commit_stats() == {"rounds": 0, "waits": 0}
+    want = s.state_hash()
+    s._log.sync()
+    s._log.close()
+    cold = JobStore.restore(log_path=log, open_writer=False)
+    assert cold.state_hash() == want
+
+
+def test_bulk_launch_supplied_task_ids_and_duplicate_refusal(tmp_path):
+    """4-tuple items carry pre-generated task ids (the zero-copy spec
+    path encodes the CKS1 segment against that id BEFORE the txn), so
+    the txn must honor them exactly — and refuse a duplicate id like a
+    failed guard rather than silently re-keying the encoded spec."""
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    jobs = [mkjob() for _ in range(3)]
+    s.create_jobs(jobs)
+    tids = [new_uuid() for _ in jobs]
+    insts = s.create_instances_bulk(
+        [(j.uuid, "h0", "agents", tid) for j, tid in zip(jobs, tids)])
+    assert [i.task_id for i in insts] == tids
+
+    dup = mkjob()
+    s.create_jobs([dup])
+    out = s.create_instances_bulk([(dup.uuid, "h0", "agents", tids[0])])
+    assert out == [None]
+    assert not dup.instances
+
+    s._log.sync()
+    s._log.close()
+    cold = JobStore.restore(log_path=log, open_writer=False)
+    assert sorted(cold.task_to_job) == sorted(tids)
+
+
+def test_bulk_launch_fast_encoder_escapes_hostile_strings(tmp_path):
+    """The hand-built "insts" log line only covers plain-ASCII field
+    values; a hostname that needs JSON escaping (agents self-report
+    their names) must drop the batch to the bound encoder, never
+    produce a corrupt line."""
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log)
+    evil = 'h"0\\x\n'
+    plain, quoted = mkjob(), mkjob()
+    s.create_jobs([plain, quoted])
+    insts = s.create_instances_bulk([
+        (plain.uuid, "h-ok", "agents"),
+        (quoted.uuid, evil, "agents"),
+    ])
+    assert all(insts)
+    s._log.sync()
+    s._log.close()
+    cold = JobStore.restore(log_path=log, open_writer=False)
+    assert cold.get_instance(insts[1].task_id).hostname == evil
+    assert cold.state_hash() == s.state_hash()
