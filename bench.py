@@ -1618,6 +1618,61 @@ def bench_launch(lanes=8, batches=40, batch_size=64):
     }), flush=True)
 
 
+def bench_day_soak():
+    """Full-magnitude compressed production day (the nightly tier of
+    tests/test_day_soak.py): diurnal burst arrivals + transport chaos +
+    coordinator SIGKILLs + agent-fleet churn armed simultaneously, at
+    the parameters the quick CI tier scales down from. Reports the
+    gate evidence as one JSON line; non-zero exit on any gate breach.
+
+    Scaled-down CI counterpart: tests/test_day_soak.py quick tier
+    (jobs=6, agents=3, window 3 s, 1 kill). Nightly magnitude here:
+    jobs=120, agents=6, window 30 s, 3 kills, 2 faults/agent."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from tests.daysoak import run_day_soak
+
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 101
+    tmp = Path(tempfile.mkdtemp(prefix="cook_day_soak_"))
+    try:
+        t0 = time.monotonic()
+        r = run_day_soak(tmp / "store", seed, jobs=120, agents=6,
+                         window_s=30.0, wall_s=600.0, max_kills=3,
+                         events_per_agent=2.0)
+        wall_s = time.monotonic() - t0
+        completed = sum(1 for j in r["jobs"].values()
+                        if j.status == "completed")
+        doubled = {t: n for t, n in r["launch_counts"].items()
+                   if n > 1}
+        ok = (not r["violations"] and not doubled
+              and completed == r["expected_jobs"]
+              and len(r["jobs"]) == r["expected_jobs"])
+        print(json.dumps({
+            "metric": "compressed production-day soak, full magnitude",
+            "value": completed,
+            "unit": f"jobs completed of {r['expected_jobs']}",
+            "ok": ok,
+            "seed": seed,
+            "wall_s": round(wall_s, 1),
+            "violations": r["violations"],
+            "double_launches": doubled,
+            "transport_injected": r["transport_injected"],
+            "server_deaths": r["server_deaths"],
+            "churn_events": len(r["churn_events"]),
+            "submit_p99_ms": r["submit_p99_ms"],
+            "max_rss_mb": r["max_rss_mb"],
+            "overload_level_max": r["overload_level_max"],
+            "kill_ledger": r["kill_ledger"],
+        }), flush=True)
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        if not os.environ.get("CHAOS_ARTIFACTS_DIR"):
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_pallas():
     """Real-TPU A/B of the Pallas kernels vs the XLA lowering (VERDICT
     r2 #2: prove a win or drop it): the batched headline cycle (dense
@@ -1744,6 +1799,11 @@ def main():
         # restore-path economics for the crash-soak CI gate: delta
         # restore must beat log-only replay >=5x on identical state
         bench_crash_soak()
+    elif which == "day-soak":
+        # full-magnitude compressed production day (nightly tier):
+        # burst arrivals + transport chaos + SIGKILLs + fleet churn at
+        # once; optional argv[2] = seed (default 101)
+        bench_day_soak()
     elif which == "launch":
         # launch-pipeline economics: group-commit fsync amortization
         # under concurrent lanes (the e2e-perf-smoke CI floor) + the
@@ -1758,7 +1818,7 @@ def main():
                          "longevity "
                          "longevity-async trace-overhead "
                          "decision-overhead chaos-overhead "
-                         "crash-soak launch pallas")
+                         "crash-soak day-soak launch pallas")
 
 
 if __name__ == "__main__":

@@ -116,6 +116,11 @@ class AgentDaemon:
         self.advertise_host = advertise_host
         self.agent_token = agent_token
         self._stop = threading.Event()
+        # chaos churn "partition": while set, every coordinator-bound
+        # RPC fails as if the network were cut — the process (and its
+        # tasks) keep running, which is exactly the case the liveness
+        # layer must resurrect-and-adopt rather than double-launch
+        self._partitioned = threading.Event()
         self.executor = Executor(
             sandbox_root,
             on_status=self._on_status,
@@ -363,10 +368,20 @@ class AgentDaemon:
             if self._urls[self._url_idx] == url:
                 self._url_idx = (self._url_idx + 1) % len(self._urls)
 
+    def set_partitioned(self, cut: bool) -> None:
+        """Churn-chaos hook (chaos/churn.py PARTITION): sever or heal
+        this daemon's coordinator link without touching its tasks."""
+        if cut:
+            self._partitioned.set()
+        else:
+            self._partitioned.clear()
+
     def _post(self, path: str, payload: dict) -> dict:
         """POST to the current coordinator; on connection failure rotate
         through the candidate list, on a 503 not-leader answer follow
         its leader hint. Raises after one full cycle of candidates."""
+        if self._partitioned.is_set():
+            raise ConnectionError("agent partitioned (chaos churn)")
         headers = {}
         if self.agent_token:
             headers["X-Cook-Agent-Token"] = self.agent_token

@@ -32,6 +32,7 @@ from typing import Optional
 from cook_tpu import obs
 from cook_tpu.backends import specwire
 from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
+from cook_tpu.scheduler.liveness import DEAD, RESURRECTED
 from cook_tpu.state.model import InstanceStatus, now_ms
 from cook_tpu.utils.breaker import (
     BreakerOpenError, CircuitBreaker, CLOSED, OPEN)
@@ -83,7 +84,8 @@ class AgentCluster(ComputeCluster):
                  task_lookup=None,
                  breaker_failures: int = 3,
                  breaker_reset_s: float = 30.0,
-                 fanout_workers: int = 8):
+                 fanout_workers: int = 8,
+                 liveness=None):
         self.name = name
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.request_timeout_s = request_timeout_s
@@ -111,6 +113,13 @@ class AgentCluster(ComputeCluster):
         # kubernetes/compute_cluster.clj:155-190 / reconcile-tasks
         # scheduler.clj:1041-1104)
         self.task_lookup = task_lookup
+        # lease-based agent lifecycle (scheduler/liveness.py): when
+        # present it replaces the raw heartbeat-cutoff death model —
+        # alive -> suspect -> dead with a grace window before tasks are
+        # requeued, and dead -> resurrected with census + adoption
+        # instead of the re-register round trip. None keeps the legacy
+        # single-cutoff behavior.
+        self.liveness = liveness
         self.agents: dict[str, AgentInfo] = {}
         # task -> (spec, host, launched_ms)
         self._specs: dict[str, tuple[LaunchSpec, str, int]] = {}
@@ -153,6 +162,11 @@ class AgentCluster(ComputeCluster):
         reported = set(payload.get("tasks", []))
         grace_cutoff = now_ms() - int(self.lost_task_grace_s * 1000)
         info.outbox_dropped = int(payload.get("outbox_dropped", 0))
+        if self.liveness is not None:
+            # registration IS the census (the payload carries the task
+            # list and this handler reconciles it), so no extra
+            # resurrection round trip is needed here
+            self.liveness.observe(hostname)
         with self._lock:
             prev = self.agents.get(hostname)
             self._account_outbox_dropped(prev, info.outbox_dropped)
@@ -257,6 +271,7 @@ class AgentCluster(ComputeCluster):
         hostname = payload.get("hostname", "")
         reported = set(payload.get("tasks", []))
         grace_cutoff = now_ms() - int(self.lost_task_grace_s * 1000)
+        self._liveness_traffic(hostname)
         lost = []
         with self._lock:
             info = self.agents.get(hostname)
@@ -330,6 +345,7 @@ class AgentCluster(ComputeCluster):
         event = payload.get("event", "")
         exit_code = payload.get("exit_code")
         sandbox = payload.get("sandbox", "")
+        self._liveness_traffic(payload.get("hostname", ""))
         self._record_remote_spans(payload)
         with self._lock:
             entry = self._specs.get(task_id)
@@ -560,7 +576,9 @@ class AgentCluster(ComputeCluster):
             metrics_registry.counter(
                 "agent_outbox_dropped_reported_total").inc(new_count - old)
 
-    def query_agent_tasks(self, timeout_s: Optional[float] = None):
+    def query_agent_tasks(self, timeout_s: Optional[float] = None,
+                          hosts: Optional[set] = None,
+                          include_dead: bool = False):
         """GET every alive agent's /state for its live task_ids — the
         restart-reconciliation census. Returns (tasks_by_host,
         responded, undelivered): a host appears in `responded` only
@@ -573,10 +591,13 @@ class AgentCluster(ComputeCluster):
         in before classifying anything as never-launched. Goes around
         the circuit breakers on purpose: this runs once at boot, when
         breakers carry no history yet, and a wrong OPEN here would
-        mis-classify every task on the host."""
+        mis-classify every task on the host. ``hosts``/``include_dead``
+        narrow the census to specific (possibly not-alive) agents —
+        the resurrection path censuses exactly the returning host."""
         with self._lock:
             targets = [(h, i.url) for h, i in self.agents.items()
-                       if i.alive]
+                       if (i.alive or include_dead)
+                       and (hosts is None or h in hosts)]
         headers = {}
         if self.agent_token:
             headers["X-Cook-Agent-Token"] = self.agent_token
@@ -602,11 +623,97 @@ class AgentCluster(ComputeCluster):
             return {h: {"backend": "agent", **i.attributes}
                     for h, i in self.agents.items() if i.alive}
 
+    # -- agent liveness (lease machine -> offers/grace/resurrection) ---
+    def _liveness_traffic(self, hostname: str) -> None:
+        """Feed agent traffic into the lease machine; a dead host's
+        returning traffic triggers the resurrection census."""
+        if self.liveness is None or not hostname:
+            return
+        if self.liveness.observe(hostname) == (DEAD, RESURRECTED):
+            self._resurrect(hostname)
+
+    def _resurrect(self, hostname: str) -> None:
+        """A dead agent's traffic returned: census it over the existing
+        query_agent_tasks path and ADOPT still-running tasks instead of
+        double-launching (the restart-reconciliation fold, scoped to
+        one host). Tasks the agent no longer reports are requeued
+        host-lost (mea-culpa); tasks it does report that we still track
+        were never failed — nothing relaunches, at-most-once holds."""
+        with self._lock:
+            info = self.agents.get(hostname)
+        if info is None:
+            return  # never registered with this coordinator life; the
+            # heartbeat handler's reregister answer covers it
+        tasks, responded, undelivered = self.query_agent_tasks(
+            hosts={hostname}, include_dead=True)
+        if hostname not in responded:
+            # reachable enough to send traffic but /state failed: stay
+            # in resurrected limbo (not offerable until census lands);
+            # the next traffic retries, the watchdogs keep protecting
+            logger.warning("resurrection census of %s failed", hostname)
+            return
+        # terminal statuses that finished while the agent was dead are
+        # folded FIRST so they can't be requeued as lost
+        for payload in undelivered:
+            try:
+                self.status_report(payload)
+            except Exception:
+                logger.exception("folding undelivered status from %s",
+                                 hostname)
+        reported = tasks.get(hostname, set())
+        with self._lock:
+            known_here = {tid for tid, (_, h, _) in self._specs.items()
+                          if h == hostname}
+        adopted = sum(self._try_adopt(tid, hostname)
+                      for tid in sorted(reported - known_here))
+        folded = {p.get("task_id") for p in undelivered}
+        gone = known_here - reported - folded
+        for tid in sorted(gone):
+            self._fail_lost(tid, "not reported by resurrected agent")
+        with self._lock:
+            info = self.agents.get(hostname)
+            if info is not None:
+                info.last_heartbeat_ms = now_ms()
+                if not info.alive:
+                    info.alive = True
+                    self.bump_offer_generation()
+        metrics_registry.counter("agent_resurrections_total").inc()
+        logger.info("agent %s resurrected: %d adopted, %d requeued, "
+                    "%d undelivered folded", hostname, adopted,
+                    len(gone), len(undelivered))
+
+    def _check_agents_liveness(self) -> list[str]:
+        """Lease-machine replacement for the raw-cutoff watchdog: on
+        dead, withdraw offers but leave tasks in GRACE; only when the
+        grace lapses are they failed mea-culpa (5000) and requeued."""
+        out = self.liveness.tick()
+        dead = []
+        with self._lock:
+            for hostname, _old, new in out["transitions"]:
+                info = self.agents.get(hostname)
+                if info is not None and new == DEAD and info.alive:
+                    info.alive = False
+                    dead.append(hostname)
+            if dead:
+                self.bump_offer_generation()
+            lapsed = set(out["lapsed"])
+            lost = [tid for tid, (_, h, _) in self._specs.items()
+                    if h in lapsed]
+        for hostname in dead:
+            logger.warning("agent %s dead (lease expired); %0.1fs task "
+                           "grace", hostname, self.liveness.grace_s)
+        for tid in lost:
+            self._fail_lost(tid, "agent lease fully lapsed")
+        return dead
+
     # -- agent-lost watchdog (heartbeat timeout -> host lost) ----------
     def check_agents(self, wall_ms: Optional[int] = None) -> list[str]:
         """Fail tasks of agents whose heartbeat lapsed; mark the agent
         dead until it re-registers (slave-removed semantics; reason 5000
-        is mea-culpa so the retry doesn't burn a user attempt)."""
+        is mea-culpa so the retry doesn't burn a user attempt). With a
+        liveness tracker installed, the lease machine decides instead."""
+        if self.liveness is not None:
+            return self._check_agents_liveness()
         wall_ms = wall_ms or now_ms()
         cutoff = wall_ms - int(self.heartbeat_timeout_s * 1000)
         dead = []
@@ -657,6 +764,8 @@ class AgentCluster(ComputeCluster):
                 "alive": a.alive,
                 "last_heartbeat_ms": a.last_heartbeat_ms,
                 "outbox_dropped": a.outbox_dropped,
+                "liveness": self.liveness.state(a.hostname)
+                if self.liveness is not None else None,
                 "breaker": self._breakers[a.hostname].snapshot()
                 if a.hostname in self._breakers
                 else {"state": CLOSED, "consecutive_failures": 0,

@@ -1,0 +1,128 @@
+"""Seeded, deterministic agent-churn schedules: kill / restart / flap /
+partition as first-class chaos, the fleet-level counterpart of the
+per-RPC sites in this package.
+
+The transport sites (agent.heartbeat, agent.status_post, ...) perturb
+individual messages; a production day also loses whole AGENTS — a node
+is drained (kill), a daemon is bounced by its supervisor (restart), a
+box reboots in a crash loop (flap), a rack loses its uplink for a
+minute (partition). This module generates those events as a
+deterministic schedule — a pure function of (seed, fleet, duration),
+using the package's ``random.Random(f"{seed}:{site}")`` idiom — which
+the day-soak harness and ``bench.py day-soak`` execute against live
+AgentDaemon processes/threads:
+
+    kill        stop the daemon and never bring it back (lease fully
+                lapses; tasks requeue mea-culpa)
+    restart     stop the daemon, start a fresh one on the same
+                hostname after ``down_s`` (re-registration reconciles)
+    flap        a short stop/start bounce, inside the suspect window
+                when the fleet is healthy — the liveness hysteresis
+                must NOT declare it dead
+    partition   the daemon keeps running its tasks but its coordinator
+                RPCs fail for ``down_s`` (network cut, process alive);
+                on heal the liveness layer must resurrect + adopt, not
+                double-launch
+
+Like every chaos schedule here the event list is recorded and can be
+written as a JSONL artifact so a red soak ships its exact churn.
+"""
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+
+SITE = "agent.churn"
+
+KILL = "kill"
+RESTART = "restart"
+FLAP = "flap"
+PARTITION = "partition"
+
+ACTIONS = (KILL, RESTART, FLAP, PARTITION)
+
+
+@dataclass(frozen=True)
+class ChurnEvent:
+    """One scheduled fleet fault. ``t_s`` is seconds from soak start;
+    ``down_s`` is how long the agent stays gone/cut (0 for kill —
+    permanent)."""
+    t_s: float
+    action: str
+    hostname: str
+    down_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {"t_s": round(self.t_s, 3), "action": self.action,
+                "hostname": self.hostname,
+                "down_s": round(self.down_s, 3)}
+
+
+@dataclass
+class ChurnSchedule:
+    seed: int
+    duration_s: float
+    events: list = field(default_factory=list)
+
+    def save(self, path: str) -> int:
+        """JSONL artifact (one event per line), the save_events shape."""
+        with open(path, "w") as f:
+            f.write(json.dumps({"seed": self.seed,
+                                "duration_s": self.duration_s,
+                                "site": SITE}) + "\n")
+            for ev in self.events:
+                f.write(json.dumps(ev.as_dict(),
+                                   separators=(",", ":")) + "\n")
+        return len(self.events)
+
+
+def generate_churn(seed: int, hostnames: list, duration_s: float,
+                   events_per_agent: float = 1.0,
+                   kill_fraction: float = 0.15,
+                   restart_down_s: tuple = (2.0, 8.0),
+                   flap_down_s: tuple = (0.2, 1.0),
+                   partition_down_s: tuple = (2.0, 10.0),
+                   weights: dict = None) -> ChurnSchedule:
+    """Deterministic churn for a fleet: ~``events_per_agent`` faults
+    per agent spread uniformly over ``duration_s``, drawn from the
+    (seed, "agent.churn") stream so the N-th event is a pure function
+    of the inputs. ``kill_fraction`` of agents (at most all-but-one —
+    the fleet must not churn itself to zero capacity) get a permanent
+    kill as their LAST event; everything before is survivable churn."""
+    rng = random.Random(f"{seed}:{SITE}")
+    w = {RESTART: 0.4, FLAP: 0.35, PARTITION: 0.25}
+    if weights:
+        w.update(weights)
+    total = sum(w.values())
+    events: list[ChurnEvent] = []
+    n_kill = min(int(len(hostnames) * kill_fraction),
+                 max(0, len(hostnames) - 1))
+    # rng.sample keeps the kill set a function of the seed alone
+    killed = set(rng.sample(sorted(hostnames), n_kill)) if n_kill else set()
+    for hostname in sorted(hostnames):
+        n = max(1, round(events_per_agent)) if events_per_agent else 0
+        last_t = 0.0
+        for _ in range(n):
+            t = rng.uniform(0.05 * duration_s, 0.8 * duration_s)
+            u = rng.uniform(0.0, total)
+            cum = 0.0
+            action = RESTART
+            for a, p in w.items():
+                cum += p
+                if u < cum:
+                    action = a
+                    break
+            lo, hi = {RESTART: restart_down_s, FLAP: flap_down_s,
+                      PARTITION: partition_down_s}[action]
+            events.append(ChurnEvent(t_s=t, action=action,
+                                     hostname=hostname,
+                                     down_s=rng.uniform(lo, hi)))
+            last_t = max(last_t, t)
+        if hostname in killed:
+            events.append(ChurnEvent(
+                t_s=rng.uniform(max(last_t, 0.5 * duration_s),
+                                0.9 * duration_s),
+                action=KILL, hostname=hostname))
+    events.sort(key=lambda e: (e.t_s, e.hostname))
+    return ChurnSchedule(seed=seed, duration_s=duration_s, events=events)

@@ -45,12 +45,25 @@ class ClusterSettings:
     # agent: network agents register themselves; timeout fails their
     # tasks host-lost
     agent_heartbeat_timeout_s: float = 30.0
+    # lease-based liveness machine (scheduler/liveness.py) over the
+    # raw heartbeat cutoff: alive -> suspect -> dead -> resurrected.
+    # liveness_grace_s is the window between an agent going dead
+    # (offers withdrawn) and its tasks being failed mea-culpa —
+    # 0 keeps the legacy fail-immediately-on-dead timing while still
+    # getting suspect/resurrect semantics; liveness_suspect_after_s
+    # 0 = half the heartbeat timeout.
+    liveness_enabled: bool = True
+    liveness_grace_s: float = 0.0
+    liveness_suspect_after_s: float = 0.0
 
     def validate(self) -> None:
         if self.kind not in ("mock", "local", "kube", "agent"):
             raise ConfigError(f"unknown cluster kind {self.kind!r}")
         if self.hosts < 0 or self.host_mem <= 0 or self.host_cpus <= 0:
             raise ConfigError(f"cluster {self.name}: invalid host shape")
+        if self.liveness_grace_s < 0 or self.liveness_suspect_after_s < 0:
+            raise ConfigError(f"cluster {self.name}: liveness windows "
+                              "must be >= 0")
 
 
 @dataclass
@@ -150,6 +163,20 @@ class SchedulerSettings:
     # extra host readback + bookkeeping — disable to shave the last
     # percent off cycle latency on hot clusters.
     decision_provenance: bool = True
+    # per-task executor heartbeat timeout (HeartbeatWatcher): a RUNNING
+    # task whose executor goes silent this long fails 3000 mea-culpa.
+    # Replaces the old hard-coded HEARTBEAT_TIMEOUT_S module constant.
+    heartbeat_timeout_s: float = 15 * 60.0
+    # adaptive overload controller (scheduler/overload.py): watermarks
+    # for the pressure signals and the hysteresis dwell counts of the
+    # shed ladder (docs/robustness.md "Agent liveness & overload
+    # shedding"). overload_enabled=false removes the controller — no
+    # shedding, zero hot-path reads.
+    overload_enabled: bool = True
+    overload_cycle_p99_ms: float = 1000.0
+    overload_launch_txn_p99_ms: float = 500.0
+    overload_escalate_after: int = 3
+    overload_relax_after: int = 10
 
     def validate(self) -> None:
         if self.max_jobs_considered < 1:
@@ -163,6 +190,10 @@ class SchedulerSettings:
                               "(1 = serial per-host launch)")
         if not 0 < self.scaleback <= 1:
             raise ConfigError("scaleback must be in (0, 1]")
+        if self.heartbeat_timeout_s <= 0:
+            raise ConfigError("heartbeat_timeout_s must be > 0")
+        if self.overload_escalate_after < 1 or self.overload_relax_after < 1:
+            raise ConfigError("overload dwell counts must be >= 1")
         if self.rebalancer_candidate_cap < 0:
             raise ConfigError("rebalancer_candidate_cap must be >= 0 "
                               "(0 = exact sweep)")

@@ -190,6 +190,13 @@ class NativeForbiddenBuilder:
         slot, n_hosts, _ = ent
         insts = job.instances
         for inst in insts[n_hosts:]:
+            # same novel-host discipline as the numpy path: a 5003
+            # launch-ack-timeout never fed the host a command, so it
+            # doesn't join the exclusion set (the instance is terminal
+            # by the time the job re-enters the pending feed, so the
+            # reason code is final here)
+            if not inst.counts_for_novel_host:
+                continue
             self._lib.mb_job_prior_host(self._h, slot,
                                         self._strs.id("h:" + inst.hostname))
         ent[1] = len(insts)

@@ -995,6 +995,16 @@ class CookApi:
                               "are not participating in matching.",
                     "code": "backend_degraded",
                     "data": {"agents": broken}})
+            # the overload controller shrinking the consider window is
+            # a first-class reason a waiting job was never looked at
+            ovl = getattr(self.coord, "overload", None)
+            if ovl is not None and ovl.level >= 1:
+                reasons.append({
+                    "reason": "considered window reduced: overload "
+                              "(the scheduler is shedding load; fewer "
+                              "jobs per cycle are being considered).",
+                    "code": "overload_shed",
+                    "data": ovl.snapshot()})
             # clusters whose offer fetch failed recently were skipped
             # whole cycles — the pool ran degraded
             skipped = getattr(self.coord, "skipped_clusters", {}) \
@@ -1229,6 +1239,17 @@ class CookApi:
                     "restart_reconcile": getattr(
                         self.coord, "last_restart_reconcile", {})
                         if self.coord is not None else {}}}
+        ovl = getattr(self.coord, "overload", None)
+        if ovl is not None:
+            # shed-ladder state: level, engaged actions, per-signal
+            # readings and the recent shed/relax event ring
+            body["overload"] = ovl.snapshot()
+        for cluster in (self.coord.clusters.all()
+                        if self.coord is not None else []):
+            tracker = getattr(cluster, "liveness", None)
+            if tracker is not None:
+                clusters[cluster.name]["agent_liveness"] = \
+                    tracker.snapshot()
         from cook_tpu import chaos
         if chaos.controller.enabled:
             # operators must be able to tell an injected outage from a

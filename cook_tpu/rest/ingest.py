@@ -77,11 +77,17 @@ class IngestBatcher:
     and blocks until the submission is durable (or rejected)."""
 
     def __init__(self, store, workers: int = 2, queue_depth: int = 512,
-                 max_batch: int = 512, retry_after_s: int = 1):
+                 max_batch: int = 512, retry_after_s: int = 1,
+                 pressure=None):
         self.store = store
         self.max_batch = max(1, int(max_batch))
         self.retry_after_s = max(1, int(retry_after_s))
-        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(queue_depth)))
+        # pressure: zero-arg callable; True means the overload
+        # controller wants admission tightened — reject at half the
+        # configured depth instead of waiting for a hard-full queue
+        self.pressure = pressure
+        self._depth = max(1, int(queue_depth))
+        self._q: queue.Queue = queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
@@ -99,6 +105,15 @@ class IngestBatcher:
         the calling thread, or raises IngestQueueFull immediately when
         admission control refuses."""
         p = _Pending(jobs, list(groups))
+        if self.pressure is not None and self._q.qsize() >= self._depth // 2:
+            try:
+                tightened = bool(self.pressure())
+            except Exception:
+                tightened = False
+            if tightened:
+                registry.counter("ingest_rejected_total").inc()
+                registry.counter("ingest_tightened_rejects_total").inc()
+                raise IngestQueueFull(self.retry_after_s)
         try:
             self._q.put_nowait(p)
         except queue.Full:
@@ -113,6 +128,10 @@ class IngestBatcher:
         if p.error is not None:
             raise p.error
         return p.result
+
+    def queue_depth(self) -> int:
+        """Instantaneous admission-queue depth (overload signal)."""
+        return self._q.qsize()
 
     def stop(self) -> None:
         self._stop.set()

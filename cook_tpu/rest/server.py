@@ -260,7 +260,15 @@ def build_scheduler(config, read_only=False):
         pools.add(Pool(name=p.name, purpose=p.purpose,
                        dru_mode=DruMode(p.dru_mode)))
     progress = ProgressAggregator(store)
-    heartbeats = HeartbeatWatcher(store)
+    heartbeats = HeartbeatWatcher(
+        store, timeout_s=config.scheduler.heartbeat_timeout_s)
+    # boot-time sync: a restart restores RUNNING instances whose agent
+    # may be gone for good (it will never re-register, so neither the
+    # census nor the liveness lease machine will ever hear from it) —
+    # tracking them NOW means the heartbeat watchdog settles them with
+    # 3000 (mea-culpa) after one timeout instead of waiting for the
+    # 300 s periodic sync to even start the clock
+    heartbeats.sync()
     clusters = ClusterRegistry()
     for c in config.clusters:
         if c.kind == "local":
@@ -295,13 +303,24 @@ def build_scheduler(config, read_only=False):
                 job = _store.get_job(uuid) if uuid else None
                 inst = _store.get_instance(task_id)
                 return (job, inst) if job and inst else None
+            liveness = None
+            if c.liveness_enabled:
+                # lease-based alive/suspect/dead/resurrected hysteresis
+                # (scheduler/liveness.py); the legacy raw-cutoff sweep
+                # remains for liveness_enabled: false
+                from cook_tpu.scheduler.liveness import AgentLivenessTracker
+                liveness = AgentLivenessTracker(
+                    lease_s=c.agent_heartbeat_timeout_s,
+                    suspect_after_s=c.liveness_suspect_after_s or None,
+                    grace_s=c.liveness_grace_s)
             clusters.register(AgentCluster(
                 name=c.name,
                 heartbeat_timeout_s=c.agent_heartbeat_timeout_s,
                 progress_aggregator=progress, heartbeats=heartbeats,
                 agent_token=config.auth.agent_token,
                 task_lookup=_resolve_task,
-                fanout_workers=config.scheduler.launch_fanout_workers))
+                fanout_workers=config.scheduler.launch_fanout_workers,
+                liveness=liveness))
         else:
             hosts = [MockHost(hostname=f"{c.name}-host-{i}",
                               mem=c.host_mem, cpus=c.host_cpus,
@@ -336,6 +355,16 @@ def build_scheduler(config, read_only=False):
             batch_size=int(config.data_locality.get("batch_size", 500)))
 
     s = config.scheduler
+    overload = None
+    if s.overload_enabled:
+        # coordinator-owned shed ladder (scheduler/overload.py); signal
+        # sources are registered below once the ingest batcher exists
+        from cook_tpu.scheduler.overload import OverloadController
+        overload = OverloadController(
+            cycle_p99_ms=s.overload_cycle_p99_ms,
+            launch_txn_p99_ms=s.overload_launch_txn_p99_ms,
+            escalate_after=s.overload_escalate_after,
+            relax_after=s.overload_relax_after)
     coord = Coordinator(
         store, clusters,
         shares=ShareStore(), quotas=QuotaStore(), pools=pools,
@@ -355,13 +384,15 @@ def build_scheduler(config, read_only=False):
                                            s.max_jobs_considered),
             launch_ack_timeout_s=s.launch_ack_timeout_s,
             consume_workers=s.consume_workers,
-            decision_provenance=s.decision_provenance),
+            decision_provenance=s.decision_provenance,
+            heartbeat_timeout_s=s.heartbeat_timeout_s),
         launch_rate_limiter=make_rl("global_launch"),
         user_launch_rate_limiter=make_rl("user_launch"),
         progress_aggregator=progress, heartbeats=heartbeats,
         plugins=plugins, data_locality=data_locality,
         checkpoint_defaults=config.checkpoint or None,
-        status_shards=s.status_shards)
+        status_shards=s.status_shards,
+        overload=overload)
 
     # device-resident match path (scheduler/resident.py): the
     # production DEFAULT, with full feature parity — plugins, data
@@ -413,10 +444,26 @@ def build_scheduler(config, read_only=False):
     ingest = None
     if config.ingest_workers > 0 and not read_only:
         from cook_tpu.rest.ingest import IngestBatcher
-        ingest = IngestBatcher(store,
-                               workers=config.ingest_workers,
-                               queue_depth=config.ingest_queue_depth,
-                               max_batch=config.ingest_max_batch)
+        ingest = IngestBatcher(
+            store,
+            workers=config.ingest_workers,
+            queue_depth=config.ingest_queue_depth,
+            max_batch=config.ingest_max_batch,
+            pressure=overload.ingest_tightened if overload else None)
+    if overload is not None:
+        # pressure signals beyond the two latency feeds the coordinator
+        # pushes: admission-queue depth and resident-structure sizes
+        if ingest is not None:
+            overload.add_source(
+                "ingest_queue_depth", ingest.queue_depth,
+                high=0.8 * config.ingest_queue_depth)
+        overload.add_source(
+            "pending_jobs", store.pending_count,
+            high=float(4 * s.max_jobs_considered))
+        overload.add_source(
+            "decision_jobs_tracked",
+            lambda: coord.decisions.stats().get("jobs_tracked", 0),
+            high=float(max(4096, 8 * s.max_jobs_considered)))
     api = CookApi(
         store, coordinator=coord,
         auth=AuthConfig(scheme=config.auth.scheme,

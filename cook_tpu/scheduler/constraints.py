@@ -110,8 +110,11 @@ def build_forbidden(jobs: list[Job], host_names: list[str],
         return vals
 
     for j, job in enumerate(jobs):
-        # novel-host: exclude hosts of previous instances
+        # novel-host: exclude hosts of previous instances (5003
+        # launch-ack-timeouts don't count — Instance.counts_for_novel_host)
         for inst in job.instances:
+            if not inst.counts_for_novel_host:
+                continue
             hi = host_idx.get(inst.hostname)
             if hi is not None:
                 forb[j, hi] = True
@@ -158,6 +161,8 @@ def explain_forbidden(job: Job, host_names: list[str],
 
     novel = np.zeros(H, bool)
     for inst in job.instances:
+        if not inst.counts_for_novel_host:
+            continue
         hi = host_idx.get(inst.hostname)
         if hi is not None:
             novel[hi] = True

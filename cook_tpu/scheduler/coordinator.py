@@ -114,6 +114,10 @@ class SchedulerConfig:
     # different pools drain concurrently instead of serializing on the
     # single consumer thread this replaced.
     consume_workers: int = 4
+    # per-task executor heartbeat timeout (HeartbeatWatcher): a RUNNING
+    # task whose executor goes silent this long fails 3000 (mea-culpa).
+    # Cook's default of 15 min; settings wire it through build_scheduler
+    heartbeat_timeout_s: float = 15 * 60.0
     # decision provenance: read back the device cycle's per-queue-slot
     # reason codes (ops/cycle.py why_*) and record them in the
     # DecisionBook behind GET /unscheduled. The device computes the
@@ -176,7 +180,8 @@ class Coordinator:
                  progress_aggregator=None, heartbeats=None,
                  plugins=None, data_locality=None,
                  checkpoint_defaults: Optional[dict] = None,
-                 status_shards: int = 0):
+                 status_shards: int = 0,
+                 overload=None):
         self.store = store
         self.clusters = clusters
         self.shares = shares or ShareStore()
@@ -239,6 +244,12 @@ class Coordinator:
         self.skipped_clusters: dict[str, dict[str, float]] = {}
         self.progress_aggregator = progress_aggregator
         self.heartbeats = heartbeats
+        # adaptive overload controller (scheduler/overload.py): the
+        # cycle paths consult its shed ladder (consider window scale,
+        # provenance gate) and feed it latency samples; run() drives
+        # its evaluate loop. None = no shedding (tests/bench drive
+        # cycles directly at full fidelity).
+        self.overload = overload
         self.plugins = plugins
         self.data_locality = data_locality
         # cluster-wide checkpoint defaults: the matcher must bin-pack
@@ -816,6 +827,12 @@ class Coordinator:
                     qn[uid] = 0
         limit = self._num_considerable.get(
             pool, self.config.max_jobs_considered)
+        if self.overload is not None:
+            # shed rung 1: the overload consider-window scale composes
+            # with the per-pool scaleback — take the smaller window
+            limit = max(1, min(limit, int(
+                self.config.max_jobs_considered
+                * self.overload.consider_scale())))
         if not self.launch_rl.would_allow("global"):
             limit = 0
         C = min(bucket(self.config.max_jobs_considered), rp.Pcap)
@@ -886,6 +903,8 @@ class Coordinator:
         metrics_registry.counter("match_matched_total", pool=pool).inc(
             stats.matched)
         metrics_registry.counter("match_cycles_total", pool=pool).inc()
+        if self.overload is not None:
+            self.overload.note_cycle_ms(stats.cycle_ms)
         if obs.tracer.enabled:
             # flight-recorder entry: this cycle with the phase stamps it
             # already took — the tail segment is the inline consume for
@@ -944,6 +963,8 @@ class Coordinator:
             cons_host = np.asarray(cons_host)[:n_matched]
         why_rows = None
         if (self.config.decision_provenance
+                and (self.overload is None
+                     or self.overload.provenance_enabled())
                 and getattr(out, "why_idx", None) is not None):
             # provenance window: in pipelined/async mode these arrays
             # were already copy_to_host_async'd at dispatch, so this is
@@ -1134,6 +1155,9 @@ class Coordinator:
         if items:
             metrics_registry.histogram("launch_txn_ms", pool=pool) \
                 .observe(self.metrics[f"match.{pool}.launch_txn_ms"])
+            if self.overload is not None:
+                self.overload.note_launch_txn_ms(
+                    self.metrics[f"match.{pool}.launch_txn_ms"])
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
         traced = []   # (trace_id, root_sid, launch_sid, task_id)
@@ -1357,6 +1381,12 @@ class Coordinator:
 
         num_considerable = self._num_considerable.get(
             pool, self.config.max_jobs_considered)
+        if self.overload is not None:
+            # same rung-1 composition as the resident path: the shed
+            # scale and the scaleback both only ever shrink the window
+            num_considerable = max(1, min(num_considerable, int(
+                self.config.max_jobs_considered
+                * self.overload.consider_scale())))
 
         # tensorize
         run_insts = [(i, self.store.jobs[i.job_uuid])
@@ -1435,7 +1465,9 @@ class Coordinator:
         job_host = np.asarray(res.job_host)
         considerable = np.asarray(res.considerable)
         queue_rank = np.asarray(res.queue_rank)
-        if self.config.decision_provenance:
+        if self.config.decision_provenance and \
+                (self.overload is None
+                 or self.overload.provenance_enabled()):
             # legacy path reads P-sized vectors anyway; the why window
             # is one more small pull on an already-synchronous cycle
             cyc = self._legacy_cycle_seq[pool] = \
@@ -1642,6 +1674,8 @@ class Coordinator:
         metrics_registry.counter("match_matched_total", pool=pool).inc(
             launched)
         metrics_registry.counter("match_cycles_total", pool=pool).inc()
+        if self.overload is not None:
+            self.overload.note_cycle_ms(stats.cycle_ms)
         if obs.tracer.enabled:
             end, t_now = obs.now_ms(), time.perf_counter()
             w = lambda t: end - (t_now - t) * 1e3
@@ -2164,7 +2198,15 @@ class Coordinator:
         # tools.clj:757-774: nuke uncommitted jobs older than a few
         # days so they don't clutter the pending scan)
         gced = self.store.gc_uncommitted(self.config.uncommitted_gc_age_ms)
-        self.publish_fairness_metrics()
+        if self.overload is not None and \
+                self.overload.defer_metrics_flush():
+            # shed rung 3: the per-(pool, user) fairness sweep is the
+            # one non-critical flush on this cadence — /metrics serves
+            # the last published values until pressure clears
+            metrics_registry.counter(
+                "overload_deferred_flush_total").inc()
+        else:
+            self.publish_fairness_metrics()
         return {"lingering": killed_lingering,
                 "stragglers": killed_straggler,
                 "launch_ack": killed_unacked,
@@ -2408,8 +2450,17 @@ class Coordinator:
         if self.progress_aggregator is not None:
             loop(1.0, self.progress_aggregator.publish, per_pool=False)
         if self.heartbeats is not None:
-            loop(30.0, self.heartbeats.check, per_pool=False)
+            # check cadence follows the configured timeout: a deployment
+            # that tightens heartbeat_timeout_s below the default 30s
+            # sweep would otherwise detect losses a full sweep late
+            hb_check_s = min(30.0, max(1.0,
+                                       self.heartbeats.timeout_s / 3.0))
+            loop(hb_check_s, self.heartbeats.check, per_pool=False)
             loop(300.0, self.heartbeats.sync, per_pool=False)
+        if self.overload is not None:
+            # the overload control loop: poll pressure signals, walk
+            # the shed ladder at most one rung per evaluation
+            loop(2.0, self.overload.evaluate, per_pool=False)
 
     def stop(self) -> None:
         self._stop.set()

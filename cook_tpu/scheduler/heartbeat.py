@@ -57,16 +57,48 @@ class HeartbeatWatcher:
 
     def check(self) -> list[str]:
         """Fail every task past its deadline (handle-timeout
-        heartbeat.clj:65). Returns the task ids timed out."""
+        heartbeat.clj:65). Returns the task ids timed out.
+
+        Two-phase so a racing completion or heartbeat wins over the
+        3000 write: the expiry snapshot is only a candidate list; each
+        candidate re-checks (a) the store — an instance that went
+        terminal since the snapshot keeps its terminal status/reason,
+        and (b) its own deadline — a notify() that landed since the
+        snapshot keeps the task alive. After the write the instance is
+        re-read and the timeout is only reported (and on_timeout only
+        fired) if FAILED/3000 actually stuck, so the store's
+        transition machine stays the final arbiter.
+        """
         now = self._clock()
         with self._lock:
-            expired = [tid for tid, dl in self._deadlines.items()
-                       if dl <= now]
-            for tid in expired:
+            candidates = [tid for tid, dl in self._deadlines.items()
+                          if dl <= now]
+        expired = []
+        for tid in candidates:
+            inst = self.store.get_instance(tid)
+            if inst is not None and not inst.active:
+                # completed between snapshot and write: terminal wins —
+                # just stop tracking (unless a notify re-armed it for a
+                # NEW deadline, which sync() will reap anyway)
+                with self._lock:
+                    dl = self._deadlines.get(tid)
+                    if dl is not None and dl <= now:
+                        del self._deadlines[tid]
+                continue
+            with self._lock:
+                dl = self._deadlines.get(tid)
+                if dl is None or dl > now:
+                    continue  # untrack()ed or freshly heartbeated
                 del self._deadlines[tid]
-        for tid in expired:
             self.store.update_instance(tid, InstanceStatus.FAILED,
                                        reason_code=3000)
+            after = self.store.get_instance(tid)
+            if after is not None and (after.status != InstanceStatus.FAILED
+                                      or after.reason_code != 3000):
+                # the store dropped or re-attributed the write (e.g. a
+                # queued completion won): not a heartbeat timeout
+                continue
+            expired.append(tid)
             if self.on_timeout:
                 self.on_timeout(tid)
         return expired

@@ -75,6 +75,17 @@ def generate_hosts(n_hosts: int = 20, cpus: float = 20.0,
             for i in range(n_hosts)]
 
 
+def generate_churn_schedule(seed: int, hostnames: list,
+                            duration_s: float, **kw):
+    """Agent-churn schedule for a generated fleet: a thin re-export of
+    :func:`cook_tpu.chaos.churn.generate_churn` so a soak's three
+    deterministic inputs — trace, fleet, churn — all come from this
+    module with one seed. Keyword args pass through (events_per_agent,
+    kill_fraction, per-action down windows)."""
+    from cook_tpu.chaos.churn import generate_churn
+    return generate_churn(seed, hostnames, duration_s, **kw)
+
+
 def main(argv=None):
     import argparse
     p = argparse.ArgumentParser(description="generate a simulator trace")
@@ -84,6 +95,10 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--trace-out", required=True)
     p.add_argument("--hosts-out", required=True)
+    p.add_argument("--churn-out", default=None,
+                   help="also write an agent-churn JSONL schedule "
+                        "for the generated fleet")
+    p.add_argument("--churn-duration-s", type=float, default=60.0)
     a = p.parse_args(argv)
     with open(a.trace_out, "w") as f:
         json.dump(generate_trace(a.jobs, a.users, seed=a.seed), f, indent=1)
@@ -91,6 +106,11 @@ def main(argv=None):
         json.dump(generate_hosts(a.hosts), f, indent=1)
     print(f"wrote {a.jobs} jobs -> {a.trace_out}, "
           f"{a.hosts} hosts -> {a.hosts_out}")
+    if a.churn_out:
+        sched = generate_churn_schedule(
+            a.seed, [str(i) for i in range(a.hosts)], a.churn_duration_s)
+        n = sched.save(a.churn_out)
+        print(f"wrote {n} churn events -> {a.churn_out}")
 
 
 if __name__ == "__main__":

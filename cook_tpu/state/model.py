@@ -164,6 +164,19 @@ class Instance:
         r = REASON_BY_CODE.get(self.reason_code or -1)
         return bool(r and r.mea_culpa)
 
+    @property
+    def counts_for_novel_host(self) -> bool:
+        """Whether this attempt contributes its host to the job's
+        novel-host exclusion set (constraints.clj:73-100). A 5003
+        launch-ack-timeout is excluded: the launch was never
+        acknowledged — the command provably never ran there, so there
+        is no evidence against the host, and counting it deadlocks a
+        small cluster (a job whose launches were twice interrupted by
+        coordinator crashes would exhaust every host and wait forever).
+        Genuine host failures (host-lost, heartbeat-lost, user exits)
+        still count."""
+        return bool(self.hostname) and self.reason_code != 5003
+
 
 @dataclass
 class Job:

@@ -1140,6 +1140,15 @@ class JobStore:
                         for j in d.values()]
             return list(self._pending.get(pool, {}).values())
 
+    def pending_count(self, pool: Optional[str] = None) -> int:
+        """O(pools) size probe for the admission/overload layer — the
+        full pending_jobs() copy is too expensive to poll every couple
+        of seconds on a deep backlog."""
+        with self._lock:
+            if pool is None:
+                return sum(len(d) for d in self._pending.values())
+            return len(self._pending.get(pool, {}))
+
     def running_jobs(self, pool: Optional[str] = None) -> list[Job]:
         """O(running), not O(all jobs ever): served from the
         _usage_jobs index (exactly the RUNNING uuids, maintained at

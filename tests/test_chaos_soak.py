@@ -122,23 +122,34 @@ def _soak(tmp_path, tag, agents=2):
             time.sleep(0.1)
 
         try:
+            # seed + event-ledger path in every message: a red soak must
+            # be replayable from the assertion line alone
+            ledger = os.path.join(
+                os.environ.get("CHAOS_ARTIFACTS_DIR", "$CHAOS_ARTIFACTS_DIR"),
+                f"chaos-events-{tag}.jsonl")
+            ctx = f"seed={chaos.controller.seed} chaos_ledger={ledger}"
             for j in jobs:
                 # no lost jobs: chaos may cost instances, never the job
                 assert j.state == JobState.COMPLETED, \
-                    f"{j.uuid} stuck in {j.state}"
-                assert j.success, f"{j.uuid} completed unsuccessfully"
+                    f"[{ctx}] {j.uuid} stuck in {j.state}"
+                assert j.success, \
+                    f"[{ctx}] {j.uuid} completed unsuccessfully"
                 # no stuck instances
                 for inst in j.instances:
                     assert inst.status in TERMINAL, \
-                        f"{inst.task_id} non-terminal: {inst.status}"
+                        f"[{ctx}] {inst.task_id} non-terminal: " \
+                        f"{inst.status}"
                 # bounded retries: real failures within the user budget,
                 # mea-culpa churn within its failure limits
-                assert j.attempts_consumed() <= j.max_retries
+                assert j.attempts_consumed() <= j.max_retries, \
+                    f"[{ctx}] {j.uuid} over retry budget"
                 assert len(j.instances) <= 16, \
-                    f"{j.uuid} churned {len(j.instances)} instances"
+                    f"[{ctx}] {j.uuid} churned {len(j.instances)} " \
+                    f"instances"
             # no double launch: at-most-once execution per task_id
             doubled = {t: n for t, n in launches.items() if n > 1}
-            assert not doubled, f"double-launched task_ids: {doubled}"
+            assert not doubled, \
+                f"[{ctx}] double-launched task_ids: {doubled}"
         except AssertionError:
             _dump_artifacts(tag)
             raise

@@ -55,6 +55,9 @@ def test_settings_full_roundtrip(tmp_path):
     {"rate_limits": {"bogus": {}}},
     {"clusters": [{"name": "a"}, {"name": "a"}]},
     {"scheduler": {"launch_fanout_workers": 0}},
+    {"scheduler": {"heartbeat_timeout_s": 0}},
+    {"scheduler": {"overload_escalate_after": 0}},
+    {"clusters": [{"kind": "agent", "liveness_grace_s": -1.0}]},
 ])
 def test_settings_validation_errors(bad):
     with pytest.raises(ConfigError):
@@ -93,6 +96,50 @@ def test_build_scheduler_wires_launch_pipeline():
         "launch_group_commit": False,
         "clusters": [{"kind": "mock", "hosts": 1}]})
     assert store2.group_commit is False
+
+
+def test_heartbeat_timeout_settings_and_wiring():
+    """heartbeat_timeout_s flows settings -> HeartbeatWatcher AND
+    SchedulerConfig (no more hard-coded 15-minute constant in the
+    assembled server)."""
+    from cook_tpu.rest.server import build_scheduler
+    from cook_tpu.scheduler.heartbeat import HEARTBEAT_TIMEOUT_S
+    s = Settings.from_dict({})
+    assert s.scheduler.heartbeat_timeout_s == HEARTBEAT_TIMEOUT_S
+    s = Settings.from_dict({"scheduler": {"heartbeat_timeout_s": 42.0}})
+    assert s.scheduler.heartbeat_timeout_s == 42.0
+    _, coord, _ = build_scheduler({
+        "clusters": [{"kind": "mock", "hosts": 1}],
+        "scheduler": {"heartbeat_timeout_s": 42.0}})
+    assert coord.heartbeats.timeout_s == 42.0
+    assert coord.config.heartbeat_timeout_s == 42.0
+    # default assembly keeps Cook's 15-minute production default
+    _, coord2, _ = build_scheduler({"clusters": [{"kind": "mock"}]})
+    assert coord2.heartbeats.timeout_s == HEARTBEAT_TIMEOUT_S
+
+
+def test_build_scheduler_wires_liveness_and_overload():
+    from cook_tpu.rest.server import build_scheduler
+    _, coord, _ = build_scheduler({
+        "dev_mode": True,
+        "clusters": [{"kind": "agent", "name": "agents",
+                      "agent_heartbeat_timeout_s": 7.0,
+                      "liveness_grace_s": 2.0}],
+        "scheduler": {"overload_cycle_p99_ms": 123.0}})
+    trk = coord.clusters.get("agents").liveness
+    assert trk is not None
+    assert trk.lease_s == 7.0 and trk.grace_s == 2.0
+    assert coord.overload is not None
+    assert coord.overload.cycle_p99_ms == 123.0
+    # both layers are opt-out: the legacy raw-cutoff sweep and an
+    # always-full-fidelity coordinator must stay configurable
+    _, coord2, _ = build_scheduler({
+        "dev_mode": True,
+        "clusters": [{"kind": "agent", "name": "agents",
+                      "liveness_enabled": False}],
+        "scheduler": {"overload_enabled": False}})
+    assert coord2.clusters.get("agents").liveness is None
+    assert coord2.overload is None
 
 
 def test_build_scheduler_wires_optimizer():

@@ -198,6 +198,7 @@ def _soak(tmp_path, tag, sites=None, seed=0, max_kills=2,
                 # monotone history: restore never loses instances a
                 # previous poll already observed
                 assert n >= seen_instances.get(u, 0), \
+                    f"[seed={seed} kill_ledger={live.budget_file}] " \
                     f"{u} instance count shrank across restart"
                 seen_instances[u] = max(n, seen_instances.get(u, 0))
             if len(jobs) == len(uuids) and \
@@ -206,21 +207,30 @@ def _soak(tmp_path, tag, sites=None, seed=0, max_kills=2,
             time.sleep(0.4)
 
         try:
-            assert len(jobs) == len(uuids), "lost jobs across restarts"
+            # seed + kill-ledger path in every message: a red soak must
+            # be replayable from the assertion line alone
+            ctx = f"seed={seed} kill_ledger={live.budget_file}"
+            assert len(jobs) == len(uuids), \
+                f"[{ctx}] lost jobs across restarts"
             for j in jobs.values():
                 assert j.status == "completed", \
-                    f"{j.uuid} stuck in {j.status}"
+                    f"[{ctx}] {j.uuid} stuck in {j.status}"
                 assert j.state == "success", \
-                    f"{j.uuid} completed unsuccessfully ({j.state})"
+                    f"[{ctx}] {j.uuid} completed unsuccessfully " \
+                    f"({j.state})"
                 for inst in j.instances:
                     assert inst.status in TERMINAL, \
-                        f"{inst.task_id} non-terminal: {inst.status}"
+                        f"[{ctx}] {inst.task_id} non-terminal: " \
+                        f"{inst.status}"
                 assert len(j.instances) <= 12, \
-                    f"{j.uuid} churned {len(j.instances)} instances"
+                    f"[{ctx}] {j.uuid} churned {len(j.instances)} " \
+                    f"instances"
             doubled = {t: n for t, n in launch_counts.items() if n > 1}
-            assert not doubled, f"double-launched task_ids: {doubled}"
+            assert not doubled, \
+                f"[{ctx}] double-launched task_ids: {doubled}"
             for t in live.sup.ready_times_s:
-                assert t <= READY_BOUND_S, f"restart took {t:.1f}s"
+                assert t <= READY_BOUND_S, \
+                    f"[{ctx}] restart took {t:.1f}s"
         except AssertionError:
             _dump_artifacts(live, tag)
             raise
@@ -254,8 +264,10 @@ def test_crash_soak_invariants(tmp_path, tag):
     # SIGKILL and one observed death, else this silently degrades into
     # the baseline test
     kills = live.kills()
-    assert kills, f"{tag}: no kill ever fired"
-    assert live.sup.deaths, f"{tag}: supervisor observed no death"
+    ctx = f"seed={sched['seed']} kill_ledger={live.budget_file}"
+    assert kills, f"[{ctx}] {tag}: no kill ever fired"
+    assert live.sup.deaths, \
+        f"[{ctx}] {tag}: supervisor observed no death"
     assert all(k["site"] in sched["sites"] for k in kills)
     # every restart restored and reconciled: /debug reports recovery.
     # restored_from may be None when the kill landed before the first

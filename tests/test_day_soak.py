@@ -1,0 +1,109 @@
+"""Compressed production-day soak: transport chaos + coordinator
+SIGKILLs + agent-fleet churn armed SIMULTANEOUSLY (tests.daysoak).
+
+Gates (the control plane's production-day promises):
+
+  - zero lost jobs: every submitted uuid reaches completed, and no
+    duplicate uuid appears;
+  - at-most-once launch: each task_id hits an executor at most once,
+    across every agent incarnation and every coordinator incarnation;
+  - monotone history: a coordinator restart never loses instances a
+    poll already observed;
+  - bounded recovery: every restart ready within the bound;
+  - bounded RSS: the server process stays under a hard ceiling;
+  - bounded p99: front-door submit latency stays sane under burst.
+
+Every assertion message carries the seed and the kill-ledger path so a
+red run is replayable from the log alone. The quick tier runs two
+seeds scaled down for CI; the slow-marked tier runs the full-magnitude
+day (nightly). The quiet baseline pins the oracle: no churn, no kills,
+no transport faults -> zero violations, zero shed-ladder engagement
+(overload_state stays 0), one clean instance per job.
+"""
+import pytest
+
+from tests.daysoak import run_day_soak
+
+QUICK = dict(jobs=6, agents=3, window_s=3.0, wall_s=75.0, max_kills=1)
+FULL = dict(jobs=120, agents=6, window_s=30.0, wall_s=600.0,
+            max_kills=3, events_per_agent=2.0)
+
+RSS_CEILING_MB = 3000.0
+SUBMIT_P99_CEILING_MS = 5000.0
+
+
+def _assert_gates(r, full=False):
+    ctx = (f"seed={r['seed']} kill_ledger={r['kill_ledger']} "
+           f"server_log={r['server_log']}")
+    assert not r["violations"], \
+        f"[{ctx}] in-flight violations: {r['violations']}"
+    assert len(r["jobs"]) == r["expected_jobs"], \
+        f"[{ctx}] lost jobs: {len(r['jobs'])}/{r['expected_jobs']}"
+    for j in r["jobs"].values():
+        assert j.status == "completed", \
+            f"[{ctx}] {j.uuid} stuck in {j.status}"
+        assert j.state == "success", \
+            f"[{ctx}] {j.uuid} completed unsuccessfully ({j.state})"
+        bound = 24 if full else 16
+        assert len(j.instances) <= bound, \
+            f"[{ctx}] {j.uuid} churned {len(j.instances)} instances"
+    doubled = {t: n for t, n in r["launch_counts"].items() if n > 1}
+    assert not doubled, \
+        f"[{ctx}] double-launched task_ids: {doubled}"
+    for t in r["ready_times_s"]:
+        assert t <= 20.0, f"[{ctx}] restart took {t:.1f}s"
+    assert r["max_rss_mb"] < RSS_CEILING_MB, \
+        f"[{ctx}] server RSS {r['max_rss_mb']}MB over ceiling"
+    assert r["submit_p99_ms"] < SUBMIT_P99_CEILING_MS, \
+        f"[{ctx}] submit p99 {r['submit_p99_ms']}ms over ceiling"
+
+
+@pytest.mark.parametrize("seed", [101, 202])
+def test_day_soak_quick(tmp_path, seed):
+    r = run_day_soak(tmp_path / "store", seed, **QUICK)
+    _assert_gates(r)
+    ctx = f"seed={seed} kill_ledger={r['kill_ledger']}"
+    # all three fault layers must actually have bitten, else this
+    # silently degrades into the baseline test
+    assert r["transport_injected"] > 0, \
+        f"[{ctx}] transport chaos never fired"
+    assert r["churn_events"], f"[{ctx}] churn schedule was empty"
+    # procfault is deterministic per (seed, incarnation): these seeds
+    # were chosen so the coordinator dies at least once mid-day
+    assert r["server_deaths"] >= 1, \
+        f"[{ctx}] no coordinator SIGKILL ever landed"
+
+
+def test_day_soak_quiet_baseline(tmp_path):
+    """No churn, no kills, no transport faults: the oracle pin. Zero
+    violations, one clean instance per job, and the overload shed
+    ladder NEVER engages on a quiet day (overload_state stays 0)."""
+    r = run_day_soak(tmp_path / "store", seed=7, jobs=6, agents=2,
+                     window_s=2.0, wall_s=60.0, max_kills=0,
+                     churn=False, transport=False)
+    _assert_gates(r)
+    ctx = f"seed=7 kill_ledger={r['kill_ledger']}"
+    assert r["transport_injected"] == 0, \
+        f"[{ctx}] baseline run injected transport faults"
+    assert r["kills"] == [] and r["server_deaths"] == 0, \
+        f"[{ctx}] baseline run killed the server"
+    assert r["overload_level_max"] == 0, \
+        f"[{ctx}] shed ladder engaged on a quiet day " \
+        f"(level {r['overload_level_max']})"
+    for j in r["jobs"].values():
+        assert len(j.instances) == 1, \
+            f"[{ctx}] {j.uuid} churned on a quiet day"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [101, 202])
+def test_day_soak_full_magnitude(tmp_path, seed):
+    """The nightly day: full-magnitude burst + churn + kills (see
+    tests.daysoak.run_day_soak docstring for the parameter story)."""
+    r = run_day_soak(tmp_path / "store", seed, **FULL)
+    _assert_gates(r, full=True)
+    ctx = f"seed={seed} kill_ledger={r['kill_ledger']}"
+    assert r["transport_injected"] > 0, \
+        f"[{ctx}] transport chaos never fired"
+    assert r["server_deaths"] >= 1, \
+        f"[{ctx}] no coordinator SIGKILL ever landed"

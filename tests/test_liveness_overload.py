@@ -1,0 +1,417 @@
+"""Units for the overload-adaptive control plane and the agent lease
+machine: AgentLivenessTracker hysteresis, OverloadController shed
+ladder, churn-schedule determinism, the HeartbeatWatcher
+terminal-overwrite race, and the circuit breaker's single half-open
+probe. All clocks are injected — nothing here sleeps.
+"""
+import threading
+
+import pytest
+
+from cook_tpu.chaos.churn import KILL, generate_churn
+from cook_tpu.scheduler.heartbeat import HeartbeatWatcher
+from cook_tpu.scheduler.liveness import (ALIVE, DEAD, RESURRECTED,
+                                         SUSPECT, AgentLivenessTracker)
+from cook_tpu.scheduler.overload import ACTIONS, OverloadController
+from cook_tpu.state.model import InstanceStatus, Job, new_uuid
+from cook_tpu.state.store import JobStore
+from cook_tpu.utils.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                    CircuitBreaker)
+
+
+# -- liveness lease machine --------------------------------------------
+def mktracker(**kw):
+    t = [0.0]
+    kw.setdefault("lease_s", 10.0)
+    trk = AgentLivenessTracker(clock=lambda: t[0], **kw)
+    return trk, t
+
+
+def test_liveness_full_cycle_and_single_lapse():
+    trk, t = mktracker(grace_s=4.0)
+    assert trk.observe("h1") == ("", ALIVE)
+    assert trk.state("h1") == ALIVE and trk.offerable("h1")
+    t[0] = 5.0                       # quiet past lease/2
+    assert trk.tick()["transitions"] == [("h1", ALIVE, SUSPECT)]
+    assert trk.offerable("h1")       # suspect still offerable
+    t[0] = 10.0                      # quiet past the full lease
+    out = trk.tick()
+    assert out["transitions"] == [("h1", SUSPECT, DEAD)]
+    assert out["lapsed"] == []       # grace not yet served
+    assert not trk.offerable("h1")
+    t[0] = 14.0                      # dead for grace_s
+    assert trk.tick()["lapsed"] == ["h1"]
+    t[0] = 20.0                      # lapse fires exactly ONCE
+    assert trk.tick()["lapsed"] == []
+
+
+def test_liveness_flap_inside_suspect_window_stays_alive():
+    trk, t = mktracker()
+    trk.observe("h1")
+    t[0] = 4.0                       # inside lease/2: no transition
+    assert trk.tick()["transitions"] == []
+    trk.observe("h1")                # the bounce's first heartbeat
+    t[0] = 8.0                       # quiet measured from the bounce
+    assert trk.tick()["transitions"] == []
+    assert trk.state("h1") == ALIVE
+    assert trk.counts()["alive"] == 1
+
+
+def test_liveness_suspect_recovers_without_dying():
+    trk, t = mktracker()
+    trk.observe("h1")
+    t[0] = 6.0
+    trk.tick()
+    assert trk.state("h1") == SUSPECT
+    assert trk.observe("h1") == (SUSPECT, ALIVE)
+
+
+def test_liveness_resurrection_hold_then_alive():
+    trk, t = mktracker()
+    trk.observe("h1")
+    t[0] = 11.0
+    trk.tick()
+    assert trk.state("h1") == DEAD
+    assert trk.observe("h1") == (DEAD, RESURRECTED)
+    assert trk.offerable("h1")       # resurrected participates again
+    t[0] = 12.0
+    assert trk.observe("h1") is None  # still inside the hold
+    t[0] = 17.0                      # hold (lease/2) served
+    assert trk.observe("h1") == (RESURRECTED, ALIVE)
+    assert trk.snapshot()["agents"]["h1"]["flaps"] == 1
+
+
+def test_liveness_unknown_host_reads_alive_and_forget():
+    trk, t = mktracker()
+    assert trk.state("nope") == ALIVE and trk.offerable("nope")
+    trk.observe("h1")
+    trk.forget("h1")
+    assert trk.counts() == {"alive": 0, "suspect": 0, "dead": 0,
+                            "resurrected": 0}
+
+
+def test_liveness_rejects_nonpositive_lease():
+    with pytest.raises(ValueError):
+        AgentLivenessTracker(lease_s=0.0)
+
+
+# -- overload shed ladder ----------------------------------------------
+def mkctl(**kw):
+    kw.setdefault("cycle_p99_ms", 100.0)
+    kw.setdefault("escalate_after", 2)
+    kw.setdefault("relax_after", 2)
+    return OverloadController(**kw)
+
+
+def feed(ctl, ms, n=50):
+    for _ in range(n):
+        ctl.note_cycle_ms(ms)
+
+
+def step(ctl, ms=None):
+    """One control step: refill the (drained-per-evaluate) latency
+    window with fresh samples, then evaluate — how a genuinely
+    overloaded coordinator looks, cycle samples arriving every step."""
+    if ms is not None:
+        feed(ctl, ms)
+    return ctl.evaluate()
+
+
+def test_overload_ladder_escalates_one_rung_per_dwell():
+    ctl = mkctl()
+    feed(ctl, 500.0)
+    assert ctl.level == 0 and ctl.consider_scale() == 1.0
+    step(ctl)                        # hot streak 1: no move yet
+    assert ctl.level == 0
+    step(ctl, 500.0)                 # hot streak 2 = escalate_after
+    assert ctl.level == 1
+    assert ctl.consider_scale() == 0.5
+    assert ctl.provenance_enabled()
+    step(ctl, 500.0); step(ctl, 500.0)
+    assert ctl.level == 2 and not ctl.provenance_enabled()
+    step(ctl, 500.0); step(ctl, 500.0)
+    assert ctl.level == 3 and ctl.defer_metrics_flush()
+    step(ctl, 500.0); step(ctl, 500.0)
+    assert ctl.level == 4 and ctl.ingest_tightened()
+    step(ctl, 500.0); step(ctl, 500.0)
+    assert ctl.level == 4            # ladder tops out at len(ACTIONS)
+
+
+def test_overload_relaxes_with_hysteresis_band():
+    ctl = mkctl()
+    step(ctl, 500.0); step(ctl, 500.0)
+    assert ctl.level == 1
+    # inside the band (above relax_margin*high, below high): HOLD —
+    # neither streak may accumulate
+    for _ in range(10):
+        step(ctl, 90.0)
+    assert ctl.level == 1
+    step(ctl, 10.0)                  # truly calm
+    assert ctl.level == 1            # calm streak 1
+    step(ctl, 10.0)
+    assert ctl.level == 0            # relax after 2
+    assert ctl.consider_scale() == 1.0
+    kinds = [e["kind"] for e in ctl.snapshot()["events"]]
+    assert kinds == ["shed", "relax"]
+
+
+def test_overload_one_shot_spike_cannot_escalate():
+    """A warm-up spike (first JIT compiles run the cycle for seconds)
+    lands in ONE control window and must not walk the ladder: each
+    evaluate() drains the latency window, so the spike is gone by the
+    next step and the hot streak never reaches escalate_after. A
+    rolling window regressed this — a freshly booted idle server
+    walked itself to rung 4 off its first compiles."""
+    ctl = mkctl()
+    feed(ctl, 5000.0, n=5)           # the compile spike, then silence
+    for _ in range(6):
+        ctl.evaluate()
+    assert ctl.level == 0
+    assert ctl.snapshot()["events"] == []
+
+
+def test_overload_sources_and_raising_reader():
+    ctl = mkctl()
+    depth = [0]
+    ctl.add_source("queue", lambda: depth[0], high=100.0)
+    boom_calls = []
+
+    def boom():
+        boom_calls.append(1)
+        raise RuntimeError("reader died")
+
+    ctl.add_source("broken", boom, high=10.0)
+    depth[0] = 500
+    ctl.evaluate(); ctl.evaluate()
+    assert ctl.level == 1            # queue signal alone escalates
+    assert boom_calls                # raising reader read as 0, polled
+    snap = ctl.snapshot()
+    assert snap["signals"]["queue"]["value"] == 500.0
+    assert snap["signals"]["broken"]["value"] == 0.0
+    assert snap["ladder"] == list(ACTIONS)
+
+
+def test_overload_gauge_and_engaged():
+    from cook_tpu.utils.metrics import registry
+    ctl = mkctl()
+    assert not ctl.engaged()
+    step(ctl, 500.0); step(ctl, 500.0)
+    assert ctl.engaged()
+    assert registry.gauge("overload_state").value == 1
+
+
+def test_overload_rejects_bad_dwell():
+    with pytest.raises(ValueError):
+        OverloadController(escalate_after=0)
+
+
+# -- churn schedule determinism ----------------------------------------
+def test_churn_deterministic_and_kill_invariants():
+    hosts = [f"h{i}" for i in range(10)]
+    a = generate_churn(42, hosts, 60.0, kill_fraction=0.5)
+    b = generate_churn(42, hosts, 60.0, kill_fraction=0.5)
+    assert [e.as_dict() for e in a.events] == \
+        [e.as_dict() for e in b.events]
+    c = generate_churn(43, hosts, 60.0, kill_fraction=0.5)
+    assert [e.as_dict() for e in a.events] != \
+        [e.as_dict() for e in c.events]
+    killed = {e.hostname for e in a.events if e.action == KILL}
+    assert len(killed) == 5          # 0.5 of 10
+    # a kill is always the host's LAST scheduled event
+    for h in killed:
+        evs = [e for e in a.events if e.hostname == h]
+        assert max(evs, key=lambda e: e.t_s).action == KILL
+
+
+def test_churn_never_kills_the_whole_fleet():
+    sched = generate_churn(1, ["only"], 30.0, kill_fraction=1.0)
+    assert not any(e.action == KILL for e in sched.events)
+
+
+def test_churn_schedule_artifact_roundtrip(tmp_path):
+    import json
+    sched = generate_churn(7, ["a", "b", "c"], 30.0)
+    path = tmp_path / "churn.jsonl"
+    n = sched.save(str(path))
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0]["seed"] == 7 and lines[0]["site"] == "agent.churn"
+    assert len(lines) - 1 == n == len(sched.events)
+
+
+# -- heartbeat terminal-overwrite race (regression) --------------------
+def mkhb(timeout=5.0):
+    t = [0.0]
+    store = JobStore()
+    job = Job(uuid=new_uuid(), user="u", command="c", mem=1, cpus=1)
+    store.create_jobs([job])
+    inst = store.create_instance(job.uuid, "h0", "default")
+    store.update_instance(inst.task_id, InstanceStatus.RUNNING)
+    hb = HeartbeatWatcher(store, timeout_s=timeout, clock=lambda: t[0])
+    return hb, store, inst, t
+
+
+def test_heartbeat_timeout_still_fires_on_silent_task():
+    fired = []
+    hb, store, inst, t = mkhb()
+    hb.on_timeout = fired.append
+    hb.track(inst.task_id)
+    t[0] = 6.0
+    assert hb.check() == [inst.task_id] == fired
+    assert inst.status == InstanceStatus.FAILED
+    assert inst.reason_code == 3000
+
+
+def test_heartbeat_terminal_state_wins_over_expiry():
+    """A completion that lands before check() must survive: the 3000
+    write is dropped by the store's transition machine and the watcher
+    reports nothing — instance history stays monotone."""
+    fired = []
+    hb, store, inst, t = mkhb()
+    hb.on_timeout = fired.append
+    hb.track(inst.task_id)
+    store.update_instance(inst.task_id, InstanceStatus.SUCCESS,
+                          reason_code=1003)
+    t[0] = 6.0                       # deadline long past
+    assert hb.check() == []
+    assert fired == []
+    assert inst.status == InstanceStatus.SUCCESS
+    assert inst.reason_code == 1003  # reason NOT rewritten to 3000
+    # deadline dropped: a later check can't resurrect the expiry
+    assert hb.check() == []
+
+
+def test_heartbeat_race_completion_lands_mid_check(monkeypatch):
+    """The actual race: the task completes BETWEEN check()'s expiry
+    snapshot and its 3000 write. The store must keep the terminal
+    status and the watcher must not report (or fire on_timeout for) a
+    task that did not time out."""
+    fired = []
+    hb, store, inst, t = mkhb()
+    hb.on_timeout = fired.append
+    hb.track(inst.task_id)
+    t[0] = 6.0
+    real_get = store.get_instance
+    raced = []
+
+    def racing_get(task_id):
+        out = real_get(task_id)
+        if not raced:
+            raced.append(task_id)
+            # a status POST wins the race right after the snapshot read
+            store.update_instance(task_id, InstanceStatus.SUCCESS,
+                                  reason_code=1003)
+        return out
+
+    monkeypatch.setattr(store, "get_instance", racing_get)
+    assert hb.check() == []
+    assert fired == []
+    assert inst.status == InstanceStatus.SUCCESS
+    assert inst.reason_code == 1003
+
+
+def test_heartbeat_notify_between_snapshot_and_write_keeps_task():
+    """A heartbeat landing after the expiry snapshot re-arms the
+    deadline; the candidate loop's re-check under the lock must skip
+    the task entirely."""
+    hb, store, inst, t = mkhb()
+    hb.track(inst.task_id)
+    t[0] = 6.0
+    real_get = store.get_instance
+    raced = []
+
+    def racing_get(task_id):
+        out = real_get(task_id)
+        if not raced:
+            raced.append(task_id)
+            hb.notify(task_id)       # fresh heartbeat mid-check
+        return out
+
+    hb.store.get_instance = racing_get
+    try:
+        assert hb.check() == []
+    finally:
+        hb.store.get_instance = real_get
+    assert inst.status == InstanceStatus.RUNNING
+
+
+# -- circuit breaker: single half-open probe (satellite) ---------------
+def test_breaker_half_open_admits_exactly_one_probe():
+    t = [0.0]
+    ledger = []
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                        clock=lambda: t[0],
+                        on_transition=lambda o, n: ledger.append((o, n)))
+    assert br.allow()
+    br.record_failure()
+    assert br.state == OPEN and not br.allow()
+    t[0] = 6.0                       # reset timeout served: HALF_OPEN
+    assert br.state == HALF_OPEN
+
+    # N concurrent callers race for the probe slot; losers must be
+    # refused IMMEDIATELY (allow() never blocks)
+    n = 8
+    results = []
+    rlock = threading.Lock()
+    barrier = threading.Barrier(n)
+
+    def prober():
+        barrier.wait()
+        ok = br.allow()
+        with rlock:
+            results.append(ok)
+
+    threads = [threading.Thread(target=prober) for _ in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=5)
+    assert results.count(True) == 1, \
+        f"half-open admitted {results.count(True)} probes"
+    assert results.count(False) == n - 1
+
+    br.record_success()              # the probe reports back healthy
+    assert br.state == CLOSED and br.allow()
+    # exactly one open -> half_open -> closed cycle in the ledger
+    assert ledger == [(CLOSED, OPEN), (HALF_OPEN, CLOSED)]
+    assert br.trips == 1
+
+
+def test_breaker_half_open_probe_failure_reopens_full_timeout():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                        clock=lambda: t[0])
+    br.record_failure()
+    t[0] = 6.0
+    assert br.allow()                # probe admitted
+    br.record_failure()              # probe failed
+    assert br.state == OPEN
+    t[0] = 10.0                      # only 4s since re-open: still shut
+    assert not br.allow()
+    t[0] = 11.5
+    assert br.allow()                # next probe after a FULL timeout
+
+
+# -- novel-host vs mea-culpa launch-ack timeouts -----------------------
+
+def test_novel_host_skips_launch_ack_timeout_instances():
+    """A 5003 launch-ack-timeout never ran the command on the host, so
+    it must not join the job's novel-host exclusion set — otherwise two
+    coordinator crashes mid-launch on a two-host cluster leave the job
+    forbidden everywhere and stuck in `waiting` forever (reproduced by
+    the crash soak's F-group-commit schedule)."""
+    from cook_tpu.scheduler.constraints import (build_forbidden,
+                                                explain_forbidden)
+    from cook_tpu.state.model import (Instance, InstanceStatus, Job,
+                                      new_uuid)
+
+    job = Job(uuid=new_uuid(), user="u", command="true", mem=64, cpus=1)
+    for host, reason in (("h0", 5003), ("h1", 5003), ("h2", 5000)):
+        job.instances.append(Instance(
+            task_id=new_uuid(), job_uuid=job.uuid, hostname=host,
+            status=InstanceStatus.FAILED, reason_code=reason))
+    names = ["h0", "h1", "h2"]
+    forb = build_forbidden([job], names, [{}, {}, {}])
+    # only the genuine host-lost (5000) host is excluded
+    assert forb[0].tolist() == [False, False, True]
+    named = explain_forbidden(job, names, [{}, {}, {}])
+    assert named["novel-host"].tolist() == [False, False, True]

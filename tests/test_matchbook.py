@@ -165,3 +165,22 @@ def test_forget_releases_constraint_value_strings():
     j3 = mkjob(constraints=[("rack", "EQUALS", "r0")])
     got = fb.fill([j3], ["h0", "h1"], [{"rack": "r0"}, {"rack": "r1"}])
     assert got[0].tolist() == [False, True]
+
+
+def test_launch_ack_timeout_not_a_prior_host_native_parity():
+    # a 5003 launch-ack-timeout must not feed the native prior-host set
+    # either — numpy and native paths stay bit-identical on the 5003
+    # exemption (Instance.counts_for_novel_host)
+    fb = NativeForbiddenBuilder.create()
+    job = mkjob()
+    job.instances.append(Instance(
+        task_id=new_uuid(), job_uuid=job.uuid, hostname="h0",
+        status=InstanceStatus.FAILED, reason_code=5003))
+    job.instances.append(Instance(
+        task_id=new_uuid(), job_uuid=job.uuid, hostname="h1",
+        status=InstanceStatus.FAILED, reason_code=5000))
+    names, attrs = ["h0", "h1", "h2"], [{}, {}, {}]
+    ref = build_forbidden([job], names, attrs)
+    got = fb.fill([job], names, attrs)
+    np.testing.assert_array_equal(got, ref)
+    assert got[0].tolist() == [False, True, False]
