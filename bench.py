@@ -1673,6 +1673,62 @@ def bench_day_soak():
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_failover():
+    """Leader-failover MTTR at soak magnitude (the measurement half of
+    tests/test_federation_soak.py): an HA pair over one durable store,
+    three SIGKILLs of whoever leads, kill -> takeover-visible timed per
+    transition (epoch minted + gates open on the survivor). Reports
+    max/median MTTR as one JSON line; non-zero exit when any takeover
+    breaches the regression ceiling, a gate evidence check fails, or
+    the stale-epoch fence proof does not hold."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from tests.fedsoak import run_failover_soak
+
+    CEILING_MS = 20_000.0
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 31
+    tmp = Path(tempfile.mkdtemp(prefix="cook_failover_"))
+    try:
+        t0 = time.monotonic()
+        r = run_failover_soak(tmp / "store", seed, jobs=24, agents=3,
+                              window_s=10.0, wall_s=240.0, kills=3,
+                              partitions=1)
+        wall_s = time.monotonic() - t0
+        mttrs = sorted(t["mttr_ms"] for t in r["transitions"]
+                       if t["action"] == "leader_kill")
+        completed = sum(1 for j in r["jobs"].values()
+                        if j.status == "completed")
+        fence = r["stale_fence"]
+        ok = (not r["violations"]
+              and len(mttrs) == 3
+              and mttrs[-1] <= CEILING_MS
+              and completed == r["expected_jobs"]
+              and bool(fence.get("rejected")))
+        print(json.dumps({
+            "metric": "leader failover MTTR, kill -> takeover visible",
+            "value": mttrs[-1] if mttrs else None,
+            "unit": f"ms worst of {len(mttrs)} takeovers "
+                    f"(ceiling {CEILING_MS:.0f})",
+            "ok": ok,
+            "seed": seed,
+            "wall_s": round(wall_s, 1),
+            "mttr_ms_median": mttrs[len(mttrs) // 2] if mttrs else None,
+            "mttr_ms_all": mttrs,
+            "epochs": r["epochs"],
+            "violations": r["violations"],
+            "stale_fence": fence,
+            "completed": completed,
+            "expected_jobs": r["expected_jobs"],
+        }), flush=True)
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        if not os.environ.get("CHAOS_ARTIFACTS_DIR"):
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_pallas():
     """Real-TPU A/B of the Pallas kernels vs the XLA lowering (VERDICT
     r2 #2: prove a win or drop it): the batched headline cycle (dense
@@ -1804,6 +1860,11 @@ def main():
         # burst arrivals + transport chaos + SIGKILLs + fleet churn at
         # once; optional argv[2] = seed (default 101)
         bench_day_soak()
+    elif which == "failover":
+        # leader-failover MTTR over a live HA pair: three leader
+        # SIGKILLs, kill -> takeover-visible per transition, with the
+        # stale-epoch fence proof; optional argv[2] = seed (default 31)
+        bench_failover()
     elif which == "launch":
         # launch-pipeline economics: group-commit fsync amortization
         # under concurrent lanes (the e2e-perf-smoke CI floor) + the
@@ -1818,7 +1879,7 @@ def main():
                          "longevity "
                          "longevity-async trace-overhead "
                          "decision-overhead chaos-overhead "
-                         "crash-soak day-soak launch pallas")
+                         "crash-soak day-soak failover launch pallas")
 
 
 if __name__ == "__main__":

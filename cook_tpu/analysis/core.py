@@ -22,7 +22,7 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 
 # which rule families run over which package subdirectories when
 # scanning a tree (explicit file arguments get every AST rule)
@@ -36,6 +36,7 @@ RULE_DIRS = {
            "integrations", "plugins", "obs"),
     "R7": ("scheduler", "rest", "backends", "agent", "plugins", "obs",
            "state", "utils", "integrations"),
+    "R8": ("state",),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -166,12 +167,13 @@ def diff_baseline(findings: list[Finding], baseline: dict[str, int]
 
 def analyze_source(source: str, path: str,
                    rules: Iterable[str] = ("R1", "R2", "R3", "R5", "R6",
-                                           "R7"),
+                                           "R7", "R8"),
                    apply_suppressions: bool = True) -> list[Finding]:
     """Run the per-module AST rules over one source text."""
-    from cook_tpu.analysis import (async_hygiene, lock_discipline,
-                                   metrics_discipline, retry_discipline,
-                                   span_discipline, trace_purity)
+    from cook_tpu.analysis import (async_hygiene, epoch_discipline,
+                                   lock_discipline, metrics_discipline,
+                                   retry_discipline, span_discipline,
+                                   trace_purity)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -192,6 +194,8 @@ def analyze_source(source: str, path: str,
         findings += retry_discipline.check(mod)
     if "R7" in rules:
         findings += metrics_discipline.check(mod)
+    if "R8" in rules:
+        findings += epoch_discipline.check(mod)
     if apply_suppressions:
         sup = collect_suppressions(source)
         findings = [f for f in findings if not suppressed(f, sup)]

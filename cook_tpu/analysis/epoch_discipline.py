@@ -1,0 +1,83 @@
+"""R8: epoch-fence discipline for the durable event log.
+
+The epoch-fenced failover design (docs/robustness.md) holds only if
+every durable append is checked against the epoch ledger: a deposed
+leader's write must raise ``StaleEpochError`` BEFORE the bytes reach
+the shared log. ``state/store.py`` funnels that guarantee through
+exactly two chokepoints — ``_append_raw`` and ``_append_raw_many`` —
+which run the leadership gate and ``_fence_stale_epoch()`` ahead of
+the writer call.
+
+R8 pins the funnel shape at the AST level: inside ``state/store.py``,
+a call to ``<anything>._log.append(...)`` or
+``<anything>._log.append_many(...)`` outside those two functions is a
+fence bypass — a code path that could commit a superseded leader's
+record.  (A writer aliased into a local first, ``w = self._log``, is
+only reachable inside the chokepoints today; the rule is receiver-name
+based and deliberately cheap, the same trade R7 makes.)
+
+The rule is scoped to the store module: ``_log`` attributes elsewhere
+in the tree are unrelated.
+"""
+from __future__ import annotations
+
+import ast
+
+from cook_tpu.analysis.core import Finding, ModuleInfo
+
+# the only functions allowed to touch the writer directly — both run
+# the append gate + _fence_stale_epoch before the writer call
+_CHOKEPOINTS = frozenset(("_append_raw", "_append_raw_many"))
+
+_APPENDS = frozenset(("append", "append_many"))
+
+_MSG = ("direct event-log append bypasses the epoch fence — route "
+        "through _append_raw/_append_raw_many (they run the "
+        "leadership gate and _fence_stale_epoch first)")
+
+
+def _enclosing_function(parents: dict, node: ast.AST) -> str:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = parents.get(cur)
+    return ""
+
+
+def _symbol(parents: dict, node: ast.AST) -> str:
+    names = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(names))
+
+
+def check(mod: ModuleInfo) -> list[Finding]:
+    norm = mod.path.replace("\\", "/")
+    if not norm.endswith("state/store.py"):
+        return []
+    findings: list[Finding] = []
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # <recv>._log.append(...) / .append_many(...)
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _APPENDS
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "_log"):
+            continue
+        if _enclosing_function(parents, node) in _CHOKEPOINTS:
+            continue
+        findings.append(Finding("R8", mod.path, node.lineno,
+                                _symbol(parents, node), _MSG))
+    return findings

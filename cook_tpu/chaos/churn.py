@@ -34,13 +34,23 @@ import random
 from dataclasses import dataclass, field
 
 SITE = "agent.churn"
+LEADER_SITE = "leader.churn"
 
 KILL = "kill"
 RESTART = "restart"
 FLAP = "flap"
 PARTITION = "partition"
 
+# coordinator-tier faults (the federation soak's schedule): a LEADER
+# process is SIGKILLed mid-flight (the standby must take over behind
+# the epoch fence), or a coordinator<->coordinator link is cut — the
+# process freezes for ``down_s`` (SIGSTOP/SIGCONT in the harness),
+# modelling a partitioned-but-alive leader whose sockets stay open
+LEADER_KILL = "leader_kill"
+LEADER_PARTITION = "leader_partition"
+
 ACTIONS = (KILL, RESTART, FLAP, PARTITION)
+LEADER_ACTIONS = (LEADER_KILL, LEADER_PARTITION)
 
 
 @dataclass(frozen=True)
@@ -64,13 +74,14 @@ class ChurnSchedule:
     seed: int
     duration_s: float
     events: list = field(default_factory=list)
+    site: str = SITE
 
     def save(self, path: str) -> int:
         """JSONL artifact (one event per line), the save_events shape."""
         with open(path, "w") as f:
             f.write(json.dumps({"seed": self.seed,
                                 "duration_s": self.duration_s,
-                                "site": SITE}) + "\n")
+                                "site": self.site}) + "\n")
             for ev in self.events:
                 f.write(json.dumps(ev.as_dict(),
                                    separators=(",", ":")) + "\n")
@@ -126,3 +137,34 @@ def generate_churn(seed: int, hostnames: list, duration_s: float,
                 action=KILL, hostname=hostname))
     events.sort(key=lambda e: (e.t_s, e.hostname))
     return ChurnSchedule(seed=seed, duration_s=duration_s, events=events)
+
+
+def generate_leader_churn(seed: int, duration_s: float,
+                          kills: int = 2, partitions: int = 1,
+                          partition_down_s: tuple = (0.5, 2.0),
+                          min_gap_s: float = 3.0) -> ChurnSchedule:
+    """Deterministic coordinator-tier churn for the federation soak:
+    ``kills`` SIGKILLs of WHOEVER leads at fire time (the harness
+    resolves the target from the lock file, so the schedule names the
+    role, not a process) and ``partitions`` freeze windows of the
+    current leader. Events are spaced at least ``min_gap_s`` apart so
+    every takeover's MTTR is measured from a settled fleet, and sorted
+    so the whole schedule is a pure function of (seed, duration)."""
+    rng = random.Random(f"{seed}:{LEADER_SITE}")
+    events: list[ChurnEvent] = []
+    n = kills + partitions
+    span = max(duration_s - 0.1 * duration_s, min_gap_s * max(n, 1))
+    slots = sorted(rng.uniform(0.1 * duration_s,
+                               0.1 * duration_s + span)
+                   for _ in range(n))
+    for i in range(1, len(slots)):     # enforce the settle gap
+        slots[i] = max(slots[i], slots[i - 1] + min_gap_s)
+    actions = [LEADER_KILL] * kills + [LEADER_PARTITION] * partitions
+    rng.shuffle(actions)
+    for t, action in zip(slots, actions):
+        down = rng.uniform(*partition_down_s) \
+            if action == LEADER_PARTITION else 0.0
+        events.append(ChurnEvent(t_s=t, action=action,
+                                 hostname="leader", down_s=down))
+    return ChurnSchedule(seed=seed, duration_s=duration_s, events=events,
+                         site=LEADER_SITE)

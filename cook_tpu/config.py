@@ -303,6 +303,12 @@ class Settings:
     optimizer: dict = field(default_factory=dict)
     data_locality: dict = field(default_factory=dict)
     # {fetcher: "pkg.mod:factory", weight: 0.25, batch_size: 500}
+    # federated per-pool control plane (scheduler/federation.py):
+    # {"group": "blue",
+    #  "groups": {"blue": {"pools": [...], "url": "http://..."}, ...},
+    #  "exchange_interval_s": 2.0, "global_quota": false}
+    # Empty = single-group federation owning every pool.
+    federation: dict = field(default_factory=dict)
     # cluster-wide default-checkpoint-config (config/kubernetes
     # :default-checkpoint-config): merged under each job's checkpoint
     # config by the matcher and the kube backend

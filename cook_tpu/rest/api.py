@@ -186,8 +186,13 @@ class CookApi:
                 if blocked is not None:
                     return blocked
             elif path not in ("/info", "/debug", "/debug/flight",
-                              "/debug/decisions",
-                              "/metrics"):  # conditional-auth-bypass
+                              "/debug/decisions", "/metrics",
+                              # peer-leader machine channel: read-only
+                              # per-user aggregates for the cross-shard
+                              # DRU exchange (same sensitivity class as
+                              # the /metrics exposition)
+                              "/federation/usage"):
+                # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)
             if method in ("POST", "PUT", "DELETE") \
                     and not path.startswith("/agents"):
@@ -204,11 +209,7 @@ class CookApi:
             # the store's write fence closed between the gate check and
             # the transaction (deposed mid-request): same answer as the
             # gate, so clients fail over instead of seeing a 409/500
-            elector = getattr(self, "leader_elector", None)
-            return Response(503, {
-                "error": "not leader",
-                "leader": (elector.current_leader() if elector else None)
-                or self.leader_url})
+            return self._not_leader()
         except AuthError as e:
             return Response(e.status, {"error": e.message})
         except ApiError as e:
@@ -229,17 +230,39 @@ class CookApi:
         daemons rotate away on the hint."""
         del agent_channel  # same policy both channels; kept for intent
         if getattr(self, "api_only", False):
-            return Response(503, {"error": "not leader",
-                                  "leader": self.leader_url})
+            return self._not_leader()
         elector = getattr(self, "leader_elector", None)
         if elector is None:
             return None
         ready = getattr(self, "leader_ready", None)
         if elector.is_leader() and (ready is None or ready.is_set()):
             return None
-        return Response(503, {
-            "error": "not leader",
-            "leader": elector.current_leader() or self.leader_url})
+        return self._not_leader()
+
+    def _leader_hint(self) -> Optional[str]:
+        """Best current-leader address for a rejected write, falling
+        back through elector.current_leader() -> configured leader_url
+        -> None. Mid-campaign the elector knows no leader yet and used
+        to hand clients None (or this very node) as the hint — a dead
+        end; the configured HA-service address at least resolves once
+        the election settles."""
+        elector = getattr(self, "leader_elector", None)
+        hint = None
+        if elector is not None:
+            try:
+                hint = elector.current_leader()
+            except Exception:
+                hint = None
+        return hint or self.leader_url or None
+
+    def _not_leader(self) -> Response:
+        """The one not-leader answer, on BOTH the agent and client
+        channels: 503 + best-effort leader hint + Retry-After so a
+        client with no usable hint (mid-election) backs off instead of
+        hammering."""
+        return Response(503, {"error": "not leader",
+                              "leader": self._leader_hint()},
+                        headers={"Retry-After": "1"})
 
     def _build_router(self) -> Router:
         r = Router()
@@ -286,6 +309,9 @@ class CookApi:
         r.add("GET", "/data-local", self.data_local_status)
         r.add("GET", "/data-local/:uuid", self.data_local_costs)
         r.add("GET", "/metrics", self.get_metrics)
+        # federated control plane: peers poll each other's per-user
+        # usage aggregates for the slow-cadence DRU exchange
+        r.add("GET", "/federation/usage", self.federation_usage)
         r.add("GET", "/rebalancer", self.get_rebalancer_params)
         r.add("POST", "/rebalancer", self.set_rebalancer_params)
         # network-agent control plane (the framework-message channel of
@@ -300,6 +326,15 @@ class CookApi:
         r.add("GET", "/openapi.json", self.get_openapi)
         r.add("GET", "/swagger-docs", self.get_openapi)
         return r
+
+    def federation_usage(self, req: Request) -> Response:
+        """Per-user running-usage aggregates for the pools THIS leader
+        group owns (scheduler/federation.py ShareExchange polls peers
+        here). 404 when no federation host is attached."""
+        fed = getattr(self, "federation", None)
+        if fed is None:
+            raise ApiError(404, "federation not configured")
+        return Response(200, fed.usage_snapshot())
 
     def get_openapi(self, req: Request) -> Response:
         """OpenAPI 3.0 description of every served route."""
@@ -440,6 +475,19 @@ class CookApi:
                 raise ApiError(400, f"pool {pool_name} is not accepting "
                                     "job submissions")
             pool_name = self.pools.resolve(pool_name)
+        # federated ingest routing: a submission for a pool another
+        # leader group owns must land in THAT group's store (this
+        # leader's cycles never serve the pool, so accepting here would
+        # ack a job nothing schedules). Same contract as not-leader:
+        # 503 + the owning leader's address + Retry-After.
+        fed = getattr(self, "federation", None)
+        if fed is not None and pool_name and not fed.owns(pool_name):
+            return Response(503, {
+                "error": f"pool {pool_name} owned by another leader "
+                         "group",
+                "leader": fed.owner_url(pool_name)
+                or self._leader_hint()},
+                headers={"Retry-After": "1"})
 
         groups = [self._parse_group(g, req.user)
                   for g in body.get("groups", [])]
@@ -1244,6 +1292,11 @@ class CookApi:
             # shed-ladder state: level, engaged actions, per-signal
             # readings and the recent shed/relax event ring
             body["overload"] = ovl.snapshot()
+        fed = getattr(self, "federation", None)
+        if fed is not None:
+            # federated control plane: pool -> leader-group map, this
+            # group's fencing epoch, last leadership handoff
+            body["federation"] = fed.debug()
         for cluster in (self.coord.clusters.all()
                         if self.coord is not None else []):
             tracker = getattr(cluster, "liveness", None)

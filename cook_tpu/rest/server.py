@@ -215,7 +215,7 @@ def build_scheduler(config, read_only=False):
     from cook_tpu.scheduler.heartbeat import HeartbeatWatcher
     from cook_tpu.scheduler.monitor import StatsMonitor
     from cook_tpu.scheduler.progress import ProgressAggregator
-    from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
+    from cook_tpu.state.limits import RateLimiter, ShareStore
     from cook_tpu.state.pools import DruMode, Pool, PoolRegistry
     from cook_tpu.state.store import JobStore
     from cook_tpu.utils import metrics as metrics_mod
@@ -354,6 +354,25 @@ def build_scheduler(config, read_only=False):
             weight=float(config.data_locality.get("weight", 0.25)),
             batch_size=int(config.data_locality.get("batch_size", 500)))
 
+    # federated per-pool control plane (scheduler/federation.py): with
+    # explicit groups, this process serves ONE group's pools and routes
+    # the rest to peers; without, the degenerate single-group host
+    # still carries the /debug federation block and fencing evidence.
+    from cook_tpu.scheduler.federation import (FederatedQuotaView,
+                                               FederationHost)
+    fcfg = config.federation or {}
+    if fcfg.get("groups"):
+        fed = FederationHost(
+            group=fcfg.get("group", ""),
+            groups=fcfg["groups"],
+            store=store, url=config.url,
+            exchange_interval_s=float(
+                fcfg.get("exchange_interval_s", 2.0)),
+            global_quota=bool(fcfg.get("global_quota", False)))
+    else:
+        fed = FederationHost.single(store=store, url=config.url)
+    quotas = FederatedQuotaView(fed)
+
     s = config.scheduler
     overload = None
     if s.overload_enabled:
@@ -367,7 +386,7 @@ def build_scheduler(config, read_only=False):
             relax_after=s.overload_relax_after)
     coord = Coordinator(
         store, clusters,
-        shares=ShareStore(), quotas=QuotaStore(), pools=pools,
+        shares=ShareStore(), quotas=quotas, pools=pools,
         config=SchedulerConfig(
             max_jobs_considered=s.max_jobs_considered,
             scaleback=s.scaleback,
@@ -393,6 +412,12 @@ def build_scheduler(config, read_only=False):
         checkpoint_defaults=config.checkpoint or None,
         status_shards=s.status_shards,
         overload=overload)
+    coord.federation = fed
+    if fcfg.get("groups"):
+        # only this group's pools get cycle threads; a peer's pools
+        # would be double-scheduled against its shard otherwise. The
+        # single-group host leaves the filter off (exact legacy path).
+        coord.pool_filter = fed.owns
 
     # device-resident match path (scheduler/resident.py): the
     # production DEFAULT, with full feature parity — plugins, data
@@ -412,7 +437,7 @@ def build_scheduler(config, read_only=False):
                 log.warning(
                     "resident_shard_devices=%d but only %d devices "
                     "visible; running single-device", shard_n, len(devs))
-        for p in pools.active():
+        for p in coord.active_pools():
             coord.enable_resident(p.name, synchronous=False,
                                   devices=shard_devs)
 
@@ -483,6 +508,7 @@ def build_scheduler(config, read_only=False):
         submission_rate_limiter=make_rl("user_submit"),
         settings=config.public(), leader_url=config.url,
         ingest=ingest)
+    api.federation = fed
     coord.monitor = monitor
     return store, coord, api
 
@@ -531,17 +557,21 @@ def main(argv=None) -> None:
         log after a successor acquired the lease."""
         if not _still_leader():
             raise RuntimeError("leadership lost before takeover init")
+        t_takeover = time.monotonic()
         # re-replay the shared snapshot+log: the previous leader kept
         # appending after this standby's boot-time restore
         store.reload_from(settings.snapshot_path)
-        # epoch-stamp every log entry with this leadership's lease
-        # transition count: replay drops any entry a stalled PREVIOUS
-        # leader physically appends after this point (the TOCTOU window
-        # the append_gate check-then-append cannot fully close)
+        # durable epoch fence: MINT a monotone fencing epoch in the
+        # <log>.epoch ledger before any post-takeover write. Every log
+        # entry is stamped with it ("ep"), replay drops older-epoch
+        # stragglers, and — the log-level guarantee the in-memory
+        # append_gate cannot give — a deposed leader's next append
+        # stat()s the ledger and rejects with StaleEpochError
+        # (state/store.py _fence_stale_epoch). The elector's lease
+        # transition count, when it has one, floors the mint.
         elector = getattr(api, "leader_elector", None)
-        epoch = getattr(elector, "epoch", 0)
-        if epoch:
-            store.adopt_epoch(epoch)
+        lease_epoch = getattr(elector, "epoch", 0)
+        epoch = store.mint_epoch(owner=settings.url, floor=lease_epoch)
         if not _still_leader():
             raise RuntimeError("leadership lost during takeover replay")
         for cluster in coord.clusters.all():
@@ -569,6 +599,15 @@ def main(argv=None) -> None:
         # cycle (the same tuning the e2e bench measures with)
         apply_gc_discipline()
         api.leader_ready.set()
+        # takeover evidence + the cross-shard usage exchange: the gates
+        # are open, so the failover clock stops here (kill -> first
+        # acceptable write is what the soak and bench.py failover
+        # actually measure end to end; this is the in-process share)
+        fed = getattr(api, "federation", None)
+        if fed is not None:
+            fed.record_takeover(
+                epoch, (time.monotonic() - t_takeover) * 1e3)
+            fed.start_exchange()
 
         if agentish and reconcile_s > 0:
             def reconcile_thread():

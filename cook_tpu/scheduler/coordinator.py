@@ -24,7 +24,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -210,6 +210,13 @@ class Coordinator:
         self._reconcile_done.set()
         self._reconcile_deadline = 0.0
         self.last_restart_reconcile: dict = {}
+        # federation pool ownership: when set (scheduler/federation.py
+        # FederationHost.owns), the per-pool cycle threads started by
+        # run() only drive pools this leader owns — the other groups'
+        # pools belong to their own leaders, and matching them here
+        # would double-schedule against a peer's shard. None = own
+        # everything (single-coordinator deployments, tests).
+        self.pool_filter: Optional[Callable[[str], bool]] = None
         self.metrics: dict[str, float] = {}
         # per-consume phase records (bounded; appended by whichever
         # thread runs _consume_cycle). This is the raw material for a
@@ -2333,17 +2340,30 @@ class Coordinator:
             return True
         return False
 
-    def reconcile_restart(self) -> dict:
+    def reconcile_restart(self, pools=None) -> dict:
         """Resolve UNKNOWN instances against a live-agent census (see
         block comment above). Always releases the match gate, even on
         an unexpected census failure — a broken reconcile pass must
-        degrade to watchdog-paced recovery, not a frozen scheduler."""
+        degrade to watchdog-paced recovery, not a frozen scheduler.
+
+        pools: restrict the census to jobs in these pools (a federated
+        takeover acquired ONE group's pools and must not settle
+        instances a peer leader still owns); None = all pools. When
+        the coordinator carries a federation pool_filter and pools is
+        None, the filter scopes the census the same way."""
         adopted, requeued, folded = [], [], []
         unknown: list[str] = []
+        if pools is not None:
+            owned = set(pools).__contains__
+        elif self.pool_filter is not None:
+            owned = self.pool_filter
+        else:
+            owned = None
         try:
             unknown = [inst.task_id
                        for job in list(self.store.jobs.values())
                        if job.state == JobState.RUNNING
+                       and (owned is None or owned(job.pool))
                        for inst in job.active_instances
                        if inst.status == InstanceStatus.UNKNOWN]
             report = {"unknown": len(unknown), "adopted": adopted,
@@ -2409,6 +2429,15 @@ class Coordinator:
                 "requeued": list(requeued), "folded": list(folded)}
             self._reconcile_done.set()
 
+    def active_pools(self):
+        """The pools this coordinator's cycle threads drive: the
+        registry's active set, narrowed by the federation ownership
+        filter when one is installed."""
+        pools = self.pools.active()
+        if self.pool_filter is None:
+            return pools
+        return [p for p in pools if self.pool_filter(p.name)]
+
     # ------------------------------------------------------------------
     # production mode: timer threads (make-trigger-chans mesos.clj:85-109)
     def run(self, leadership_check=None) -> None:
@@ -2430,7 +2459,7 @@ class Coordinator:
                         if gate is not None and not gate():
                             continue
                         if per_pool:
-                            for p in self.pools.active():
+                            for p in self.active_pools():
                                 fn(p.name)
                         else:
                             fn()

@@ -1,0 +1,283 @@
+"""Federated per-pool control plane: the host layer that promotes the
+`parallel/federation.py` dry-run math into the serving stack.
+
+Cook runs ONE match loop per pool behind HA masters
+(scheduler.clj:1557-1578); this module gives each *leader group* of
+pools its own election, its own store, and its own scheduling cycles,
+so the control plane scales out horizontally while every pool still
+sees exactly the single-coordinator decision sequence:
+
+  - A **group** is a named set of pools served by one leader process
+    (plus standbys) over one shared snapshot+log. The group's election
+    reuses the existing electors (FileLeaderElector / LeaseElector) —
+    one lock path / lease name per group — and its takeover mints a
+    durable fencing epoch in the group store's epoch ledger
+    (state/store.py mint_epoch), runs the PR-6 restart-reconcile
+    census scoped to the group's pools, and only then opens the gates.
+  - **Routing**: the REST front door 503s submissions for pools a peer
+    group owns, hinting the owning leader's address (rest/api.py); the
+    coordinator's per-pool cycle threads are narrowed by
+    Coordinator.pool_filter so this leader never matches a peer's
+    pools.
+  - **Cross-shard DRU reconciliation**: pool-keyed shares/quotas are
+    already shard-local (DRU divisors and quota tensors resolve per
+    (user, pool)), so disjoint ownership reproduces the
+    single-coordinator per-pool decisions exactly — the fleet
+    differential oracle in tests/test_federation.py pins this.
+    ShareExchange adds the slow-cadence piece a split brain of quotas
+    cannot see: each leader publishes per-user usage aggregates for
+    its owned pools (/federation/usage) and folds what peers report
+    into FederatedQuotaView, so a DEFAULT-keyed (blanket) quota can
+    bind globally. The fold is opt-in (`global_quota: true`): a
+    single coordinator enforces quota per pool independently, and the
+    default keeps the federation byte-equal to it.
+
+Config (Settings.federation):
+
+    {"group": "blue",
+     "groups": {"blue":  {"pools": ["default"], "url": "http://...:a"},
+                "green": {"pools": ["gpu"],     "url": "http://...:b"}},
+     "exchange_interval_s": 2.0,
+     "global_quota": false}
+
+A process with no federation config still gets a single-group host
+owning every pool (FederationHost.single), so /debug carries the
+federation block and the fencing-epoch evidence in every deployment.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from cook_tpu.state.limits import QuotaStore
+
+log = logging.getLogger(__name__)
+
+
+class FederationHost:
+    """One process's view of the federated control plane: which group
+    it serves, which pools that group owns, where the peer groups
+    live, and the takeover evidence (epoch, transitions, handoff
+    timing) the observability layer surfaces."""
+
+    def __init__(self, group: str, groups: Optional[dict] = None,
+                 store=None, url: str = "",
+                 exchange_interval_s: float = 2.0,
+                 global_quota: bool = False):
+        self.group = group
+        self.groups: dict[str, dict] = dict(groups or {})
+        self.store = store
+        self.url = url
+        self.exchange_interval_s = float(exchange_interval_s)
+        self.global_quota = bool(global_quota)
+        # pool -> owning group name, from the explicit group specs;
+        # pools listed nowhere belong to the LOCAL group (so the
+        # default single-group federation owns everything, and a pool
+        # added at runtime is served rather than blackholed)
+        self._pool_owner: dict[str, str] = {}
+        for name, spec in self.groups.items():
+            for pool in spec.get("pools", ()):
+                self._pool_owner[pool] = name
+        self.transitions = 0
+        self.last_handoff: dict = {}
+        # remote usage fold: peer group -> its last usage snapshot
+        self._remote: dict[str, dict] = {}
+        self._remote_lock = threading.Lock()
+        self._exchange_stop: Optional[threading.Event] = None
+
+    @classmethod
+    def single(cls, store=None, url: str = "") -> "FederationHost":
+        """The degenerate federation every non-federated deployment
+        runs: one group, owning all pools, no peers."""
+        return cls(group="all", groups={}, store=store, url=url)
+
+    # ------------------------------------------------------------------
+    # ownership / routing
+    def owns(self, pool: str) -> bool:
+        return self._pool_owner.get(pool, self.group) == self.group
+
+    def owned_pools(self) -> list[str]:
+        return sorted(p for p, g in self._pool_owner.items()
+                      if g == self.group)
+
+    def owner_url(self, pool: str) -> Optional[str]:
+        """The owning group's leader address (the 503 hint for a
+        misrouted submission); None when we own it / nothing better
+        than the caller's fallback is known."""
+        owner = self._pool_owner.get(pool, self.group)
+        if owner == self.group:
+            return None
+        return self.groups.get(owner, {}).get("url") or None
+
+    def peers(self) -> list[tuple[str, str]]:
+        """[(group, url)] for every OTHER group with an address."""
+        return [(name, spec["url"])
+                for name, spec in sorted(self.groups.items())
+                if name != self.group and spec.get("url")]
+
+    # ------------------------------------------------------------------
+    # takeover evidence (satellite: /debug federation block + metrics)
+    def record_takeover(self, epoch: int, duration_ms: float) -> None:
+        """Called by the server's on_leadership once the gates open:
+        counts the transition, observes the failover duration (the
+        MTTR the soak and bench.py failover bound), and pins the
+        handoff record /debug serves."""
+        from cook_tpu.utils.metrics import registry
+        self.transitions += 1
+        registry.counter("leader_transitions_total",
+                         group=self.group).inc()
+        registry.histogram("failover_duration_ms",
+                           group=self.group).observe(duration_ms)
+        self.last_handoff = {"epoch": epoch,
+                             "t_ms": int(time.time() * 1e3),
+                             "duration_ms": round(duration_ms, 1)}
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.store, "epoch", 0) if self.store else 0
+
+    def debug(self) -> dict:
+        pools = {}
+        names = set(self._pool_owner)
+        if self.store is not None:
+            # pools with live state but no explicit spec: owned locally
+            names |= set(getattr(self.store, "_pending", {}))
+        for pool in sorted(names):
+            owner = self._pool_owner.get(pool, self.group)
+            pools[pool] = {
+                "group": owner,
+                "leader": (self.url if owner == self.group
+                           else self.groups.get(owner, {}).get("url")),
+                "local": owner == self.group}
+        with self._remote_lock:
+            exchange = {g: {"pools": sorted(s.get("pools", {})),
+                            "epoch": s.get("epoch", 0),
+                            "t_ms": s.get("t_ms", 0)}
+                        for g, s in self._remote.items()}
+        return {"group": self.group,
+                "pools": pools,
+                "epoch": self.epoch,
+                "transitions": self.transitions,
+                "last_handoff": dict(self.last_handoff),
+                "exchange": exchange,
+                "global_quota": self.global_quota}
+
+    # ------------------------------------------------------------------
+    # cross-shard usage exchange
+    def usage_snapshot(self) -> dict:
+        """What this leader publishes at /federation/usage: per-user
+        running aggregates for the pools it owns, stamped with its
+        fencing epoch so a peer can drop a deposed leader's stale
+        report."""
+        pools: dict[str, dict] = {}
+        if self.store is not None:
+            owned = self.owned_pools() or \
+                sorted(getattr(self.store, "_usage", {}))
+            for pool in owned:
+                usage = self.store.user_usage(pool)
+                if usage:
+                    pools[pool] = usage
+        return {"group": self.group, "epoch": self.epoch,
+                "t_ms": int(time.time() * 1e3), "pools": pools}
+
+    def fold_remote(self, group: str, snapshot: dict) -> None:
+        """Absorb a peer's usage snapshot. Epoch-monotone per group: a
+        partitioned old leader's report (lower epoch than one already
+        folded) is dropped, the same staleness rule the store applies
+        to log entries."""
+        if not isinstance(snapshot, dict) or group == self.group:
+            return
+        with self._remote_lock:
+            prev = self._remote.get(group)
+            if prev and snapshot.get("epoch", 0) < prev.get("epoch", 0):
+                return
+            self._remote[group] = snapshot
+
+    def remote_usage(self, user: str, pool: str) -> dict:
+        """The user's usage as reported by PEER groups, for the quota
+        fold. {} unless global_quota is on (the default keeps the
+        federation byte-equal to a single coordinator, which enforces
+        quota per pool independently). With it on, the user's total
+        remote usage — every peer, every pool — shrinks the effective
+        quota, so a blanket ceiling binds fleet-wide."""
+        if not self.global_quota:
+            return {}
+        del pool  # blanket fold: the ceiling is global by definition
+        out = {"mem": 0.0, "cpus": 0.0, "gpus": 0.0, "jobs": 0.0}
+        any_usage = False
+        with self._remote_lock:
+            snaps = list(self._remote.values())
+        for snap in snaps:
+            for usage in snap.get("pools", {}).values():
+                u = usage.get(user)
+                if not u:
+                    continue
+                any_usage = True
+                for k in out:
+                    out[k] += float(u.get(k, 0.0))
+        return out if any_usage else {}
+
+    # ------------------------------------------------------------------
+    # exchange transport (leader-only thread; peers poll each other)
+    def start_exchange(self) -> None:
+        if not self.peers() or self._exchange_stop is not None:
+            return
+        stop = self._exchange_stop = threading.Event()
+
+        def poll_once() -> None:
+            for group, url in self.peers():
+                try:
+                    with urllib.request.urlopen(
+                            f"{url}/federation/usage",
+                            timeout=2.0) as resp:
+                        self.fold_remote(
+                            group, json.loads(resp.read().decode()))
+                except Exception:
+                    # a dead / partitioned / mid-failover peer is
+                    # normal life; the last folded snapshot stands
+                    # until its successor reports
+                    continue
+
+        def body() -> None:
+            while not stop.wait(self.exchange_interval_s):
+                poll_once()
+
+        self._poll_once = poll_once   # tests drive one round inline
+        threading.Thread(target=body, daemon=True,
+                         name=f"fed-exchange-{self.group}").start()
+
+    def stop_exchange(self) -> None:
+        if self._exchange_stop is not None:
+            self._exchange_stop.set()
+            self._exchange_stop = None
+
+
+class FederatedQuotaView(QuotaStore):
+    """A QuotaStore whose get() subtracts the usage PEER shards report
+    for the same user, clamped at zero — transparent to
+    tensorize.quota_arrays, so the matcher needs no federation
+    awareness. With the exchange idle (or global_quota off) this is
+    bit-for-bit the base QuotaStore: the fleet differential oracle
+    relies on that."""
+
+    def __init__(self, federation: FederationHost):
+        super().__init__()
+        self._federation = federation
+
+    def get(self, user: str, pool: str) -> dict:
+        q = super().get(user, pool)
+        remote = self._federation.remote_usage(user, pool)
+        if not remote:
+            return q
+        out = {}
+        for k, v in q.items():
+            used = remote.get("jobs" if k == "count" else k, 0.0)
+            # inf stays inf; a finite ceiling already consumed remotely
+            # clamps at zero rather than going negative (quota_arrays
+            # feeds these straight into the device tensors)
+            out[k] = max(0.0, v - used)
+        return out

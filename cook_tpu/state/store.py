@@ -156,6 +156,16 @@ class NotLeaderError(TransactionError):
     + leader hint so clients fail over transparently."""
 
 
+class StaleEpochError(NotLeaderError):
+    """Write rejected by the DURABLE epoch fence: the store's epoch
+    ledger records a fencing epoch newer than this node's, i.e. a
+    successor leader has minted since we last did. Unlike append_gate
+    (an in-memory elector liveness verdict, racy by construction), this
+    verdict is read from disk at append time — a partitioned old leader
+    that still holds open sockets cannot commit after its successor's
+    mint, no matter what its elector thread believes."""
+
+
 class _GroupCommitBarrier:
     """Cross-lane fsync coalescer: leader/follower group commit above a
     single log writer (the transactor-ack amortization the reference
@@ -302,6 +312,14 @@ class JobStore:
         # trimmed + replayed the log. 0 = epochless (single-node dev).
         self.epoch: int = 0
         self._replay_max_epoch = 0
+        # durable epoch ledger (<log>.epoch, append-only JSONL): every
+        # leader acquisition APPENDS a mint record before taking log
+        # authorship, and every write transaction stat()s the ledger —
+        # a newer mint than our own epoch fences the write at append
+        # time (StaleEpochError). (size, mtime_ns) caching keeps the
+        # steady-state cost to one stat per gate check.
+        self._epoch_ledger_stat: Optional[tuple] = None
+        self._epoch_ledger_max: int = 0
         self._log_path = log_path
         self._log = log_writer
         if log_path and log_writer is None:
@@ -410,6 +428,7 @@ class JobStore:
         gate = getattr(self, "append_gate", None)
         if gate is not None and not gate():
             raise NotLeaderError("write fenced: not the leader")
+        self._fence_stale_epoch()
         if chaos.controller.enabled:
             a = chaos.controller.act("store.append")
             if a.kind == "torn":
@@ -446,6 +465,7 @@ class JobStore:
         gate = getattr(self, "append_gate", None)
         if gate is not None and not gate():
             raise NotLeaderError("write fenced: not the leader")
+        self._fence_stale_epoch()
         w = self._log
         if hasattr(w, "append_many"):
             w.append_many(lines)
@@ -477,11 +497,49 @@ class JobStore:
         (inside the store lock, before any in-memory mutation): a
         fenced (deposed or stalled) leader must neither append to the
         shared log nor ack. NotLeaderError maps to HTTP 503 + leader
-        hint, which clients follow."""
+        hint, which clients follow. The durable epoch fence runs here
+        too, so a superseded leader rejects BEFORE mutating in-memory
+        state (the append-time backstop in _append_raw can only reject
+        after)."""
+        if getattr(self, "_replaying", False):
+            return
         gate = getattr(self, "append_gate", None)
-        if gate is not None and not gate() \
-                and not getattr(self, "_replaying", False):
+        if gate is not None and not gate():
             raise NotLeaderError("write fenced: not the leader")
+        self._fence_stale_epoch()
+
+    @property
+    def _epoch_ledger_path(self) -> Optional[str]:
+        return f"{self._log_path}.epoch" if self._log_path else None
+
+    def _fence_stale_epoch(self) -> None:
+        """Durable append-time fence (tentpole of the epoch-fenced
+        failover design, docs/robustness.md): reject the write when the
+        epoch ledger records a mint newer than our own epoch. Cost is
+        one stat() per check; the ledger is only re-read when its
+        (size, mtime_ns) changed — i.e. once per takeover. Epochless
+        stores (epoch 0: in-memory, dev single-node, pre-HA logs) are
+        exempt; the fence arms at the first mint_epoch."""
+        if not self.epoch:
+            return
+        path = self._epoch_ledger_path
+        if not path:
+            return
+        try:
+            st = os.stat(path)
+        except OSError:
+            return
+        key = (st.st_size, st.st_mtime_ns)
+        if key != self._epoch_ledger_stat:
+            self._epoch_ledger_max = _read_epoch_ledger(path)
+            self._epoch_ledger_stat = key
+        if self._epoch_ledger_max > self.epoch:
+            from cook_tpu.obs.metrics import registry as metrics_registry
+            metrics_registry.counter(
+                "stale_epoch_writes_rejected_total").inc()
+            raise StaleEpochError(
+                f"write fenced: epoch {self.epoch} superseded by "
+                f"{self._epoch_ledger_max} in epoch ledger")
 
     def _emit(self, kind: str, data: dict) -> None:
         if getattr(self, "_replaying", False):
@@ -1188,6 +1246,43 @@ class JobStore:
         (a stalled previous leader's late appends then drop at the next
         replay)."""
         self.epoch = max(lease_epoch, self._replay_max_epoch + 1)
+
+    def mint_epoch(self, owner: str = "", floor: int = 0) -> int:
+        """Mint a monotone fencing epoch and PERSIST it in the epoch
+        ledger before taking log authorship — the durable half of the
+        failover fence. Strictly above: any elector lease epoch
+        (floor), our own prior epoch, every epoch seen in replay, and
+        every mint already in the ledger. The ledger append is fsync'd
+        (file + directory) BEFORE this returns, so by the time the new
+        leader's first transaction commits, any deposed leader's next
+        _fence_stale_epoch() stat observes the mint and rejects —
+        combined with the per-record "ep" stamp + replay-side drop,
+        this closes the split-brain window end to end. Returns the
+        minted epoch."""
+        with self._lock:
+            path = self._epoch_ledger_path
+            ledger_max = _read_epoch_ledger(path) if path else 0
+            new = max(floor, self.epoch, self._replay_max_epoch,
+                      ledger_max) + 1
+            if path:
+                rec = json.dumps(
+                    {"epoch": new, "owner": owner, "t": now_ms()},
+                    separators=(",", ":"))
+                fd = os.open(path,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                             0o644)
+                try:
+                    os.write(fd, (rec + "\n").encode("utf-8"))
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+                _fsync_dir(os.path.dirname(os.path.abspath(path)))
+                st = os.stat(path)
+                self._epoch_ledger_stat = (st.st_size, st.st_mtime_ns)
+                self._epoch_ledger_max = new
+            self.epoch = new
+        procfault.kill_point("store.epoch_mint")
+        return new
 
     def log_lines(self) -> int:
         """Lines appended to the current log segment (0 when no log) —
@@ -2301,6 +2396,27 @@ def _read_log_genesis(path: str):
         return ev.get("g") if ev.get("k") == "genesis" else None
     except (OSError, ValueError):
         return None
+
+
+def _read_epoch_ledger(path: str) -> int:
+    """Max epoch recorded in the ledger (0 when missing/empty). The
+    ledger is append-only JSONL; a torn final record (crash mid-mint)
+    is skipped — a mint that never fsync'd never fenced anyone, so the
+    crashed candidate simply re-mints above the last durable entry."""
+    top = 0
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    top = max(top, int(json.loads(line).get("epoch", 0)))
+                except (ValueError, TypeError):
+                    continue
+    except OSError:
+        return 0
+    return top
 
 
 def _fsync_dir(path: str) -> None:

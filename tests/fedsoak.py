@@ -1,0 +1,451 @@
+"""Leader-kill / partition failover soak over an HA coordinator pair.
+
+The day-soak churns the AGENT fleet under one coordinator; this tier
+churns the COORDINATOR tier itself. Two real server processes (an HA
+pair, ``tests.livestack.LiveServer`` with per-member file suffixes)
+share one durable store directory and campaign for a flock lease
+(``FileLeaderElector``). A seeded ``chaos.churn.generate_leader_churn``
+schedule then:
+
+  - ``leader_kill``: SIGKILLs whoever leads at fire time. The standby
+    must acquire the lease, mint a fencing epoch in the durable epoch
+    ledger (``events.log.epoch``), replay, census, and open its gates —
+    the harness measures kill -> takeover-visible as MTTR and then
+    respawns the victim as a standby.
+  - ``leader_partition``: SIGSTOPs the leader for ``down_s`` and
+    SIGCONTs it — a partitioned-but-alive leader whose sockets stay
+    open. The flock is still held, so no takeover happens; the fleet
+    must ride out the stall (clients retry, agents re-deliver).
+
+Traffic runs throughout: agents live in THIS process (launch-count
+evidence survives server kills) and clients submit over the HA pair
+with kill-retry, both following 503 leader hints. After the churn a
+post-wave of submissions guarantees instances are created under the
+post-takeover epoch, so the per-record ``"ep"`` stamps in the shared
+event log span leader generations — the at-most-once-across-epochs
+evidence.
+
+The harness also runs the split-brain proof the whole design exists
+for: a store handle replaying the SHARED log (no writer — it must not
+touch the live leader's file) is given a superseded epoch, exactly the
+view of a deposed leader that never noticed the takeover, and its next
+transaction must raise ``StaleEpochError`` off the fsync'd ledger and
+bump ``stale_epoch_writes_rejected_total``.
+
+Evidence is COLLECTED here and asserted by the caller
+(tests/test_federation_soak.py; ``bench.py failover`` measures the
+MTTR half at full magnitude). Every input schedule and ledger is
+written to $CHAOS_ARTIFACTS_DIR so a red run ships its replay.
+"""
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+import uuid as uuidlib
+
+from cook_tpu.agent.daemon import AgentDaemon
+from cook_tpu.chaos.churn import (LEADER_KILL, LEADER_PARTITION,
+                                  generate_leader_churn)
+from cook_tpu.client import JobClient
+from cook_tpu.sim.gen import generate_trace
+from cook_tpu.state.model import Job, new_uuid
+from cook_tpu.state.store import JobStore, StaleEpochError
+from tests.livestack import LiveServer
+
+READY_BOUND_S = 25.0
+SUBMIT_RETRIES = 20
+
+
+def _read_epoch_ledger(path: str) -> list:
+    """All mint records, in file order; torn final line skipped (same
+    tolerance as the store's reader)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _scan_inst_events(log_path: str) -> list:
+    """Every ``k=="inst"`` record in the shared event log — the durable
+    at-most-once ledger the gates scan: one record per task, stamped
+    with the minting leader's epoch."""
+    out = []
+    try:
+        with open(log_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or '"inst"' not in line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("k") == "inst":
+                    out.append(ev)
+    except OSError:
+        pass
+    return out
+
+
+def run_failover_soak(store_root, seed, tag=None, jobs=8, agents=2,
+                      window_s=6.0, wall_s=90.0, kills=2, partitions=1,
+                      post_jobs=2, churn=True):
+    """One compressed failover day. Returns an evidence dict; asserts
+    nothing. churn=False is the quiet baseline: same pair, same
+    traffic, zero leader faults — exactly one epoch ever minted.
+
+    Full-magnitude nightly parameters (documented here, driven by the
+    CI federation-soak job): jobs=40, window_s=15, wall_s=300,
+    kills=3, partitions=2.
+    """
+    tag = tag or f"fed{seed}"
+    violations: list[str] = []
+    transitions: list[dict] = []
+    launch_counts: dict[str, int] = {}
+    lock_path = os.path.join(str(store_root), "leader.lock")
+    overrides = {"leader_lock_path": lock_path,
+                 "scheduler": {"heartbeat_timeout_s": 6.0}}
+    servers = {
+        "a": LiveServer(store_root, name="a", sites=None, seed=seed,
+                        max_kills=0, overrides=overrides),
+        "b": LiveServer(store_root, name="b", sites=None, seed=seed,
+                        max_kills=0, overrides=overrides),
+    }
+    shared_log = os.path.join(str(store_root), "events.log")
+    ha_urls = ",".join(s.url for s in servers.values())
+
+    def _fed(srv):
+        try:
+            return srv.debug().get("federation", {})
+        except Exception:
+            return {}
+
+    def _leader():
+        """The member whose store epoch matches the newest mint — the
+        federation block is served by standbys too, with their (stale
+        or zero) replayed epoch, so max wins."""
+        best, best_ep = None, 0
+        for name, s in servers.items():
+            ep = _fed(s).get("epoch", 0)
+            if ep > best_ep:
+                best, best_ep = name, ep
+        return best, best_ep
+
+    def _wait_leader(timeout_s=READY_BOUND_S):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            name, ep = _leader()
+            if name is not None:
+                return name, ep
+            time.sleep(0.05)
+        return None, 0
+
+    def make_daemon(host):
+        d = AgentDaemon(ha_urls, hostname=host, mem=4096.0, cpus=8.0,
+                        sandbox_root=str(store_root / f"sbx-{host}"
+                                         / str(time.monotonic_ns())),
+                        heartbeat_interval_s=0.4,
+                        agent_token=LiveServer.AGENT_TOKEN)
+        orig = d.executor.launch
+
+        def counted(task_id, *a, _orig=orig, **kw):
+            launch_counts[task_id] = launch_counts.get(task_id, 0) + 1
+            return _orig(task_id, *a, **kw)
+
+        d.executor.launch = counted
+        return d
+
+    clients: dict[str, JobClient] = {}
+    uuids: list[tuple] = []
+
+    def submit_with_retry(user, priority=50):
+        """Kill-retry submission: a dead or frozen leader mid-submit is
+        the point of this soak. The HA client follows 503 hints; the
+        dedup probe keeps the retry loop at-most-once."""
+        cli = clients.setdefault(
+            user, JobClient(ha_urls, user=user, timeout=5.0))
+        u = str(uuidlib.uuid4())
+        for _ in range(SUBMIT_RETRIES):
+            try:
+                cli.submit(command="sleep 0.4", mem=64.0, cpus=1.0,
+                           uuid=u, priority=priority, max_retries=4)
+                break
+            except Exception:
+                try:
+                    if cli.query_jobs([u]):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+        else:
+            violations.append(f"submit of {u} never landed")
+        uuids.append((u, user))
+
+    schedule = generate_leader_churn(seed, duration_s=window_s + 2.0,
+                                     kills=kills,
+                                     partitions=partitions) \
+        if churn else None
+    stop_evt = threading.Event()
+    frozen_pids: list[int] = []
+
+    def _do_leader_event(ev):
+        name, ep_before = _wait_leader()
+        if name is None:
+            violations.append(f"no leader to {ev.action} at t={ev.t_s}")
+            return
+        victim = servers[name]
+        if ev.action == LEADER_KILL:
+            t0 = time.monotonic()
+            victim.sup.kill()
+            survivor = servers["b" if name == "a" else "a"]
+            ep_after, deadline = 0, time.monotonic() + READY_BOUND_S
+            while time.monotonic() < deadline:
+                f = _fed(survivor)
+                if f.get("epoch", 0) > ep_before and f.get("last_handoff"):
+                    ep_after = f["epoch"]
+                    break
+                if stop_evt.wait(0.05):
+                    break
+            mttr_ms = (time.monotonic() - t0) * 1e3
+            if not ep_after:
+                violations.append(
+                    f"no takeover within {READY_BOUND_S}s after "
+                    f"killing leader {name} (epoch {ep_before})")
+            transitions.append(
+                {"action": LEADER_KILL, "victim": name,
+                 "epoch_before": ep_before, "epoch_after": ep_after,
+                 "mttr_ms": round(mttr_ms, 1)})
+            # the victim rejoins as a standby over the same store dir
+            try:
+                victim.ensure_alive(READY_BOUND_S)
+            except Exception as e:
+                violations.append(f"killed leader {name} failed to "
+                                  f"rejoin as standby: {e}")
+        elif ev.action == LEADER_PARTITION:
+            proc = getattr(victim.sup, "_proc", None)
+            if proc is None or proc.poll() is not None:
+                return
+            os.kill(proc.pid, signal.SIGSTOP)
+            frozen_pids.append(proc.pid)
+            try:
+                stop_evt.wait(ev.down_s)
+            finally:
+                try:
+                    os.kill(proc.pid, signal.SIGCONT)
+                except OSError:
+                    pass
+                frozen_pids.remove(proc.pid)
+            # the flock is still held through the freeze: this must be
+            # a survivable stall, not a takeover. Give the thawed
+            # process a beat to answer /debug again.
+            f, deadline = {}, time.monotonic() + 10.0
+            while not f and time.monotonic() < deadline:
+                f = _fed(victim)
+                if not f and stop_evt.wait(0.1):
+                    break
+            transitions.append(
+                {"action": LEADER_PARTITION, "victim": name,
+                 "down_s": round(ev.down_s, 3),
+                 "epoch_before": ep_before,
+                 "epoch_after": f.get("epoch", 0)})
+            if f.get("epoch", 0) > ep_before:
+                violations.append(
+                    f"partitioned (frozen) leader {name} was deposed: "
+                    f"epoch {ep_before} -> {f['epoch']}; SIGSTOP must "
+                    f"not lose the flock")
+
+    def churn_worker(t0):
+        # sequential on purpose: leader events are min_gap-spaced and
+        # each one must settle before the next resolves "the leader"
+        for ev in schedule.events:
+            if stop_evt.wait(max(0.0, ev.t_s - (time.time() - t0))):
+                return
+            _do_leader_event(ev)
+
+    daemons: list[AgentDaemon] = []
+    jobs_final: dict = {}
+    stale_fence: dict = {}
+    try:
+        servers["a"].start()
+        servers["b"].start()
+        name0, ep0 = _wait_leader()
+        if name0 is None:
+            violations.append("no initial leader elected")
+        for i in range(agents):
+            d = make_daemon(f"{tag}-a{i}")
+            d.start()
+            daemons.append(d)
+
+        # pre-wave: one job must be RUNNING under the initial epoch
+        # before any leader fault fires — with the post-wave below this
+        # pins instances on BOTH sides of every takeover, making the
+        # "ep stamps span leader generations" gate deterministic
+        submit_with_retry("prewave")
+        pre_u = uuids[-1][0]
+        deadline = time.monotonic() + READY_BOUND_S
+        while time.monotonic() < deadline:
+            try:
+                js = clients["prewave"].query_jobs([pre_u])
+                if js and js[0].instances:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        else:
+            violations.append("pre-wave job never got an instance")
+
+        t0 = time.time()
+        churn_t = None
+        if schedule is not None:
+            churn_t = threading.Thread(target=churn_worker, args=(t0,),
+                                       daemon=True)
+            churn_t.start()
+
+        # traffic throughout the churn window, then a post-wave that
+        # pins instances under the final epoch
+        trace = generate_trace(n_jobs=jobs, n_users=3, seed=seed,
+                               submit_window_ms=int(window_s * 1e3))
+        for t in sorted(trace, key=lambda t: t["submit-time-ms"]):
+            delay = t["submit-time-ms"] / 1e3
+            now = time.time() - t0
+            if delay > now:
+                time.sleep(delay - now)
+            submit_with_retry(t["job/user"], t["job/priority"])
+        if churn_t is not None:
+            churn_t.join(timeout=wall_s / 2)
+            if churn_t.is_alive():
+                violations.append("churn schedule did not finish")
+        for i in range(post_jobs):
+            submit_with_retry("postwave")
+
+        def poll():
+            by_user: dict[str, list] = {}
+            for u, user in uuids:
+                by_user.setdefault(user, []).append(u)
+            out = {}
+            for user, us in by_user.items():
+                for j in clients[user].query_jobs(us):
+                    out[j.uuid] = j
+            return out
+
+        deadline = time.time() + wall_s
+        while time.time() < deadline:
+            try:
+                jobs_final = poll()
+            except Exception:
+                time.sleep(0.4)
+                continue
+            if len(jobs_final) == len(uuids) and all(
+                    j.status == "completed"
+                    for j in jobs_final.values()):
+                break
+            time.sleep(0.4)
+
+        # ---- the split-brain proof: a deposed leader's next append ----
+        ledger = _read_epoch_ledger(shared_log + ".epoch")
+        epochs = [r.get("epoch", 0) for r in ledger]
+        if len(epochs) >= 2:
+            stale = epochs[0]
+            from cook_tpu.obs.metrics import registry as metrics
+            ctr = metrics.counter("stale_epoch_writes_rejected_total")
+            before = ctr.value
+            # replay the shared log WITHOUT a writer: this handle is
+            # the deposed leader's view and must never touch the live
+            # leader's file (no trim, no append)
+            h = JobStore.restore(None, log_path=shared_log,
+                                 trim_tail=False, open_writer=False)
+            h.epoch = stale
+            rejected = False
+            try:
+                h.create_jobs([Job(uuid=new_uuid(), user="fence-probe",
+                                   command="true", mem=1.0, cpus=0.1)])
+            except StaleEpochError:
+                rejected = True
+            except Exception as e:
+                violations.append(
+                    f"stale-epoch probe died unexpectedly: {e!r}")
+            stale_fence = {"attempt_epoch": stale,
+                           "ledger_max": max(epochs),
+                           "rejected": rejected,
+                           "counter_delta": ctr.value - before}
+            if not rejected:
+                violations.append(
+                    f"stale-epoch write at epoch {stale} was ACCEPTED "
+                    f"with ledger at {max(epochs)} — fence breached")
+
+        stop_evt.set()
+        inst_events = _scan_inst_events(shared_log)
+        evidence = {
+            "seed": seed,
+            "tag": tag,
+            "violations": violations,
+            "jobs": jobs_final,
+            "expected_jobs": len(uuids),
+            "launch_counts": dict(launch_counts),
+            "transitions": transitions,
+            "epochs": epochs,
+            "epoch_ledger": ledger,
+            "stale_fence": stale_fence,
+            "inst_tasks": [
+                {"task": e.get("task"), "ep": e.get("ep", 0)}
+                for e in inst_events],
+            "churn_events": ([e.as_dict() for e in schedule.events]
+                             if schedule else []),
+            "server_deaths": {n: len(s.sup.deaths)
+                              for n, s in servers.items()},
+            "kill_ledgers": {n: s.kills()
+                             for n, s in servers.items()},
+        }
+        _dump_artifacts(tag, servers, schedule, shared_log, evidence)
+        return evidence
+    finally:
+        stop_evt.set()
+        for pid in list(frozen_pids):
+            try:
+                os.kill(pid, signal.SIGCONT)
+            except OSError:
+                pass
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception:
+                pass
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def _dump_artifacts(tag, servers, schedule, shared_log, evidence):
+    out = os.environ.get("CHAOS_ARTIFACTS_DIR")
+    if not out:
+        return
+    os.makedirs(out, exist_ok=True)
+    if schedule is not None:
+        schedule.save(os.path.join(out, f"fed-{tag}-churn.jsonl"))
+    for name, s in servers.items():
+        for src, dst in ((s.server_log, f"fed-{tag}-server-{name}.log"),
+                         (s.budget_file, f"fed-{tag}-kills-{name}.jsonl")):
+            if os.path.exists(src):
+                shutil.copy(src, os.path.join(out, dst))
+    if os.path.exists(shared_log + ".epoch"):
+        shutil.copy(shared_log + ".epoch",
+                    os.path.join(out, f"fed-{tag}-epoch-ledger.jsonl"))
+    slim = {k: v for k, v in evidence.items() if k != "jobs"}
+    slim["job_statuses"] = {u: j.status
+                           for u, j in evidence["jobs"].items()}
+    with open(os.path.join(out, f"fed-{tag}-evidence.json"), "w") as f:
+        json.dump(slim, f, indent=1)

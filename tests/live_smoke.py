@@ -74,9 +74,13 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
         flight = json.loads(scrape(stack.server.url + "/debug/flight"))
         decisions = json.loads(scrape(
             stack.server.url + "/debug/decisions"))
+        debug = json.loads(scrape(stack.server.url + "/debug"))
 
         with open(os.path.join(artifact_dir, "metrics.txt"), "w") as f:
             f.write(metrics)
+        with open(os.path.join(artifact_dir,
+                               "federation.json"), "w") as f:
+            json.dump(debug.get("federation", {}), f, indent=1)
         with open(os.path.join(artifact_dir, "trace.json"), "w") as f:
             json.dump(trace, f, indent=1)
         with open(os.path.join(artifact_dir, "flight.json"), "w") as f:
@@ -98,6 +102,22 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
         if 'cook_decisions_total{outcome="matched",pool="default"}' \
                 not in metrics:
             failures.append("/metrics missing decision outcome counter")
+        # the federated control plane's operator surface: every
+        # deployment (this one degenerate single-group) exposes its
+        # pool ownership, fencing epoch, and takeover evidence
+        fed = debug.get("federation", {})
+        if not fed.get("group"):
+            failures.append("/debug has no federation block")
+        if fed.get("epoch", 0) < 1:
+            failures.append(
+                f"/debug federation epoch never minted ({fed})")
+        if not fed.get("pools", {}).get("default", {}).get("local"):
+            failures.append(
+                f"/debug federation does not own 'default' ({fed})")
+        if "cook_leader_transitions_total" not in metrics:
+            failures.append("/metrics missing leader transition counter")
+        if "cook_failover_duration_ms" not in metrics:
+            failures.append("/metrics missing failover duration histogram")
         codes = [r.get("code") for r in unsched[0]["reasons"]]
         if "no_host_fit" not in codes:
             failures.append(

@@ -25,6 +25,7 @@ from cook_tpu.rest.api import CookApi
 from cook_tpu.rest.auth import AuthConfig
 from cook_tpu.rest.server import ApiServer
 from cook_tpu.scheduler.coordinator import Coordinator, SchedulerConfig
+from cook_tpu.scheduler.federation import FederationHost
 from cook_tpu.state.limits import QuotaStore, RateLimiter, ShareStore
 from cook_tpu.state.store import JobStore
 
@@ -57,6 +58,17 @@ class Stack:
             auth=AuthConfig(scheme="header", admins={"admin"}),
             submission_rate_limiter=sub_rl)
         self.server = ApiServer(self.api).start()
+        # mirror the real server's wiring (build_scheduler + the
+        # on_leadership epilogue): every deployment runs the degenerate
+        # single-group federation, mints an epoch, and records the
+        # initial takeover — so /debug carries a federation block and
+        # /metrics the failover families
+        self.federation = FederationHost.single(store=self.store,
+                                                url=self.server.url)
+        self.coord.federation = self.federation
+        self.api.federation = self.federation
+        self.federation.record_takeover(self.store.mint_epoch(
+            owner=self.server.url), 0.0)
         self.admin = JobClient(self.server.url, user="admin")
 
     def client(self, user):
@@ -102,8 +114,13 @@ class LiveServer:
     AGENT_TOKEN = "livestack-secret"
 
     def __init__(self, store_dir, sites=None, seed=0, max_kills=2,
-                 overrides=None):
+                 overrides=None, name=None):
+        """``name`` suffixes the per-process files (config, kill
+        budget, server log) so an HA PAIR can share one store_dir —
+        the durable snapshot+log stay shared (that's the point of the
+        pair) while each member keeps its own supervisor evidence."""
         self.store_dir = str(store_dir)
+        self.name = name
         os.makedirs(self.store_dir, exist_ok=True)
         self.port = free_port()
         self.url = f"http://127.0.0.1:{self.port}"
@@ -129,11 +146,15 @@ class LiveServer:
                           "status_shards": 0},
         }
         _merge(cfg, overrides or {})
-        self.config_path = os.path.join(self.store_dir, "config.json")
+        sfx = f"-{name}" if name else ""
+        self.config_path = os.path.join(self.store_dir,
+                                        f"config{sfx}.json")
         with open(self.config_path, "w") as f:
             json.dump(cfg, f, indent=1)
-        self.budget_file = os.path.join(self.store_dir, "kills.jsonl")
-        self.server_log = os.path.join(self.store_dir, "server.log")
+        self.budget_file = os.path.join(self.store_dir,
+                                        f"kills{sfx}.jsonl")
+        self.server_log = os.path.join(self.store_dir,
+                                       f"server{sfx}.log")
         self.sup = procfault.ServerSupervisor(
             self.config_path, self.url, sites=sites, seed=seed,
             max_kills=max_kills, budget_file=self.budget_file,

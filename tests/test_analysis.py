@@ -652,6 +652,55 @@ def test_r7_suppression():
     assert fs == []
 
 
+# ----------------------------------------------------------------------
+# R8 epoch-fence discipline (state/store.py append chokepoints)
+
+_STORE_PATH = "cook_tpu/state/store.py"
+
+
+def test_r8_direct_append_outside_chokepoint_flagged():
+    fs = run("""
+        class JobStore:
+            def sneak(self, line):
+                self._log.append(line)
+
+            def sneak_many(self, lines):
+                self._log.append_many(lines)
+    """, rules=("R8",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R8", "R8"]
+    assert all("epoch fence" in f.message for f in fs)
+
+
+def test_r8_chokepoints_and_other_modules_exempt():
+    # the two fenced chokepoints are the allowed writer call sites
+    src = """
+        class JobStore:
+            def _append_raw(self, line):
+                self._log.append(line)
+
+            def _append_raw_many(self, lines):
+                self._log.append_many(lines)
+    """
+    assert run(src, rules=("R8",), path=_STORE_PATH) == []
+    # an unrelated _log attribute elsewhere in the tree is not a fence
+    bypass = """
+        class Thing:
+            def push(self, line):
+                self._log.append(line)
+    """
+    assert run(bypass, rules=("R8",),
+               path="cook_tpu/state/other.py") == []
+
+
+def test_r8_suppression():
+    fs = run("""
+        class JobStore:
+            def recover(self, line):
+                self._log.append(line)  # cookcheck: disable=R8
+    """, rules=("R8",), path=_STORE_PATH)
+    assert fs == []
+
+
 def test_syntax_error_reports_r0():
     fs = analyze_source("def broken(:\n", "bad.py")
     assert rules_of(fs) == ["R0"]

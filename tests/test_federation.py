@@ -1,0 +1,509 @@
+"""Federated per-pool control plane + durable epoch fencing.
+
+Three layers under test:
+
+- the store's durable epoch ledger (``<log>.epoch``): mint_epoch
+  monotonicity across handles, append-time StaleEpochError for a
+  deposed leader, torn-ledger-line tolerance, and the epochless
+  exemption for single-node dev stores;
+- the FederationHost (scheduler/federation.py): pool ownership /
+  routing, the epoch-monotone cross-shard usage fold, takeover
+  evidence, and the FederatedQuotaView transparency contract;
+- the REST surface: the one not-leader answer (503 + leader hint +
+  Retry-After) on BOTH channels, federated ingest routing, the /debug
+  federation block, and /federation/usage;
+
+plus the fleet differential oracle: the same trace through a 2-leader
+federation (disjoint pool ownership) and through one single
+coordinator must produce byte-identical matched sets and per-pool DRU
+orderings — horizontal scale-out must not change a single decision.
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from cook_tpu.backends.base import ClusterRegistry
+from cook_tpu.backends.mock import MockCluster, MockHost
+from cook_tpu.rest.api import CookApi
+from cook_tpu.scheduler.coordinator import Coordinator, SchedulerConfig
+from cook_tpu.scheduler.federation import FederatedQuotaView, FederationHost
+from cook_tpu.state.limits import QuotaStore, ShareStore
+from cook_tpu.state.model import Job, new_uuid
+from cook_tpu.state.pools import Pool, PoolRegistry
+from cook_tpu.state.store import JobStore, StaleEpochError
+from cook_tpu.utils.metrics import registry as metrics_registry
+
+
+def _job(user, pool, mem=100.0, cpus=1.0, priority=50):
+    return Job(uuid=new_uuid(), user=user, command="true", mem=mem,
+               cpus=cpus, priority=priority, pool=pool, max_retries=1)
+
+
+# ----------------------------------------------------------------------
+# durable epoch ledger + append-time fence (state/store.py)
+
+def test_mint_epoch_monotone_and_durable(tmp_path):
+    log = str(tmp_path / "events.log")
+    a = JobStore(log_path=log)
+    assert a.mint_epoch(owner="A") == 1
+    assert a.mint_epoch(owner="A") == 2
+    # a FRESH handle on the same log (a successor that replayed
+    # nothing) still mints above every prior mint: the ledger, not
+    # process memory, is the authority
+    b = JobStore(log_path=log)
+    assert b.mint_epoch(owner="B") == 3
+    recs = [json.loads(l) for l in open(log + ".epoch") if l.strip()]
+    assert [r["epoch"] for r in recs] == [1, 2, 3]
+    assert recs[-1]["owner"] == "B"
+
+
+def test_mint_epoch_respects_lease_floor(tmp_path):
+    log = str(tmp_path / "events.log")
+    s = JobStore(log_path=log)
+    # a LeaseElector's leaseTransitions count floors the mint so the
+    # durable epoch never runs behind the lease's own fencing token
+    assert s.mint_epoch(owner="A", floor=7) == 8
+
+
+def test_stale_epoch_write_rejected_and_counted(tmp_path):
+    log = str(tmp_path / "events.log")
+    a = JobStore(log_path=log)
+    a.mint_epoch(owner="A")
+    a.create_jobs([_job("u", "default")])          # epoch 1: accepted
+
+    b = JobStore(log_path=log)
+    b.mint_epoch(owner="B")                        # fences A durably
+    b.create_jobs([_job("u", "default")])
+
+    before = metrics_registry.counter(
+        "stale_epoch_writes_rejected_total").value
+    with pytest.raises(StaleEpochError):
+        a.create_jobs([_job("u", "default")])      # partitioned old leader
+    after = metrics_registry.counter(
+        "stale_epoch_writes_rejected_total").value
+    assert after == before + 1
+    # and the new leader keeps writing
+    b.create_jobs([_job("u", "default")])
+
+
+def test_torn_ledger_line_tolerated(tmp_path):
+    log = str(tmp_path / "events.log")
+    s = JobStore(log_path=log)
+    s.mint_epoch(owner="A")
+    # a crash mid-mint leaves a torn final line; it was never fsynced
+    # as a complete record, so it never fenced anyone and must not
+    # poison the ledger read
+    with open(log + ".epoch", "a") as f:
+        f.write('{"epoch": 99, "own')
+    assert s.mint_epoch(owner="A") == 2
+    s.create_jobs([_job("u", "default")])          # not fenced by the tear
+
+
+def test_epochless_store_exempt_from_fence(tmp_path):
+    log = str(tmp_path / "events.log")
+    minted = JobStore(log_path=log)
+    minted.mint_epoch(owner="A")
+    # a store that never minted (epoch 0: single-node dev, pre-HA logs,
+    # bare test stores) is exempt from the fence even when a ledger
+    # exists — fencing is opt-in by taking an epoch
+    legacy = JobStore(log_path=log)
+    assert legacy.epoch == 0
+    legacy.create_jobs([_job("u", "default")])
+
+
+# ----------------------------------------------------------------------
+# FederationHost (scheduler/federation.py)
+
+GROUPS = {"blue": {"pools": ["alpha"], "url": "http://blue:1"},
+          "green": {"pools": ["beta"], "url": "http://green:2"}}
+
+
+def test_ownership_and_routing():
+    blue = FederationHost(group="blue", groups=GROUPS, url="http://blue:1")
+    assert blue.owns("alpha")
+    assert not blue.owns("beta")
+    assert blue.owns("gamma")          # unlisted pools stay local
+    assert blue.owned_pools() == ["alpha"]
+    assert blue.owner_url("beta") == "http://green:2"
+    assert blue.owner_url("alpha") is None
+    assert blue.peers() == [("green", "http://green:2")]
+
+
+def test_single_group_owns_everything():
+    fed = FederationHost.single(url="http://solo:1")
+    assert fed.owns("anything")
+    assert fed.peers() == []
+    d = fed.debug()
+    assert d["group"] == "all"
+    assert d["transitions"] == 0
+
+
+def test_fold_remote_is_epoch_monotone():
+    blue = FederationHost(group="blue", groups=GROUPS, global_quota=True)
+    snap5 = {"group": "green", "epoch": 5,
+             "pools": {"beta": {"u": {"mem": 100.0, "cpus": 2.0,
+                                      "gpus": 0.0, "jobs": 1}}}}
+    blue.fold_remote("green", snap5)
+    # a deposed green leader's stale report (lower epoch) is dropped
+    snap3 = {"group": "green", "epoch": 3,
+             "pools": {"beta": {"u": {"mem": 999.0, "cpus": 9.0,
+                                      "gpus": 0.0, "jobs": 9}}}}
+    blue.fold_remote("green", snap3)
+    assert blue.remote_usage("u", "alpha")["mem"] == 100.0
+    # its successor's (higher epoch) replaces
+    snap6 = dict(snap5, epoch=6)
+    snap6["pools"] = {"beta": {"u": {"mem": 50.0, "cpus": 1.0,
+                                     "gpus": 0.0, "jobs": 1}}}
+    blue.fold_remote("green", snap6)
+    assert blue.remote_usage("u", "alpha")["mem"] == 50.0
+    # a host's OWN snapshot never folds (no self-subtraction)
+    blue.fold_remote("blue", snap5)
+    assert "blue" not in blue._remote
+
+
+def test_record_takeover_evidence():
+    fed = FederationHost(group="takeovergrp", groups=GROUPS)
+    before = metrics_registry.counter(
+        "leader_transitions_total", group="takeovergrp").value
+    fed.record_takeover(epoch=4, duration_ms=123.4)
+    assert fed.transitions == 1
+    assert fed.last_handoff["epoch"] == 4
+    assert fed.last_handoff["duration_ms"] == 123.4
+    assert metrics_registry.counter(
+        "leader_transitions_total", group="takeovergrp").value \
+        == before + 1
+    assert metrics_registry.histogram(
+        "failover_duration_ms", group="takeovergrp").count >= 1
+
+
+def test_federated_quota_view_identity_and_fold():
+    blue = FederationHost(group="blue", groups=GROUPS, global_quota=True)
+    fq = FederatedQuotaView(blue)
+    base = QuotaStore()
+    fq.set("u", "alpha", mem=100.0, cpus=10.0, count=5)
+    base.set("u", "alpha", mem=100.0, cpus=10.0, count=5)
+    # no remote usage folded yet: bit-identical to the base QuotaStore
+    # (the differential oracle's precondition)
+    assert fq.get("u", "alpha") == base.get("u", "alpha")
+    assert fq.get("nobody", "alpha") == base.get("nobody", "alpha")
+    blue.fold_remote("green", {
+        "group": "green", "epoch": 1,
+        "pools": {"beta": {"u": {"mem": 30.0, "cpus": 2.0, "gpus": 0.0,
+                                 "jobs": 2}}}})
+    got = fq.get("u", "alpha")
+    assert got["mem"] == 70.0           # 100 - 30 reported remotely
+    assert got["cpus"] == 8.0
+    assert got["count"] == 3.0          # "jobs" maps onto "count"
+    assert got["gpus"] == float("inf")  # inf stays inf
+    # remote usage can only clamp to zero, never go negative
+    blue.fold_remote("green", {
+        "group": "green", "epoch": 2,
+        "pools": {"beta": {"u": {"mem": 500.0, "cpus": 50.0, "gpus": 0.0,
+                                 "jobs": 50}}}})
+    assert fq.get("u", "alpha")["mem"] == 0.0
+    # global_quota off (the default): the fold is inert
+    blue.global_quota = False
+    assert fq.get("u", "alpha") == base.get("u", "alpha")
+
+
+def test_usage_snapshot_covers_owned_pools():
+    store = JobStore()
+    fed = FederationHost(group="blue", groups=GROUPS, store=store,
+                         url="http://blue:1")
+    # fabricate running usage through the store's own accounting
+    reg = ClusterRegistry()
+    reg.register(MockCluster([MockHost("alpha-h0", mem=1000, cpus=16,
+                                       pool="alpha")]))
+    pools = PoolRegistry()
+    pools.add(Pool(name="alpha"))
+    coord = Coordinator(store, reg, shares=ShareStore(),
+                        quotas=QuotaStore(), pools=pools)
+    store.create_jobs([_job("u1", "alpha")])
+    coord.match_cycle("alpha")
+    snap = fed.usage_snapshot()
+    assert snap["group"] == "blue"
+    assert "alpha" in snap["pools"]
+    assert snap["pools"]["alpha"]["u1"]["jobs"] == 1
+
+
+# ----------------------------------------------------------------------
+# REST surface: not-leader hints, ingest routing, /debug, /federation
+
+class _FakeElector:
+    def __init__(self, leader=False, current=None, boom=False):
+        self._leader = leader
+        self._current = current
+        self._boom = boom
+
+    def is_leader(self):
+        return self._leader
+
+    def current_leader(self):
+        if self._boom:
+            raise RuntimeError("election backend down")
+        return self._current
+
+
+def _api(**kw):
+    store = kw.pop("store", None) or JobStore()
+    return CookApi(store, **kw)
+
+
+def _post(api, path, body):
+    return api.handle("POST", path, {}, body, {})
+
+
+JOBS_BODY = {"jobs": [{"command": "true", "mem": 1.0, "cpus": 1.0}]}
+
+
+def test_client_channel_not_leader_hint_chain():
+    api = _api(leader_url="http://configured:1")
+    api.leader_elector = _FakeElector(leader=False,
+                                      current="http://elected:9")
+    r = _post(api, "/jobs", JOBS_BODY)
+    assert r.status == 503
+    assert r.body["leader"] == "http://elected:9"
+    assert r.headers["Retry-After"] == "1"
+    # elector knows no leader (mid-campaign): fall back to the
+    # configured HA address instead of handing the client a dead end
+    api.leader_elector = _FakeElector(leader=False, current=None)
+    r = _post(api, "/jobs", JOBS_BODY)
+    assert r.status == 503
+    assert r.body["leader"] == "http://configured:1"
+    # elector UNREACHABLE: same fallback, no 500
+    api.leader_elector = _FakeElector(leader=False, boom=True)
+    r = _post(api, "/jobs", JOBS_BODY)
+    assert r.status == 503
+    assert r.body["leader"] == "http://configured:1"
+    # nothing configured either: explicit null hint + Retry-After so
+    # the client backs off rather than hammering
+    api.leader_url = ""
+    r = _post(api, "/jobs", JOBS_BODY)
+    assert r.status == 503
+    assert r.body["leader"] is None
+    assert r.headers["Retry-After"] == "1"
+
+
+def test_agent_channel_not_leader_hint():
+    api = _api(leader_url="http://configured:1")
+    api.leader_elector = _FakeElector(leader=False,
+                                      current="http://elected:9")
+    r = _post(api, "/agents/heartbeat", {"hostname": "h0"})
+    assert r.status == 503
+    assert r.body["leader"] == "http://elected:9"
+    assert r.headers["Retry-After"] == "1"
+    # same fallback chain as the client channel
+    api.leader_elector = _FakeElector(leader=False, current=None)
+    r = _post(api, "/agents/heartbeat", {"hostname": "h0"})
+    assert r.status == 503
+    assert r.body["leader"] == "http://configured:1"
+
+
+def test_api_only_node_refuses_both_channels():
+    api = _api(leader_url="http://leader:1")
+    api.api_only = True
+    for path, body in (("/jobs", JOBS_BODY),
+                       ("/agents/heartbeat", {"hostname": "h0"})):
+        r = _post(api, path, body)
+        assert r.status == 503
+        assert r.body["leader"] == "http://leader:1"
+        assert r.headers["Retry-After"] == "1"
+
+
+def test_federated_ingest_routing_503():
+    pools = PoolRegistry()
+    pools.add(Pool(name="alpha"))
+    pools.add(Pool(name="beta"))
+    api = _api(pools=pools)
+    api.federation = FederationHost(group="blue", groups=GROUPS,
+                                    url="http://blue:1")
+    # a submission for the peer's pool: refused with the OWNER's address
+    r = _post(api, "/jobs", dict(JOBS_BODY, pool="beta"))
+    assert r.status == 503
+    assert r.body["leader"] == "http://green:2"
+    assert r.headers["Retry-After"] == "1"
+    # our own pool (and unlisted pools) are served
+    r = _post(api, "/jobs", dict(JOBS_BODY, pool="alpha"))
+    assert r.status == 201
+    r = _post(api, "/jobs", JOBS_BODY)     # default pool: unlisted=local
+    assert r.status == 201
+
+
+def test_debug_federation_block_and_usage_endpoint():
+    store = JobStore()
+    pools = PoolRegistry()
+    pools.add(Pool(name="alpha"))
+    api = _api(store=store, pools=pools)
+    fed = FederationHost(group="blue", groups=GROUPS, store=store,
+                         url="http://blue:1")
+    fed.record_takeover(epoch=1, duration_ms=5.0)
+    api.federation = fed
+    dbg = api.handle("GET", "/debug", {}, None, {})
+    assert dbg.status == 200
+    block = dbg.body["federation"]
+    assert block["group"] == "blue"
+    assert block["pools"]["alpha"] == {"group": "blue",
+                                       "leader": "http://blue:1",
+                                       "local": True}
+    assert block["pools"]["beta"]["group"] == "green"
+    assert block["pools"]["beta"]["leader"] == "http://green:2"
+    assert block["last_handoff"]["epoch"] == 1
+    # the peer-exchange endpoint answers without auth (machine channel)
+    u = api.handle("GET", "/federation/usage", {}, None, {})
+    assert u.status == 200
+    assert u.body["group"] == "blue"
+    # and 404s cleanly when no federation is attached
+    bare = _api()
+    assert bare.handle("GET", "/federation/usage", {}, None,
+                       {}).status == 404
+
+
+# ----------------------------------------------------------------------
+# fleet differential oracle: federation == single coordinator
+
+def _hosts(pool, n):
+    return [MockHost(f"{pool}-h{i}", mem=1000.0, cpus=16.0, pool=pool)
+            for i in range(n)]
+
+
+def _trace(n_jobs):
+    """A deterministic cross-pool, cross-user trace."""
+    users = ["alice", "bob", "carol"]
+    jobs = []
+    for i in range(n_jobs):
+        pool = "alpha" if i % 2 == 0 else "beta"
+        jobs.append(Job(uuid=f"j{i:04d}", user=users[i % len(users)],
+                        command="true", mem=64.0 + (i % 5) * 32.0,
+                        cpus=1.0 + (i % 3), priority=50 + (i % 7),
+                        pool=pool, max_retries=1))
+    return jobs
+
+
+def _make_node(hosts, owned_pools=None):
+    store = JobStore()
+    reg = ClusterRegistry()
+    reg.register(MockCluster(hosts))
+    shares = ShareStore()
+    for user, share in (("alice", 200.0), ("bob", 400.0),
+                        ("carol", 800.0)):
+        for pool in ("alpha", "beta"):
+            shares.set(user, pool, mem=share, cpus=8.0)
+    pools = PoolRegistry()
+    pools.add(Pool(name="alpha"))
+    pools.add(Pool(name="beta"))
+    coord = Coordinator(store, reg, shares=shares, quotas=QuotaStore(),
+                        pools=pools, config=SchedulerConfig())
+    if owned_pools is not None:
+        fed = FederationHost(group="g", groups={
+            "g": {"pools": list(owned_pools), "url": ""},
+            "peer": {"pools": [], "url": ""}})
+        coord.pool_filter = fed.owns
+    return store, coord
+
+
+def _dru_order(store, shares, pool):
+    """Per-pool (user, dru, jobs) ranking, highest DRU first — the
+    ordering the rank kernel sorts the queue by."""
+    out = []
+    for user, u in sorted(store.user_usage(pool).items()):
+        share = shares.get(user, pool)
+        dru = max(u["mem"] / share["mem"], u["cpus"] / share["cpus"])
+        out.append((user, round(dru, 9), u["jobs"]))
+    return sorted(out, key=lambda t: (-t[1], t[0]))
+
+
+def _matched(store):
+    return {(j.uuid, inst.hostname)
+            for j in store.jobs.values()
+            for inst in j.instances}
+
+
+def _run_differential(n_jobs, rounds):
+    trace = _trace(n_jobs)
+
+    # single coordinator owning both pools
+    s_store, s_coord = _make_node(_hosts("alpha", 2) + _hosts("beta", 2))
+    s_store.create_jobs([Job(**{f: getattr(j, f) for f in (
+        "uuid", "user", "command", "mem", "cpus", "priority", "pool",
+        "max_retries")}) for j in trace])
+    for _ in range(rounds):
+        s_coord.match_cycle("alpha")
+        s_coord.match_cycle("beta")
+
+    # 2-leader federation: each group owns one pool over its own store
+    a_store, a_coord = _make_node(_hosts("alpha", 2) + _hosts("beta", 2),
+                                  owned_pools=["alpha"])
+    b_store, b_coord = _make_node(_hosts("alpha", 2) + _hosts("beta", 2),
+                                  owned_pools=["beta"])
+    a_store.create_jobs([Job(**{f: getattr(j, f) for f in (
+        "uuid", "user", "command", "mem", "cpus", "priority", "pool",
+        "max_retries")}) for j in trace if j.pool == "alpha"])
+    b_store.create_jobs([Job(**{f: getattr(j, f) for f in (
+        "uuid", "user", "command", "mem", "cpus", "priority", "pool",
+        "max_retries")}) for j in trace if j.pool == "beta"])
+    for _ in range(rounds):
+        for p in a_coord.active_pools():
+            a_coord.match_cycle(p.name)
+        for p in b_coord.active_pools():
+            b_coord.match_cycle(p.name)
+
+    # pool_filter scoping held: neither shard touched the peer's pool
+    assert all(j.pool == "alpha" for j in a_store.jobs.values())
+    assert all(j.pool == "beta" for j in b_store.jobs.values())
+
+    single = _matched(s_store)
+    fleet = _matched(a_store) | _matched(b_store)
+    assert fleet == single, (
+        f"fleet decisions diverged from the single-coordinator oracle: "
+        f"only-single={sorted(single - fleet)[:5]} "
+        f"only-fleet={sorted(fleet - single)[:5]}")
+    for pool, st in (("alpha", a_store), ("beta", b_store)):
+        assert _dru_order(st, a_coord.shares, pool) == \
+            _dru_order(s_store, s_coord.shares, pool), \
+            f"DRU ordering diverged for pool {pool}"
+    assert len(single) > 0            # the oracle actually matched work
+
+
+def test_fleet_differential_oracle_small():
+    _run_differential(n_jobs=24, rounds=3)
+
+
+@pytest.mark.slow
+def test_fleet_differential_oracle_full():
+    _run_differential(n_jobs=400, rounds=6)
+
+
+def test_reconcile_restart_scoped_by_pool_filter():
+    """A federated takeover's census must not settle instances a peer
+    leader owns: UNKNOWN instances in an unowned pool stay UNKNOWN."""
+    from cook_tpu.state.model import InstanceStatus
+
+    store = JobStore()
+    reg = ClusterRegistry()
+    cluster = MockCluster(_hosts("alpha", 1) + _hosts("beta", 1))
+
+    def census():
+        # every host answered and reports NOTHING running: an unscoped
+        # census would requeue both pools' UNKNOWN instances
+        return {}, {h for h in cluster.hosts}, set()
+
+    cluster.query_agent_tasks = census
+    reg.register(cluster)
+    pools = PoolRegistry()
+    pools.add(Pool(name="alpha"))
+    pools.add(Pool(name="beta"))
+    coord = Coordinator(store, reg, shares=ShareStore(),
+                        quotas=QuotaStore(), pools=pools)
+    ja, jb = _job("u", "alpha"), _job("u", "beta")
+    store.create_jobs([ja, jb])
+    coord.match_cycle("alpha")
+    coord.match_cycle("beta")
+    for j in (ja, jb):
+        for inst in j.instances:
+            inst.status = InstanceStatus.UNKNOWN
+    coord.pool_filter = lambda pool: pool == "alpha"
+    report = coord.reconcile_restart()
+    assert report["unknown"] == 1                 # only alpha's censused
+    assert [i.status for i in jb.instances] == [InstanceStatus.UNKNOWN]

@@ -1,0 +1,131 @@
+"""Leader-kill / partition failover soak gates (tests.fedsoak).
+
+The federated control plane's failover promises, asserted over a live
+HA pair sharing one durable store:
+
+  - zero lost jobs: every submitted uuid reaches completed across
+    every leader generation;
+  - at-most-once launch ACROSS LEADER EPOCHS: each task_id hits an
+    executor at most once and appears exactly once in the shared event
+    log, whose per-record ``"ep"`` stamps span at least two leader
+    generations (instances were created on both sides of a takeover);
+  - monotone fencing epochs: the durable epoch ledger is strictly
+    increasing, one mint per takeover;
+  - bounded failover: every kill->takeover MTTR under the ceiling;
+  - the fence holds: a store handle carrying a superseded epoch (the
+    deposed leader that never noticed) has its write REJECTED with
+    ``StaleEpochError`` and the rejection counter increments;
+  - a partitioned-but-alive leader (SIGSTOP) is never deposed — the
+    stall is survivable, not a split-brain.
+
+Every assertion message carries the seed and artifact paths so a red
+run replays from $CHAOS_ARTIFACTS_DIR alone. The quiet baseline pins
+the oracle: same pair, same traffic, zero faults -> exactly one epoch
+ever minted, zero transitions, one clean instance per job.
+"""
+import pytest
+
+from cook_tpu.chaos.churn import LEADER_KILL, LEADER_PARTITION
+from tests.fedsoak import run_failover_soak
+
+QUICK = dict(jobs=6, agents=2, window_s=4.0, wall_s=90.0,
+             kills=1, partitions=1)
+FULL = dict(jobs=40, agents=3, window_s=15.0, wall_s=300.0,
+            kills=3, partitions=2)
+
+MTTR_CEILING_MS = 20_000.0
+
+
+def _assert_gates(r, kills=0):
+    ctx = f"seed={r['seed']} tag={r['tag']} epochs={r['epochs']}"
+    assert not r["violations"], \
+        f"[{ctx}] in-flight violations: {r['violations']}"
+    # zero lost jobs
+    assert len(r["jobs"]) == r["expected_jobs"], \
+        f"[{ctx}] lost jobs: {len(r['jobs'])}/{r['expected_jobs']}"
+    for j in r["jobs"].values():
+        assert j.status == "completed", \
+            f"[{ctx}] {j.uuid} stuck in {j.status}"
+        assert j.state == "success", \
+            f"[{ctx}] {j.uuid} completed unsuccessfully ({j.state})"
+    # at-most-once launch, across every leader generation
+    doubled = {t: n for t, n in r["launch_counts"].items() if n > 1}
+    assert not doubled, \
+        f"[{ctx}] double-launched task_ids: {doubled}"
+    seen: dict = {}
+    for rec in r["inst_tasks"]:
+        seen[rec["task"]] = seen.get(rec["task"], 0) + 1
+    dup_log = {t: n for t, n in seen.items() if n > 1}
+    assert not dup_log, \
+        f"[{ctx}] duplicate inst records in shared log: {dup_log}"
+    # monotone fencing epochs, one mint per takeover
+    assert all(a < b for a, b in zip(r["epochs"], r["epochs"][1:])), \
+        f"[{ctx}] epoch ledger not strictly increasing"
+    assert len(r["epochs"]) >= 1 + kills, \
+        f"[{ctx}] expected >= {1 + kills} mints (initial + per kill)"
+    # bounded, epoch-advancing failover
+    kill_ts = [t for t in r["transitions"]
+               if t["action"] == LEADER_KILL]
+    assert len(kill_ts) == kills, \
+        f"[{ctx}] {len(kill_ts)} kill transitions, wanted {kills}"
+    for t in kill_ts:
+        assert t["epoch_after"] > t["epoch_before"], \
+            f"[{ctx}] takeover without epoch advance: {t}"
+        assert t["mttr_ms"] <= MTTR_CEILING_MS, \
+            f"[{ctx}] failover took {t['mttr_ms']}ms: {t}"
+    for t in r["transitions"]:
+        if t["action"] == LEADER_PARTITION and t["epoch_after"]:
+            assert t["epoch_after"] <= t["epoch_before"] or kills, \
+                f"[{ctx}] frozen leader deposed: {t}"
+    if kills:
+        # instances exist on both sides of a takeover
+        eps = {rec["ep"] for rec in r["inst_tasks"]}
+        assert len(eps) >= 2, \
+            f"[{ctx}] inst epoch stamps never crossed a takeover: {eps}"
+        # the split-brain proof ran and held
+        sf = r["stale_fence"]
+        assert sf and sf["rejected"], \
+            f"[{ctx}] stale-epoch fence proof missing/failed: {sf}"
+        assert sf["counter_delta"] >= 1, \
+            f"[{ctx}] rejection counter never moved: {sf}"
+
+
+@pytest.mark.parametrize("seed", [31, 62])
+def test_failover_soak_quick(tmp_path, seed):
+    r = run_failover_soak(tmp_path / "store", seed, **QUICK)
+    _assert_gates(r, kills=QUICK["kills"])
+    ctx = f"seed={seed}"
+    assert r["churn_events"], f"[{ctx}] churn schedule was empty"
+    # the kill actually landed on a live process
+    assert sum(r["server_deaths"].values()) >= QUICK["kills"], \
+        f"[{ctx}] no leader SIGKILL ever landed: {r['server_deaths']}"
+
+
+def test_failover_soak_quiet_baseline(tmp_path):
+    """No churn: the oracle pin. One epoch ever minted (the initial
+    takeover), zero transitions, zero deaths, one clean instance per
+    job — the HA pair at rest is indistinguishable from a single
+    coordinator."""
+    r = run_failover_soak(tmp_path / "store", seed=7, jobs=6, agents=2,
+                          window_s=2.0, wall_s=60.0, churn=False,
+                          post_jobs=0)
+    _assert_gates(r, kills=0)
+    ctx = "seed=7 baseline"
+    assert r["transitions"] == [], \
+        f"[{ctx}] leader transitions on a quiet day: {r['transitions']}"
+    assert len(r["epochs"]) == 1, \
+        f"[{ctx}] extra epoch mints on a quiet day: {r['epochs']}"
+    assert sum(r["server_deaths"].values()) == 0, \
+        f"[{ctx}] server died on a quiet day"
+    for j in r["jobs"].values():
+        assert len(j.instances) == 1, \
+            f"[{ctx}] {j.uuid} churned on a quiet day"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [31, 62])
+def test_failover_soak_full_magnitude(tmp_path, seed):
+    """The nightly failover day: three leader kills + two partitions
+    under sustained traffic (see run_failover_soak's docstring)."""
+    r = run_failover_soak(tmp_path / "store", seed, **FULL)
+    _assert_gates(r, kills=FULL["kills"])

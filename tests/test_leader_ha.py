@@ -6,6 +6,7 @@ integration/tests/cook/test_master_slave.py): two schedulers, kill the
 leader, the standby takes over within the lease TTL, and no work is
 ever performed twice.
 """
+import os
 import threading
 import time
 
@@ -15,7 +16,7 @@ from cook_tpu.backends.base import ClusterRegistry
 from cook_tpu.backends.kube.standin import ApiServerStandIn
 from cook_tpu.backends.mock import MockCluster, MockHost
 from cook_tpu.scheduler.coordinator import Coordinator
-from cook_tpu.scheduler.leader import LeaseElector
+from cook_tpu.scheduler.leader import FileLeaderElector, LeaseElector
 from cook_tpu.state.model import Job, JobState, new_uuid
 from cook_tpu.state.store import JobStore
 
@@ -204,6 +205,74 @@ def test_failover_no_double_launch(apiserver):
     assert len(job2.instances) == 1      # exactly once, on the new leader
     assert job2.instances[0].hostname == "b-h0"
     eb.stop()
+
+
+def _file_elector(path, ident, on_loss=None):
+    return FileLeaderElector(path, f"http://{ident}",
+                             retry_interval_s=0.05,
+                             on_loss=on_loss or (lambda: None))
+
+
+def test_file_elector_stop_during_campaign(tmp_path):
+    """PR-1 fd double-close regression, now with targeted coverage:
+    stop() a candidate that is still CAMPAIGNING (another elector
+    holds the flock, so the candidate's transient fd churns open/close
+    in the retry loop). stop()'s _release must neither close a fd the
+    campaign loop owns nor leave one leaked holding the flock; the
+    holder is untouched and a fresh candidate acquires the moment the
+    holder releases."""
+    path = str(tmp_path / "leader.lock")
+    holder_led = threading.Event()
+    holder = _file_elector(path, "holder")
+    holder.start(holder_led.set)
+    wait_until(holder_led.is_set)
+
+    led = threading.Event()
+    camp = _file_elector(path, "camp")
+    camp.start(led.set)
+    time.sleep(0.25)              # several denied flock attempts
+    camp.stop()                   # mid-campaign
+    camp.stop()                   # and idempotent: no double-close
+    assert not led.is_set()
+    assert not camp.is_leader()
+    assert camp._fd is None
+
+    assert holder.is_leader()
+    assert holder.current_leader() == "http://holder"
+    holder.stop()
+    succ_led = threading.Event()
+    succ = _file_elector(path, "succ")
+    succ.start(succ_led.set)
+    wait_until(succ_led.is_set)
+    assert succ.current_leader() == "http://succ"
+    succ.stop()
+
+
+def test_file_elector_loss_path_leaves_no_stale_lock(tmp_path):
+    """Lease expiry (lock file replaced out from under the holder —
+    the ZK-session-expired analog that triggers _suicide in
+    production): on_loss fires, the deposed holder's fd is released
+    (no leaked flock), and nothing it leaves behind blocks the
+    successor — who acquires, owns the one lock file on disk, and is
+    named by current_leader()."""
+    path = str(tmp_path / "leader.lock")
+    lost, led = threading.Event(), threading.Event()
+    old = _file_elector(path, "old", on_loss=lost.set)
+    old.start(led.set)
+    wait_until(led.is_set)
+    os.unlink(path)               # the lease is gone: holder must lose
+    wait_until(lost.is_set, timeout=5)
+    assert not old.is_leader()
+    assert old._fd is None        # released — no fd leaked holding flock
+
+    succ_led = threading.Event()
+    succ = _file_elector(path, "succ")
+    succ.start(succ_led.set)
+    wait_until(succ_led.is_set)
+    assert succ.current_leader() == "http://succ"
+    assert os.path.exists(path)   # exactly the successor's lease file
+    succ.stop()
+    old.stop()
 
 
 def test_is_leader_self_fences_on_stale_renewals(apiserver):
