@@ -736,7 +736,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
               retention_s=120.0,
               label="e2e coordinator @ 100k-pending x 10k-offers",
               stats_out=None, durability_check=False, consider=None,
-              decision_provenance=None):
+              decision_provenance=None, pools=1, store_shards=4):
     """END-TO-END production path: Coordinator.match_cycle itself — the
     durable store (100k pending + ~10k running), device-resident
     tensors updated by store-event deltas, the real launch transaction
@@ -769,7 +769,15 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
     readback-transfer RTT — NOT the bundle-upload RTT the tunnel also
     charges — so the published co-located percentiles are a
     conservative upper bound, measured per cycle rather than derived
-    from phase means."""
+    from phase means.
+
+    pools > 1 partitions hosts and jobs round-robin across K pools and
+    drives K match_cycle(pool) calls concurrently per bench cycle —
+    the deployment shape the pool-sharded store exists for (N per-pool
+    lanes driving N shard locks; a single pool hashes to ONE shard and
+    measures only the encoding win). store_shards=1 is the
+    differential A/B arm: same workload, the old single-lock
+    behavior."""
     import tempfile
 
     from cook_tpu.backends.base import ClusterRegistry
@@ -782,15 +790,21 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
 
     import jax
 
+    from cook_tpu.state.pools import Pool, PoolRegistry
+
+    K = max(1, int(pools))
+    pool_names = (["default"] if K == 1
+                  else [f"p{i}" for i in range(K)])
     rng = np.random.default_rng(0)
     hosts = [MockHost(f"h{i}", mem=float(rng.uniform(64, 256) * 1024),
-                      cpus=float(rng.uniform(16, 64)))
+                      cpus=float(rng.uniform(16, 64)),
+                      pool=pool_names[i % K])
              for i in range(H)]
     fd, log_path = tempfile.mkstemp(prefix="cook_e2e_", suffix=".log")
     os.close(fd)
     fd, snap_path = tempfile.mkstemp(prefix="cook_e2e_", suffix=".snap")
     os.close(fd)
-    store = JobStore(log_path=log_path)
+    store = JobStore(log_path=log_path, store_shards=store_shards)
     cluster = MockCluster(hosts, runtime_fn=lambda s: (runtime_s, True, None),
                           bulk_status=True)
     reg = ClusterRegistry()
@@ -809,24 +823,34 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
         # costs (fsync, launch RPC, dispatch overhead) over `consider`
         # decisions instead of the default 1024
         cfg.max_jobs_considered = consider
-    coord = Coordinator(store, reg, config=cfg, status_shards=19)
+    preg = PoolRegistry(pool_names[0])
+    for name in pool_names[1:]:
+        preg.add(Pool(name=name))
+    coord = Coordinator(store, reg, config=cfg, pools=preg,
+                        status_shards=19)
 
     # cleanup in finally: a mid-run failure (tunnel outage,
     # Ctrl-C during a 10-minute run) must not leak the consumer/
     # shard threads or the ~100 MB durable-log tempfile
     try:
+        job_seq = [0]
+
         def mkjobs(n):
+            base = job_seq[0]
+            job_seq[0] += n
             return [Job(uuid=new_uuid(), user=f"u{int(rng.integers(0, U))}",
                         command="true",
+                        pool=pool_names[(base + i) % K],
                         mem=float(rng.uniform(1, 10) * 1024),
                         cpus=float(rng.uniform(0.5, 4)))
-                    for _ in range(n)]
+                    for i in range(n)]
 
         t0 = time.perf_counter()
         seed_jobs = mkjobs(P0)
         store.create_jobs(seed_jobs)
         seed_s = time.perf_counter() - t0
-        coord.enable_resident(synchronous=not async_consumer)
+        for p in pool_names:
+            coord.enable_resident(pool=p, synchronous=not async_consumer)
         # the seeded baseline is ~10^6 long-lived objects; without freezing
         # them, periodic gen-2 GC scans show up as multi-hundred-ms p99
         # spikes that have nothing to do with the scheduler. This is the
@@ -909,6 +933,36 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
         #                  maxlen must never silently truncate a long
         #                  run's consumer-side histogram
 
+        # K>1: one executor drives every pool's match_cycle
+        # concurrently — the per-pool consume lanes then hit their own
+        # shard locks at the same time, which is the contention the
+        # sharded store removes. Stats aggregate as sum(matched) /
+        # max(cycle_ms) (the cycles overlap in wall time).
+        from concurrent.futures import ThreadPoolExecutor
+        from types import SimpleNamespace
+        pool_exec = ThreadPoolExecutor(
+            max_workers=K, thread_name_prefix="bench-pool") \
+            if K > 1 else None
+
+        def run_cycle():
+            if pool_exec is None:
+                return coord.match_cycle()
+            all_stats = list(pool_exec.map(coord.match_cycle,
+                                           pool_names))
+            return SimpleNamespace(
+                matched=sum(s.matched for s in all_stats),
+                cycle_ms=max(s.cycle_ms for s in all_stats))
+
+        def pool_metric(key, op=max, pop=False, default=None):
+            vals = []
+            for p in pool_names:
+                mk = f"match.{p}.{key}"
+                v = (coord.metrics.pop(mk, None) if pop
+                     else coord.metrics.get(mk))
+                if v is not None:
+                    vals.append(v)
+            return op(vals) if vals else default
+
         t0 = time.perf_counter()
         wall, match_ms, readback, writeback, submit_ms, matched_hist = \
             [], [], [], [], [], []
@@ -926,8 +980,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
         for c in range(cycles):
             cycle_box[0] = c
             t_c = time.perf_counter()
-            stats = coord.match_cycle()
-            rs = coord.metrics.pop("match.default.resync_ms", None)
+            stats = run_cycle()
+            rs = pool_metric("resync_ms", op=max, pop=True)
             if rs is not None:
                 resyncs.append((c, round(rs, 2)))
             gcms = coord.metrics.pop("gc.refreeze_ms", None)
@@ -951,16 +1005,22 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             if c >= warmup:
                 wall.append((t_m - t_c) * 1e3)
                 match_ms.append(stats.cycle_ms)
-                readback.append(coord.metrics.get("match.default.readback_ms", 0))
+                readback.append(pool_metric("readback_ms",
+                                            op=lambda v: sum(v) / len(v),
+                                            default=0))
                 rtt_probe.append(rtt_c)
-                qwait.append(coord.metrics.pop(
-                    "match.default.queue_wait_ms", 0.0))
+                qwait.append(pool_metric("queue_wait_ms", op=max,
+                                         pop=True, default=0.0))
                 writeback.append((t_w - t_p) * 1e3)
                 submit_ms.append((t_s - t_w) * 1e3)
                 matched_hist.append(stats.matched)
                 for k in phase_keys:
-                    phases[k].append(coord.metrics.get(f"match.default.{k}", 0))
-        coord.drain_resident()
+                    phases[k].append(pool_metric(
+                        k, op=lambda v: sum(v) / len(v), default=0))
+        for p in pool_names:
+            coord.drain_resident(pool=p)
+        if pool_exec is not None:
+            pool_exec.shutdown(wait=True)
         if coord.status_shards is not None:
             coord.status_shards.drain()
         if async_consumer:
@@ -1043,8 +1103,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
         launch_p99_ms = (round(float(np.percentile(txn_samples, 99)), 2)
                          if len(txn_samples) else None)
 
-        n_pend = len(store.pending_jobs("default"))
-        n_run = len(store.running_instances("default"))
+        n_pend = sum(len(store.pending_jobs(p)) for p in pool_names)
+        n_run = sum(len(store.running_instances(p)) for p in pool_names)
 
         # ack-durability gate (CI e2e-perf-smoke): stop the background
         # writers, then rebuild the store cold exactly as a post-crash
@@ -1072,6 +1132,12 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
                 "cold_pending": len(cold_pending),
                 "live_running": len(live_running),
                 "cold_running": len(cold_running),
+                # the strongest replay oracle: the cold store must not
+                # merely cover the live one, it must BE it — every
+                # hand-built / zero-copy-encoded record replayed to the
+                # identical jobs/groups/config digest
+                "state_hash_match": (store.state_hash()
+                                     == replayed.state_hash()),
             }
 
         out = {
@@ -1159,6 +1225,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             "completed_total": completed_total,
             "seed_submit_s": round(seed_s, 1),
             "cycles": len(wall),
+            "pools": K,
+            "store_shards": store_shards,
             "wall_s": round(total_s, 1),
             "device": str(jax.devices()[0]),
         }
@@ -1618,6 +1686,126 @@ def bench_launch(lanes=8, batches=40, batch_size=64):
     }), flush=True)
 
 
+def bench_store_shard(lanes=4, batches=24, batch_size=64):
+    """Pool-sharded store economics, measured in isolation from the
+    matcher (the store half of the e2e launch path, no JAX dispatch in
+    the loop so the numbers are not drowned by device-kernel noise).
+
+    `lanes` concurrent consume lanes — one pool each, the PR 7/PR 9
+    shape — each push `batches` durable launch txns of `batch_size`
+    instances plus two full status folds (RUNNING, SUCCESS) through
+    ONE durable store. Three arms over the identical workload:
+
+      - store_shards=1: every lane serializes on the single section
+        (the pre-round-9 behavior) — lock WAIT is the contention bill.
+      - store_shards=4: each lane owns a shard; waits collapse to the
+        cross-shard group-commit barrier only.
+      - store_shards=4, native_encoder=False: the dict->json.dumps
+        bound-encoder fallback, isolating the zero-copy segment
+        encoder's share.
+
+    Every arm must cold-replay to its own live state_hash (sharding
+    and encoding are perf knobs, never semantics — the differential
+    oracle in tests/test_state.py proves byte-identity on a fixed
+    trace; here the guard is hash equality under real concurrency).
+    Reported lock_wait/hold are the store's own per-shard txn metrics
+    (the /debug store.shards block), summed over shards."""
+    import shutil
+    import tempfile
+    import threading
+
+    from cook_tpu.state.model import InstanceStatus, Job, new_uuid
+    from cook_tpu.state.store import JobStore
+
+    def run(shards: int, native: bool) -> dict:
+        tmp = tempfile.mkdtemp(prefix="cook-store-shard-")
+        log = os.path.join(tmp, "events.log")
+        try:
+            store = JobStore(log_path=log, store_shards=shards)
+            store.native_encoder = native
+            lane_jobs = []
+            for ln in range(lanes):
+                jobs = [Job(uuid=new_uuid(), user=f"u{ln}",
+                            command="true", mem=1.0, cpus=0.1,
+                            pool=f"p{ln}")
+                        for _ in range(batches * batch_size)]
+                store.create_jobs(jobs)
+                lane_jobs.append([j.uuid for j in jobs])
+            start = threading.Barrier(lanes)
+
+            def lane(ln: int) -> None:
+                uuids = lane_jobs[ln]
+                start.wait()
+                for b in range(batches):
+                    chunk = uuids[b * batch_size:(b + 1) * batch_size]
+                    insts = store.create_instances_bulk(
+                        [(u, f"h{ln}", "bench", new_uuid())
+                         for u in chunk])
+                    tids = [i.task_id for i in insts if i is not None]
+                    store.update_instances_bulk(
+                        [(t, InstanceStatus.RUNNING, None)
+                         for t in tids])
+                    store.update_instances_bulk(
+                        [(t, InstanceStatus.SUCCESS, None)
+                         for t in tids])
+
+            threads = [threading.Thread(target=lane, args=(ln,))
+                       for ln in range(lanes)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_s = time.perf_counter() - t0
+            rows = lanes * batches * batch_size * 3   # launch + 2 folds
+            stats = store.shard_stats()
+            want = store.state_hash()
+            store._log.sync()
+            store._log.close()
+            cold = JobStore.restore(None, log_path=log,
+                                    open_writer=False)
+            return {
+                "store_shards": shards,
+                "native_encoder": native,
+                "rows_per_s": round(rows / wall_s, 1),
+                "wall_s": round(wall_s, 3),
+                "lock_wait_ms_total": round(
+                    sum(stats["lock_wait_ms"]), 1),
+                "lock_hold_ms_total": round(
+                    sum(stats["lock_hold_ms"]), 1),
+                "txns": sum(stats["txns"]),
+                "replay_hash_ok": cold.state_hash() == want,
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    sharded = run(4, True)
+    single = run(1, True)
+    bound = run(4, False)
+
+    wait_x = round(single["lock_wait_ms_total"]
+                   / max(0.1, sharded["lock_wait_ms_total"]), 1)
+    ok = (sharded["replay_hash_ok"] and single["replay_hash_ok"]
+          and bound["replay_hash_ok"]
+          and sharded["lock_wait_ms_total"]
+          < single["lock_wait_ms_total"])
+    print(json.dumps({
+        "metric": f"pool-sharded store txn path, {lanes} lanes x "
+                  f"{batches} txns x {batch_size} instances + 2 folds",
+        "value": sharded["rows_per_s"],
+        "unit": "durable txn rows/s (4 shards, native encoder)",
+        "ok": ok,
+        "lock_wait_reduction_x": wait_x,
+        "encoder_speedup_x": round(
+            sharded["rows_per_s"] / max(1.0, bound["rows_per_s"]), 2),
+        "sharded": sharded,
+        "single_shard": single,
+        "bound_encoder": bound,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def bench_day_soak():
     """Full-magnitude compressed production day (the nightly tier of
     tests/test_day_soak.py): diurnal burst arrivals + transport chaos +
@@ -1811,10 +1999,19 @@ def main():
                   label="e2e coordinator @ 20k-pending x 2k-offers")
     elif which == "e2e-smoke":
         # CI perf gate: reduced scale, plus the cold-replay ack-
-        # durability self-check (no acked job may exist only in RAM)
+        # durability self-check (no acked job may exist only in RAM).
+        # Default = the sharded production shape (4 match lanes over 4
+        # store shards). E2E_SMOKE_SHARDS=1 is the same-host A/B arm
+        # (same 4-lane workload, one shard); E2E_SMOKE_POOLS=1 pins the
+        # historical single-pool shape the dps floor was calibrated on
+        # (multi-pool pays 4x the fixed JAX dispatch cost per cycle, so
+        # its absolute dps is only comparable to itself).
+        shards = int(os.environ.get("E2E_SMOKE_SHARDS", "4"))
+        pools = int(os.environ.get("E2E_SMOKE_POOLS", "4"))
         bench_e2e(P0=20_000, H=2_000, cycles=60, warmup=10,
-                  durability_check=True,
-                  label="e2e perf smoke @ 20k-pending x 2k-offers")
+                  durability_check=True, pools=pools, store_shards=shards,
+                  label=f"e2e perf smoke @ 20k-pending x 2k-offers, "
+                        f"{pools} pools x {shards} shards")
     elif which == "e2e-batched":
         # batched matcher on the resident path (exact head + audited
         # windows instead of the full C-step sequential scan)
@@ -1870,6 +2067,11 @@ def main():
         # under concurrent lanes (the e2e-perf-smoke CI floor) + the
         # zero-copy spec-encode A/B
         bench_launch()
+    elif which == "store-shard":
+        # pool-sharded store A/B in isolation: lock-wait collapse at
+        # shards=4 vs the single section, the zero-copy event encoder
+        # vs the bound fallback, replay-hash green on every arm
+        bench_store_shard()
     elif which == "pallas":
         bench_pallas()
     else:
@@ -1879,7 +2081,8 @@ def main():
                          "longevity "
                          "longevity-async trace-overhead "
                          "decision-overhead chaos-overhead "
-                         "crash-soak day-soak failover launch pallas")
+                         "crash-soak day-soak failover launch "
+                         "store-shard pallas")
 
 
 if __name__ == "__main__":

@@ -33,7 +33,8 @@ def main(argv: list[str] | None = None) -> int:
         description="cookcheck: trace-purity (R1), lock discipline (R2), "
                     "async hygiene (R3), REST/OpenAPI drift (R4), "
                     "span discipline (R5), retry discipline (R6), "
-                    "metrics discipline (R7), epoch discipline (R8)")
+                    "metrics discipline (R7), epoch discipline (R8), "
+                    "shard-lock discipline (R9)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the cook_tpu "
                          "package)")

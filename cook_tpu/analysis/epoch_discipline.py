@@ -4,15 +4,14 @@ The epoch-fenced failover design (docs/robustness.md) holds only if
 every durable append is checked against the epoch ledger: a deposed
 leader's write must raise ``StaleEpochError`` BEFORE the bytes reach
 the shared log. ``state/store.py`` funnels that guarantee through
-exactly two chokepoints — ``_append_raw`` and ``_append_raw_many`` —
-which run the leadership gate and ``_fence_stale_epoch()`` ahead of
-the writer call.
+exactly three chokepoints — ``_append_raw``, ``_append_raw_many`` and
+``_append_segments`` (the zero-copy preencoded path) — which run the
+leadership gate and ``_fence_stale_epoch()`` ahead of the writer call.
 
 R8 pins the funnel shape at the AST level: inside ``state/store.py``,
-a call to ``<anything>._log.append(...)`` or
-``<anything>._log.append_many(...)`` outside those two functions is a
-fence bypass — a code path that could commit a superseded leader's
-record.  (A writer aliased into a local first, ``w = self._log``, is
+a call to ``<anything>._log.append(...)``, ``.append_many(...)`` or
+``.append_segments(...)`` outside those functions is a fence bypass —
+a code path that could commit a superseded leader's record.  (A writer aliased into a local first, ``w = self._log``, is
 only reachable inside the chokepoints today; the rule is receiver-name
 based and deliberately cheap, the same trade R7 makes.)
 
@@ -25,15 +24,16 @@ import ast
 
 from cook_tpu.analysis.core import Finding, ModuleInfo
 
-# the only functions allowed to touch the writer directly — both run
+# the only functions allowed to touch the writer directly — all run
 # the append gate + _fence_stale_epoch before the writer call
-_CHOKEPOINTS = frozenset(("_append_raw", "_append_raw_many"))
+_CHOKEPOINTS = frozenset(("_append_raw", "_append_raw_many",
+                          "_append_segments"))
 
-_APPENDS = frozenset(("append", "append_many"))
+_APPENDS = frozenset(("append", "append_many", "append_segments"))
 
 _MSG = ("direct event-log append bypasses the epoch fence — route "
-        "through _append_raw/_append_raw_many (they run the "
-        "leadership gate and _fence_stale_epoch first)")
+        "through _append_raw/_append_raw_many/_append_segments (they "
+        "run the leadership gate and _fence_stale_epoch first)")
 
 
 def _enclosing_function(parents: dict, node: ast.AST) -> str:

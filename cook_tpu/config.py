@@ -325,6 +325,16 @@ class Settings:
     # consume lanes pay ~1 fsync per drain instead of N. Durability is
     # unchanged — the launch ack still waits for ITS round's fsync.
     launch_group_commit: bool = True
+    # pool-sharded store locks (JobStore store_shards): transactions
+    # take only their pool's shard lock, so per-pool consume lanes and
+    # status folds stop serializing on one mutex. 1 = the old single-
+    # lock behavior (the differential-oracle A/B arm).
+    store_shards: int = 4
+    # zero-copy event encoding (JobStore native_encoder): hot txn
+    # records are appended as preencoded byte segments through the
+    # native writer's scatter-gather entry point; off = the legacy
+    # dict→json.dumps→str path (byte-identical logs either way).
+    store_native_encoder: bool = True
 
     @classmethod
     def from_dict(cls, raw: dict) -> "Settings":
@@ -374,6 +384,8 @@ class Settings:
         if self.snapshot_delta_chain < 0:
             raise ConfigError("snapshot_delta_chain must be >= 0 "
                               "(0 = full snapshots only)")
+        if self.store_shards < 1:
+            raise ConfigError("store_shards must be >= 1")
         if self.restart_reconcile_timeout_s < 0:
             raise ConfigError("restart_reconcile_timeout_s must be "
                               ">= 0 (0 = no match-cycle gate)")

@@ -189,6 +189,29 @@ int64_t el_append_batch(int64_t h, const char* s, int64_t len,
   return log->existing + log->appended;
 }
 
+int64_t el_append_segments(int64_t h, const char** segs,
+                           const int64_t* lens, int64_t nsegs,
+                           int64_t nlines) {
+  // Scatter-gather variant of el_append_batch: segs[i] (lens[i] bytes
+  // each) concatenate to nlines pre-terminated records.  The store's
+  // zero-copy encoders hand over the constant key fragments and the
+  // variable uuid/value fragments as separate segments, so Python never
+  // pays a join — the single reserve+append splice here is the only
+  // copy between the transaction and the syncer's write(2).
+  auto log = get(h);
+  if (!log || nlines <= 0 || nsegs <= 0) return -1;
+  size_t total = 0;
+  for (int64_t i = 0; i < nsegs; i++) total += (size_t)lens[i];
+  std::lock_guard<std::mutex> lk(log->mu);
+  log->buf.reserve(log->buf.size() + total);
+  for (int64_t i = 0; i < nsegs; i++)
+    log->buf.append(segs[i], (size_t)lens[i]);
+  log->buffered += nlines;
+  log->appended += nlines;
+  log->cv_work.notify_one();
+  return log->existing + log->appended;
+}
+
 int64_t el_lines(int64_t h) {
   auto log = get(h);
   if (!log) return -1;

@@ -40,6 +40,17 @@ def _load():
         # stale cached .so from before the batch entry point existed;
         # append_many degrades to per-line appends
         lib._has_append_batch = False
+    try:
+        lib.el_append_segments.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64]
+        lib.el_append_segments.restype = ctypes.c_int64
+        lib._has_append_segments = True
+    except AttributeError:
+        # stale cached .so predating the scatter-gather entry point;
+        # append_segments degrades to a joined el_append_batch
+        lib._has_append_segments = False
     lib.el_sync.argtypes = [ctypes.c_int64, ctypes.c_int64]
     lib.el_sync.restype = ctypes.c_int
     lib.el_close.argtypes = [ctypes.c_int64]
@@ -85,6 +96,26 @@ class NativeLogWriter:
         b = ("\n".join(lines) + "\n").encode()
         if self._lib.el_append_batch(self._h, b, len(b), len(lines)) < 0:
             raise OSError("el_append_batch failed")
+
+    def append_segments(self, segs, nlines: int) -> None:
+        """Scatter-gather batch append: segs is a list of bytes
+        fragments concatenating to exactly `nlines` newline-terminated
+        records. One native call, no Python-side join — the only copy
+        is the C++ buffer splice. The ctypes arrays hold references to
+        every fragment for the (synchronous) call's duration, so no
+        segment can be collected mid-splice."""
+        if not segs or not nlines:
+            return
+        if not getattr(self._lib, "_has_append_segments", False):
+            self.append_many(
+                b"".join(segs).decode("utf-8").splitlines())
+            return
+        n = len(segs)
+        arr = (ctypes.c_char_p * n)(*segs)
+        lens = (ctypes.c_int64 * n)(*[len(s) for s in segs])
+        if self._lib.el_append_segments(self._h, arr, lens, n,
+                                        nlines) < 0:
+            raise OSError("el_append_segments failed")
 
     def lines(self) -> int:
         return int(self._lib.el_lines(self._h))

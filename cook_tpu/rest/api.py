@@ -1286,7 +1286,11 @@ class CookApi:
                         self.store.delta_chain_length(),
                     "restart_reconcile": getattr(
                         self.coord, "last_restart_reconcile", {})
-                        if self.coord is not None else {}}}
+                        if self.coord is not None else {}},
+                # pool-sharded store evidence: shard count, native
+                # encoder state, per-shard txn/lock-wait/hold totals
+                # (live_smoke scrapes this block)
+                "store": {"shards": self.store.shard_stats()}}
         ovl = getattr(self.coord, "overload", None)
         if ovl is not None:
             # shed-ladder state: level, engaged actions, per-signal

@@ -253,8 +253,10 @@ def build_scheduler(config, read_only=False):
     store = JobStore.restore(config.snapshot_path,
                              log_path=config.log_path,
                              trim_tail=not ha and not read_only,
-                             open_writer=not read_only)
+                             open_writer=not read_only,
+                             store_shards=config.store_shards)
     store.group_commit = bool(config.launch_group_commit)
+    store.native_encoder = bool(config.store_native_encoder)
     pools = PoolRegistry(config.default_pool)
     for p in config.pools:
         pools.add(Pool(name=p.name, purpose=p.purpose,

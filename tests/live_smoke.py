@@ -125,6 +125,24 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
                 f"(got {codes})")
         if not decisions.get("cycles"):
             failures.append("/debug/decisions ring is empty")
+        # the pool-sharded store's operator surface: shard count, the
+        # zero-copy encoder flag, and per-shard txn/lock-wait evidence
+        # (the job that completed above pushed >=1 txn through a shard)
+        shards = debug.get("store", {}).get("shards", {})
+        if shards.get("count", 0) < 1:
+            failures.append(f"/debug has no store.shards block ({shards})")
+        if "native_encoder" not in shards:
+            failures.append("/debug store.shards lacks native_encoder")
+        if sum(shards.get("txns", [])) < 1:
+            failures.append(
+                f"/debug store.shards recorded no transactions ({shards})")
+        if not shards.get("txns_by_pool"):
+            failures.append("/debug store.shards has no per-pool txns")
+        if "store_shard_lock_wait_ms" not in metrics:
+            failures.append("/metrics missing shard lock-wait histogram")
+        if 'cook_store_shard_txns_total{pool="default"}' not in metrics \
+                and "store_shard_txns_total" not in metrics:
+            failures.append("/metrics missing per-pool shard txn counter")
         names = {sp["name"] for sp in trace["spans"]}
         for required in ("job.submit", "store.create_jobs",
                          "match.cycle", "launch_txn", "backend_launch",

@@ -189,3 +189,67 @@ def rebalance_oracle(running, spare, pending_job, shares,
         return None
     d, host, tasks, fm, fc = best
     return host, tasks, d
+
+
+def run_store_shard_trace(log_path, store_shards, native_encoder=True):
+    """Differential-oracle driver for the pool-sharded store: apply one
+    fixed, fully deterministic multi-pool trace — job submission across
+    three pools, bulk + single launches, bulk + single status folds,
+    progress, preemption, retry, kill — with explicit uuids/task ids
+    and a monotonic fake clock, then sync and close the writer.
+
+    Two runs differing ONLY in store_shards (or in the zero-copy
+    encoder toggle) must produce byte-identical event logs and
+    identical state hashes: shard count and encoding are performance
+    knobs, never semantics. Returns the (closed-writer) live store.
+    """
+    import itertools
+
+    import cook_tpu.state.store as store_mod
+    from cook_tpu.state.model import InstanceStatus, Job
+    from cook_tpu.state.store import JobStore
+
+    tick = itertools.count(1_700_000_000_000)
+    real_now = store_mod.now_ms
+    store_mod.now_ms = lambda: next(tick)
+    try:
+        s = JobStore(log_path=log_path, store_shards=store_shards)
+        s.native_encoder = bool(native_encoder)
+        pools = ["default", "gpu", "batch"]
+        jobs = [Job(uuid=f"00000000-0000-4000-8000-{i:012d}",
+                    user=f"u{i % 4}", command="true", mem=100.0 + i,
+                    cpus=1.0 + (i % 3), priority=50 + (i % 7),
+                    max_retries=2, pool=pools[i % 3])
+                for i in range(24)]
+        s.create_jobs(jobs)
+        tids = [f"11111111-0000-4000-8000-{i:012d}" for i in range(18)]
+        insts = s.create_instances_bulk(
+            [(j.uuid, f"h{i % 5}", "agents", tids[i])
+             for i, j in enumerate(jobs[:18])])
+        assert all(insts), "deterministic trace must launch cleanly"
+        lone = s.create_instance(
+            jobs[18].uuid, "h9", "mock",
+            task_id="22222222-0000-4000-8000-000000000000")
+        # bulk status folds spanning every pool at once (the consume-
+        # lane shape): RUNNING wave, then a mixed terminal wave that
+        # exercises every branch of the hand-built status line
+        s.update_instances_bulk(
+            [(t, InstanceStatus.RUNNING, None) for t in tids])
+        s.update_instance(lone.task_id, InstanceStatus.RUNNING)
+        s.update_progress(tids[0], 1, 50, "halfway")
+        s.update_instances_bulk([
+            (tids[0], InstanceStatus.SUCCESS, None),
+            (tids[1], InstanceStatus.FAILED, 1003,
+             {"exit_code": 1}),
+            (tids[2], InstanceStatus.FAILED, 2000),
+        ])
+        s.update_instance(tids[3], InstanceStatus.FAILED,
+                          reason_code=2000, preempted=True)
+        s.update_instance(lone.task_id, InstanceStatus.SUCCESS)
+        s.retry_job(jobs[1].uuid, 4)
+        s.kill_job(jobs[23].uuid)
+        s._log.sync()
+        s._log.close()
+        return s
+    finally:
+        store_mod.now_ms = real_now

@@ -701,6 +701,116 @@ def test_r8_suppression():
     assert fs == []
 
 
+def test_r8_append_segments_is_fenced_chokepoint():
+    # the zero-copy scatter-gather entry point is a first-class append:
+    # outside its chokepoint it is a fence bypass like any other
+    fs = run("""
+        class JobStore:
+            def sneak_segs(self, segs, n):
+                self._log.append_segments(segs, n)
+    """, rules=("R8",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R8"]
+    src = """
+        class JobStore:
+            def _append_segments(self, segs, nlines):
+                self._log.append_segments(segs, nlines)
+    """
+    assert run(src, rules=("R8",), path=_STORE_PATH) == []
+
+
+# ----------------------------------------------------------------------
+# R9 shard-lock discipline (state/store.py section helpers)
+
+
+def test_r9_shard_section_inside_global_flagged():
+    fs = run("""
+        class JobStore:
+            def bad_order(self, pool):
+                with self._lock:
+                    with self._pool_section(pool):
+                        pass
+
+            def bad_order_global(self, pools):
+                with self._global_section():
+                    with self._pools_section(pools):
+                        pass
+    """, rules=("R9",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R9", "R9"]
+    assert all("shard" in f.message for f in fs)
+
+
+def test_r9_nested_shard_sections_flagged():
+    fs = run("""
+        class JobStore:
+            def two_locks(self, a, b):
+                with self._pool_section(a):
+                    with self._pool_section(b):
+                        pass
+
+            def same_with(self, a, b):
+                with self._pool_section(a), self._pools_section(b):
+                    pass
+    """, rules=("R9",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R9", "R9"]
+    assert all("_pools_section" in f.message for f in fs)
+
+
+def test_r9_direct_shard_lock_access_flagged():
+    fs = run("""
+        class JobStore:
+            def sneak(self, idx):
+                self._shard_locks[idx].acquire()
+    """, rules=("R9",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R9"]
+    assert "acquisition order" in fs[0].message
+
+
+def test_r9_blessed_shapes_pass():
+    # the helpers own the order; shard→global nesting is the pinned
+    # direction; _global_section callers never touch shard state
+    src = """
+        class JobStore:
+            def __init__(self):
+                self._shard_locks = []
+
+            def _pool_section(self, pool):
+                lk = self._shard_locks[0]
+                with lk:
+                    yield
+
+            def _global_section(self):
+                for lk in self._shard_locks:
+                    lk.acquire()
+
+            def create_instance(self, pool):
+                with self._pool_section(pool, txn=True):
+                    with self._lock:
+                        pass
+
+            def snapshot(self):
+                with self._global_section():
+                    pass
+    """
+    assert run(src, rules=("R9",), path=_STORE_PATH) == []
+    # an unrelated module with the same shapes is not a store
+    assert run("""
+        class X:
+            def f(self):
+                with self._lock:
+                    with self._pool_section("p"):
+                        pass
+    """, rules=("R9",), path="cook_tpu/state/other.py") == []
+
+
+def test_r9_suppression():
+    fs = run("""
+        class JobStore:
+            def migrate(self, idx):
+                self._shard_locks[idx].acquire()  # cookcheck: disable=R9
+    """, rules=("R9",), path=_STORE_PATH)
+    assert fs == []
+
+
 def test_syntax_error_reports_r0():
     fs = analyze_source("def broken(:\n", "bad.py")
     assert rules_of(fs) == ["R0"]

@@ -29,6 +29,14 @@ point:
                       synced it) or torn, but it was never acked — on
                       restart reconciliation must surface zero lost
                       and zero duplicated instances
+  G  store.shard_append
+                      inside the owning shard's lock, after the launch
+                      record hits the (sharded) append path but BEFORE
+                      the cross-shard group-commit barrier runs — the
+                      pool-sharded store's version of F, with
+                      store_shards=4 forced on so the window under
+                      test is a real shard section, on both the bulk
+                      and the classic single-launch txn paths
 
 Traffic is a compressed production day: `cook_tpu.sim.generate_trace`
 with diurnal=True produces two workday bursts whose submit times are
@@ -92,6 +100,9 @@ SCHEDULES = {
                          sites={"store.ingest_txn": 0.3}),
     "F-group-commit": dict(seed=53, max_kills=2,
                            sites={"store.launch_group_commit": 0.5}),
+    "G-shard-append": dict(seed=67, max_kills=2,
+                           sites={"store.shard_append": 0.5},
+                           overrides={"store_shards": 4}),
 }
 
 

@@ -1238,3 +1238,105 @@ def test_bulk_launch_fast_encoder_escapes_hostile_strings(tmp_path):
     cold = JobStore.restore(log_path=log, open_writer=False)
     assert cold.get_instance(insts[1].task_id).hostname == evil
     assert cold.state_hash() == s.state_hash()
+
+
+def test_store_shard_differential_oracle(tmp_path):
+    """Sharding must be INVISIBLE in every durable artifact: the same
+    deterministic multi-pool trace run at store_shards 1, 4 and 7
+    produces byte-identical event logs, identical live state hashes,
+    identical cold-replay hashes, and identical DRU fair-queue
+    orderings over the surviving tasks. If any shard count changed any
+    of these, sharding would be a semantics change, not a perf knob."""
+    from tests.oracles import Task, dru_rank_oracle, run_store_shard_trace
+
+    runs = {}
+    for shards in (1, 4, 7):
+        log = str(tmp_path / f"log{shards}")
+        runs[shards] = (run_store_shard_trace(log, shards), log)
+    base_store, base_log = runs[1]
+    with open(base_log, "rb") as f:
+        base_bytes = f.read()
+    base_hash = base_store.state_hash()
+
+    def dru_order(store):
+        users, tasks = {}, []
+        for n, inst in enumerate(sorted(store.running_instances(),
+                                        key=lambda i: i.task_id)):
+            j = store.jobs[inst.job_uuid]
+            u = users.setdefault(j.user, len(users))
+            tasks.append(Task(id=n, user=u, mem=j.mem, cpus=j.cpus,
+                              priority=j.priority,
+                              start_time=inst.start_time_ms))
+        shares = {u: (1000.0, 10.0) for u in users.values()}
+        return [(t.id, round(d, 9))
+                for t, d in dru_rank_oracle(tasks, shares)]
+
+    base_order = dru_order(base_store)
+    assert base_order, "trace must leave running tasks to rank"
+    for shards, (s, log) in runs.items():
+        with open(log, "rb") as f:
+            assert f.read() == base_bytes, f"log diverged at {shards}"
+        assert s.state_hash() == base_hash
+        cold = JobStore.restore(log_path=log, open_writer=False)
+        assert cold.state_hash() == base_hash
+        assert dru_order(s) == base_order == dru_order(cold)
+
+
+def test_shard_encoder_toggle_byte_identical(tmp_path):
+    """The zero-copy segment encoder and the dict->json.dumps fallback
+    must write the SAME bytes — the native path is an encoding
+    strategy, not a format fork. (This is what makes _PyLogWriter a
+    safe fallback and cold replay writer-agnostic.)"""
+    from tests.oracles import run_store_shard_trace
+
+    la, lb = str(tmp_path / "native"), str(tmp_path / "bound")
+    sa = run_store_shard_trace(la, 4, native_encoder=True)
+    sb = run_store_shard_trace(lb, 4, native_encoder=False)
+    with open(la, "rb") as fa, open(lb, "rb") as fb:
+        assert fa.read() == fb.read()
+    assert sa.state_hash() == sb.state_hash()
+
+
+def test_concurrent_shard_lanes_replay_to_live_hash(tmp_path):
+    """Four lanes, one pool each, hammer the sharded store
+    concurrently: whatever interleaving the shard locks allow, the
+    durable log must replay to exactly the live state (hash equality
+    is no-lost-jobs + at-most-once in one digest), and the txn
+    counters must show every pool routed through a shard section."""
+    import threading
+
+    log = str(tmp_path / "log")
+    s = JobStore(log_path=log, store_shards=4)
+    pools = [f"p{i}" for i in range(4)]
+    jobs_by_pool = {}
+    for p in pools:
+        js = [mkjob(user=f"u-{p}", pool=p) for _ in range(12)]
+        s.create_jobs(js)
+        jobs_by_pool[p] = js
+    start = threading.Barrier(len(pools))
+
+    def lane(p):
+        start.wait()
+        insts = s.create_instances_bulk(
+            [(j.uuid, f"h-{p}", "agents") for j in jobs_by_pool[p]])
+        live = [i.task_id for i in insts if i is not None]
+        s.update_instances_bulk(
+            [(t, InstanceStatus.RUNNING, None) for t in live])
+        s.update_instances_bulk(
+            [(t, InstanceStatus.SUCCESS, None) for t in live])
+
+    threads = [threading.Thread(target=lane, args=(p,)) for p in pools]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = s.shard_stats()
+    assert stats["count"] == 4
+    assert sum(stats["txns"]) >= 3 * len(pools)
+    assert set(stats["txns_by_pool"]) >= set(pools)
+    want = s.state_hash()
+    s._log.sync()
+    s._log.close()
+    cold = JobStore.restore(log_path=log, open_writer=False)
+    assert cold.state_hash() == want
+    assert len(cold.task_to_job) == 48
