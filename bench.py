@@ -736,7 +736,8 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
               retention_s=120.0,
               label="e2e coordinator @ 100k-pending x 10k-offers",
               stats_out=None, durability_check=False, consider=None,
-              decision_provenance=None, pools=1, store_shards=4):
+              decision_provenance=None, pools=1, store_shards=4,
+              pipeline_depth=None, native=None):
     """END-TO-END production path: Coordinator.match_cycle itself — the
     durable store (100k pending + ~10k running), device-resident
     tensors updated by store-event deltas, the real launch transaction
@@ -823,6 +824,14 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
         # costs (fsync, launch RPC, dispatch overhead) over `consider`
         # decisions instead of the default 1024
         cfg.max_jobs_considered = consider
+    if pipeline_depth is not None:
+        # resident pipeline depth: enable_resident inherits it via
+        # config (kw.setdefault), so one knob covers every pool lane
+        cfg.pipeline_depth = int(pipeline_depth)
+    from cook_tpu.native import consumefold
+    native_was = consumefold.enabled()
+    if native is not None:
+        consumefold.set_enabled(bool(native))
     preg = PoolRegistry(pool_names[0])
     for name in pool_names[1:]:
         preg.add(Pool(name=name))
@@ -968,7 +977,9 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             [], [], [], [], [], []
         rtt_probe, qwait = [], []
         phase_keys = ("drain_ms", "ship_ms", "dispatch_ms", "launch_loop_ms",
-                      "launch_txn_ms", "backend_launch_ms")
+                      "launch_txn_ms", "backend_launch_ms",
+                      "consume_fold_ms", "consume_frame_ms",
+                      "consume_bookkeep_ms")
         phases = {k: [] for k in phase_keys}
         completed_total = 0
         resyncs = []   # (cycle, ms) — the default 560 cycles cross the
@@ -1227,6 +1238,9 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             "cycles": len(wall),
             "pools": K,
             "store_shards": store_shards,
+            "pipeline_depth": coord._resident[pool_names[0]].pipeline_depth,
+            "native_consume": consumefold.enabled(),
+            "native_available": consumefold.native_available(),
             "wall_s": round(total_s, 1),
             "device": str(jax.devices()[0]),
         }
@@ -1236,6 +1250,7 @@ def bench_e2e(P0=100_000, H=10_000, U=500, cycles=560, warmup=15,
             stats_out.update(out)
         print(json.dumps(out), flush=True)
     finally:
+        consumefold.set_enabled(native_was)
         try:
             rot_stop.set()
             rot_thread.join(timeout=30)
@@ -2006,12 +2021,20 @@ def main():
         # historical single-pool shape the dps floor was calibrated on
         # (multi-pool pays 4x the fixed JAX dispatch cost per cycle, so
         # its absolute dps is only comparable to itself).
+        # E2E_SMOKE_DEPTH / E2E_SMOKE_NATIVE are the consume-fast-path
+        # A/B arms: depth 0 = the synchronous PR-12 consume shape,
+        # native=0 = the byte-identical Python folds. The default is
+        # the production shape (depth 2, native on).
         shards = int(os.environ.get("E2E_SMOKE_SHARDS", "4"))
         pools = int(os.environ.get("E2E_SMOKE_POOLS", "4"))
+        depth = int(os.environ.get("E2E_SMOKE_DEPTH", "2"))
+        native = bool(int(os.environ.get("E2E_SMOKE_NATIVE", "1")))
         bench_e2e(P0=20_000, H=2_000, cycles=60, warmup=10,
                   durability_check=True, pools=pools, store_shards=shards,
+                  pipeline_depth=depth, native=native,
                   label=f"e2e perf smoke @ 20k-pending x 2k-offers, "
-                        f"{pools} pools x {shards} shards")
+                        f"{pools} pools x {shards} shards, depth {depth}, "
+                        f"native {'on' if native else 'off'}")
     elif which == "e2e-batched":
         # batched matcher on the resident path (exact head + audited
         # windows instead of the full C-step sequential scan)

@@ -49,6 +49,7 @@ _CHAOS_SITES = {
     "/agents/register": "agent.register",
     "/agents/heartbeat": "agent.heartbeat",
     "/agents/status": "agent.status_post",
+    "/agents/status/bulk": "agent.status_post",
     "/agents/progress": "agent.progress_post",
 }
 
@@ -93,6 +94,18 @@ class AgentDaemon:
         self._outbox_lock = threading.Lock()
         self.outbox_max = int(outbox_max)
         self.outbox_dropped = 0
+        # status coalescer: callbacks enqueue here and the FIRST caller
+        # becomes the sender, draining whatever accumulated while the
+        # previous send was on the wire as ONE bulk POST. Uncontended,
+        # a status still delivers synchronously inside its own callback
+        # (no detached sender thread — callers see delivery/outbox
+        # effects when _on_status returns, exactly like the old path).
+        self._status_q: list[dict] = []
+        self._status_lock = threading.Lock()
+        self._status_sending = False
+        # latched on the first 404/405 from /agents/status/bulk: an old
+        # coordinator without the bulk route gets singular posts forever
+        self._bulk_unsupported = False
         # delivery policies: statuses get a few jittered tries, the
         # blocking register loop retries until shutdown (the daemon is
         # useless unregistered, so there is no deadline)
@@ -299,15 +312,55 @@ class AgentDaemon:
                                   "t1": time.time() * 1000.0})
                 payload["traceparent"] = entry["tp"]
                 payload["spans"] = spans
-        if not self._post_retry("/agents/status", payload):
-            # terminal statuses must not be lost to a leaderless window
-            # (the task is gone from later heartbeat task lists, so the
-            # diff safety net can't recover it): queue for redelivery
-            # after the next successful register/heartbeat
-            with self._outbox_lock:
-                self._outbox.append(payload)
-                self._trim_outbox_locked()
-            logger.warning("queued undelivered status for %s", task_id)
+        self._send_status(payload)
+
+    def _send_status(self, payload: dict) -> None:
+        """Enqueue one status and drain the queue unless another
+        thread is already sending. A burst of executor completions
+        (bench scale: hundreds of mock tasks finishing in one tick)
+        collapses into a handful of bulk POSTs instead of a per-task
+        round trip each; a lone status delivers synchronously."""
+        with self._status_lock:
+            self._status_q.append(payload)
+            if self._status_sending:
+                return
+            self._status_sending = True
+        try:
+            while True:
+                with self._status_lock:
+                    if not self._status_q:
+                        self._status_sending = False
+                        return
+                    batch, self._status_q = self._status_q, []
+                self._deliver_statuses(batch)
+        except BaseException:
+            with self._status_lock:
+                self._status_sending = False
+            raise
+
+    def _deliver_statuses(self, batch: list) -> None:
+        if len(batch) > 1 and not self._bulk_unsupported:
+            try:
+                self._post("/agents/status/bulk", {"updates": batch})
+                return
+            except urllib.error.HTTPError as e:
+                if e.code in (404, 405):
+                    # old coordinator: remember and stop probing
+                    self._bulk_unsupported = True
+            except Exception:
+                pass  # singular path below owns retry + outbox
+        for payload in batch:
+            if not self._post_retry("/agents/status", payload):
+                # terminal statuses must not be lost to a leaderless
+                # window (the task is gone from later heartbeat task
+                # lists, so the diff safety net can't recover it):
+                # queue for redelivery after the next successful
+                # register/heartbeat
+                with self._outbox_lock:
+                    self._outbox.append(payload)
+                    self._trim_outbox_locked()
+                logger.warning("queued undelivered status for %s",
+                               payload.get("task_id"))
 
     def _trim_outbox_locked(self) -> None:
         while len(self._outbox) > self.outbox_max:
@@ -475,6 +528,10 @@ class AgentDaemon:
         # heartbeat, but reconciliation runs before that).
         with self._outbox_lock:
             undelivered = list(self._outbox)
+        with self._status_lock:
+            # statuses still in the coalescer queue are just as
+            # undelivered as the outbox's from the census's viewpoint
+            undelivered += list(self._status_q)
         return {"hostname": self.hostname,
                 "tasks": sorted(self.executor.alive_task_ids()),
                 "undelivered": undelivered,

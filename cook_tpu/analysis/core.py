@@ -22,7 +22,8 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
+             "R10")
 
 # which rule families run over which package subdirectories when
 # scanning a tree (explicit file arguments get every AST rule)
@@ -38,6 +39,7 @@ RULE_DIRS = {
            "state", "utils", "integrations"),
     "R8": ("state",),
     "R9": ("state",),
+    "R10": ("state", "backends", "scheduler", "native", "agent"),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -168,13 +170,14 @@ def diff_baseline(findings: list[Finding], baseline: dict[str, int]
 
 def analyze_source(source: str, path: str,
                    rules: Iterable[str] = ("R1", "R2", "R3", "R5", "R6",
-                                           "R7", "R8", "R9"),
+                                           "R7", "R8", "R9", "R10"),
                    apply_suppressions: bool = True) -> list[Finding]:
     """Run the per-module AST rules over one source text."""
-    from cook_tpu.analysis import (async_hygiene, epoch_discipline,
-                                   lock_discipline, metrics_discipline,
-                                   retry_discipline, shard_discipline,
-                                   span_discipline, trace_purity)
+    from cook_tpu.analysis import (async_hygiene, consume_discipline,
+                                   epoch_discipline, lock_discipline,
+                                   metrics_discipline, retry_discipline,
+                                   shard_discipline, span_discipline,
+                                   trace_purity)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -199,6 +202,8 @@ def analyze_source(source: str, path: str,
         findings += epoch_discipline.check(mod)
     if "R9" in rules:
         findings += shard_discipline.check(mod)
+    if "R10" in rules:
+        findings += consume_discipline.check(mod)
     if apply_suppressions:
         sup = collect_suppressions(source)
         findings = [f for f in findings if not suppressed(f, sup)]

@@ -32,6 +32,7 @@ from typing import Optional
 from cook_tpu import obs
 from cook_tpu.backends import specwire
 from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
+from cook_tpu.native import consumefold
 from cook_tpu.scheduler.liveness import DEAD, RESURRECTED
 from cook_tpu.state.model import InstanceStatus, now_ms
 from cook_tpu.utils.breaker import (
@@ -244,6 +245,28 @@ class AgentCluster(ComputeCluster):
         u[2] += spec.gpus
         u[3] += 1
 
+    def _track_bulk_locked(self, specs: list, hostname: str,
+                           t0: int) -> None:
+        """Batch twin of _track_locked for one host's launch batch:
+        the per-host used aggregate is folded ONCE from the batch's
+        resource totals (native consume chokepoint) instead of four
+        float adds per spec on the launch path. Adding the subtotal
+        can differ from per-spec accumulation in the last ulp; that is
+        exactly the float residue _untrack_locked's drop-at-zero rule
+        already clears (un-counting is per-spec and exact either
+        way)."""
+        for s in specs:
+            self._specs[s.task_id] = (s, hostname, t0)
+        mem, cpus, gpus = consumefold.usage_totals(
+            [(s.mem, s.cpus, s.gpus) for s in specs])
+        u = self._used.get(hostname)
+        if u is None:
+            u = self._used[hostname] = [0.0, 0.0, 0.0, 0]
+        u[0] += mem
+        u[1] += cpus
+        u[2] += gpus
+        u[3] += len(specs)
+
     def _untrack_locked(self, task_id: str):
         """Inverse of _track_locked; returns the popped entry (or
         None). Un-counts the exact resources counted in, and drops the
@@ -337,10 +360,13 @@ class AgentCluster(ComputeCluster):
             except (KeyError, TypeError, ValueError):
                 continue
 
-    def status_report(self, payload: dict) -> dict:
-        """POST /agents/status: executor events relayed over the wire.
-        Same event -> instance-status mapping as the in-process local
-        backend (executor exit-code reporting)."""
+    def _status_update(self, payload: dict):
+        """Map one executor status payload to its (task_id, status,
+        reason, extras) emit tuple, performing the non-emit side
+        effects (liveness, spans, adoption, _forget). Returns None for
+        payloads this cluster cannot vouch for. Shared by the singular
+        and bulk ingestion paths so the event -> instance-status
+        mapping cannot drift between them."""
         task_id = payload["task_id"]
         event = payload.get("event", "")
         exit_code = payload.get("exit_code")
@@ -361,39 +387,64 @@ class AgentCluster(ComputeCluster):
             hostname = payload.get("hostname", "")
             if res is None or not hostname or \
                     res[1].hostname != hostname:
-                return {"ok": False, "unknown": True}
+                return None
             self._try_adopt(task_id, hostname, resolved=res)
             with self._lock:
                 entry = self._specs.get(task_id)
             if entry is None:
-                return {"ok": False, "unknown": True}
+                return None
         with self._lock:
             info = self.agents.get(entry[1])
             output_url = info.file_server_url if info else ""
         if event == "running":
-            self.emit_status(task_id, InstanceStatus.RUNNING, None,
-                             sandbox=sandbox, output_url=output_url)
-            return {"ok": True}
+            return (task_id, InstanceStatus.RUNNING, None,
+                    {"sandbox": sandbox, "output_url": output_url})
         if event == "fetch_failed":
             self._forget(task_id)
-            self.emit_status(task_id, InstanceStatus.FAILED,
-                             REASON_LAUNCH_FAILED, sandbox=sandbox,
-                             output_url=output_url)
-            return {"ok": True}
+            return (task_id, InstanceStatus.FAILED,
+                    REASON_LAUNCH_FAILED,
+                    {"sandbox": sandbox, "output_url": output_url})
         self._forget(task_id)
         if event == "killed":
-            self.emit_status(task_id, InstanceStatus.FAILED, 1004,
-                             exit_code=exit_code, sandbox=sandbox,
-                             output_url=output_url)
-        elif exit_code == 0:
-            self.emit_status(task_id, InstanceStatus.SUCCESS, None,
-                             exit_code=0, sandbox=sandbox,
-                             output_url=output_url)
-        else:
-            self.emit_status(task_id, InstanceStatus.FAILED, 1003,
-                             exit_code=exit_code, sandbox=sandbox,
-                             output_url=output_url)
+            return (task_id, InstanceStatus.FAILED, 1004,
+                    {"exit_code": exit_code, "sandbox": sandbox,
+                     "output_url": output_url})
+        if exit_code == 0:
+            return (task_id, InstanceStatus.SUCCESS, None,
+                    {"exit_code": 0, "sandbox": sandbox,
+                     "output_url": output_url})
+        return (task_id, InstanceStatus.FAILED, 1003,
+                {"exit_code": exit_code, "sandbox": sandbox,
+                 "output_url": output_url})
+
+    def status_report(self, payload: dict) -> dict:
+        """POST /agents/status: executor events relayed over the wire.
+        Same event -> instance-status mapping as the in-process local
+        backend (executor exit-code reporting)."""
+        upd = self._status_update(payload)
+        if upd is None:
+            return {"ok": False, "unknown": True}
+        self.emit_status(upd[0], upd[1], upd[2], **upd[3])
         return {"ok": True}
+
+    def status_report_bulk(self, payloads: list) -> dict:
+        """POST /agents/status/bulk: a daemon's coalesced status batch,
+        folded through ONE emit_status_bulk call — at bench scale the
+        per-item HTTP round trip (and per-item shard submit on the
+        coordinator side) dominates status ingestion. Per-item results
+        mirror the singular endpoint's bodies, positionally."""
+        updates = []
+        results = []
+        for payload in payloads:
+            upd = self._status_update(payload)
+            if upd is None:
+                results.append({"ok": False, "unknown": True})
+            else:
+                updates.append(upd)
+                results.append({"ok": True})
+        if updates:
+            self.emit_status_bulk(updates)
+        return {"ok": True, "results": results, "applied": len(updates)}
 
     def progress_report(self, payload: dict) -> dict:
         """POST /agents/progress (the framework-message progress path,
@@ -499,9 +550,7 @@ class AgentCluster(ComputeCluster):
             if info is None or not info.alive:
                 info = None
             else:
-                t0 = now_ms()
-                for s in host_specs:
-                    self._track_locked(s, hostname, t0)
+                self._track_bulk_locked(host_specs, hostname, now_ms())
         if info is None:
             for s in host_specs:
                 self.emit_status(s.task_id, InstanceStatus.FAILED,

@@ -128,12 +128,17 @@ class ComputeCluster(abc.ABC):
             cb(task_id, status, reason, **extra)
 
     def emit_status_bulk(self, updates) -> None:
+        """updates: (task_id, status, reason) or (task_id, status,
+        reason, extras_dict) tuples — the 4-tuple form carries the
+        per-item kwargs (exit_code/sandbox/output_url) the singular
+        channel passes as **extra."""
         cb = getattr(self, "_bulk_status_cb", None)
         if cb is not None:
             cb(updates)
         else:
-            for task_id, status, reason in updates:
-                self.emit_status(task_id, status, reason)
+            for upd in updates:
+                extra = upd[3] if len(upd) > 3 and upd[3] else {}
+                self.emit_status(upd[0], upd[1], upd[2], **extra)
 
     # lifecycle / recovery ------------------------------------------------
     def initialize(self) -> None:

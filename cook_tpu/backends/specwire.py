@@ -32,6 +32,8 @@ from __future__ import annotations
 import json
 import struct
 
+from cook_tpu.native import consumefold
+
 MAGIC = b"CKS1"
 WIRE_FORMAT = "cks1"              # capability token in register payload
 CONTENT_TYPE = "application/x-cook-specs"
@@ -107,8 +109,12 @@ def encode_spec_segment(spec) -> bytes:
 
 def frame_segments(segments: list[bytes]) -> bytes:
     """Assemble a CKS1 frame from pre-encoded per-spec segments
-    (byte-identical to ``encode_specs`` over the same specs)."""
-    return b"".join((MAGIC, _U32.pack(len(segments)), *segments))
+    (byte-identical to ``encode_specs`` over the same specs). The
+    splice runs behind the native consume chokepoint — at bench scale
+    a 1k-match cycle frames hundreds of segments per host POST, and
+    consumefold does it in one C pass (or one Python join)."""
+    return consumefold.frame_concat(
+        MAGIC + _U32.pack(len(segments)), segments)
 
 
 class _Cursor:

@@ -174,6 +174,21 @@ class SchedulerSettings:
     # shedding, zero hot-path reads.
     overload_enabled: bool = True
     overload_cycle_p99_ms: float = 1000.0
+    # consume pipeline depth (resident match path): how many matched
+    # cycles may be in flight between the device match and the host
+    # consume/launch fold. 0 = strictly synchronous (each cycle's
+    # consume completes before the next dispatch); N>0 lets the device
+    # run N cycles ahead while the host folds earlier results —
+    # overlapping readback with status/launch work is where the
+    # single-leader dispatch rate comes from. Async pools size their
+    # consume backpressure from the same knob (min 2).
+    pipeline_depth: int = 2
+    # native consume fast path (cook_tpu/native/consumefold): C folds
+    # for status-line assembly, CKS1 frame splicing and _used
+    # bookkeeping. Byte-identical Python fallback; false forces the
+    # Python path process-wide (operational escape hatch — the
+    # differential oracle pins both paths together).
+    native_consume: bool = True
     overload_launch_txn_p99_ms: float = 500.0
     overload_escalate_after: int = 3
     overload_relax_after: int = 10
@@ -197,6 +212,9 @@ class SchedulerSettings:
         if self.rebalancer_candidate_cap < 0:
             raise ConfigError("rebalancer_candidate_cap must be >= 0 "
                               "(0 = exact sweep)")
+        if not 0 <= self.pipeline_depth <= 8:
+            raise ConfigError("pipeline_depth must be in [0, 8] "
+                              "(0 = synchronous consume)")
         if not isinstance(self.use_pallas, bool) \
                 and str(self.use_pallas).lower() != "auto":
             raise ConfigError(

@@ -319,6 +319,7 @@ class CookApi:
         r.add("POST", "/agents/register", self.agent_register)
         r.add("POST", "/agents/heartbeat", self.agent_heartbeat)
         r.add("POST", "/agents/status", self.agent_status)
+        r.add("POST", "/agents/status/bulk", self.agent_status_bulk)
         r.add("POST", "/agents/progress", self.agent_progress)
         r.add("GET", "/agents", self.agent_list)
         # machine-readable self-description (swagger role,
@@ -416,6 +417,21 @@ class CookApi:
         if "task_id" not in body:
             raise ApiError(400, "task_id is required")
         return Response(200, self._agent_cluster().status_report(body))
+
+    def agent_status_bulk(self, req: Request) -> Response:
+        """Coalesced executor statuses from one daemon: the whole
+        batch rides one POST and one emit_status_bulk fold. Daemons
+        fall back to the singular endpoint when this route 404s (old
+        leaders keep working unmodified)."""
+        body = req.body or {}
+        updates = body.get("updates")
+        if not isinstance(updates, list) or not updates:
+            raise ApiError(400, "updates must be a non-empty list")
+        for upd in updates:
+            if not isinstance(upd, dict) or "task_id" not in upd:
+                raise ApiError(400, "every update needs a task_id")
+        return Response(
+            200, self._agent_cluster().status_report_bulk(updates))
 
     def agent_progress(self, req: Request) -> Response:
         body = req.body or {}

@@ -24,6 +24,7 @@ _BODY_HINTS = {
     ("POST", "/retry"): "RetryRequest",
     ("POST", "/share"): "LimitUpdate",
     ("POST", "/quota"): "LimitUpdate",
+    ("POST", "/agents/status/bulk"): "AgentStatusBulk",
 }
 
 _SCHEMAS = {
@@ -73,6 +74,22 @@ _SCHEMAS = {
                        "gpus": {"type": "number"},
                        "count": {"type": "integer"},
                        "reason": {"type": "string"}},
+    },
+    "AgentStatusBulk": {
+        "type": "object",
+        "required": ["updates"],
+        "properties": {
+            "updates": {"type": "array", "items": {
+                "type": "object",
+                "required": ["task_id"],
+                "properties": {
+                    "task_id": {"type": "string"},
+                    "event": {"type": "string"},
+                    "exit_code": {"type": "integer"},
+                    "hostname": {"type": "string"},
+                    "sandbox": {"type": "string"},
+                }}},
+        },
     },
 }
 

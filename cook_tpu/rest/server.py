@@ -376,6 +376,11 @@ def build_scheduler(config, read_only=False):
     quotas = FederatedQuotaView(fed)
 
     s = config.scheduler
+    # native consume fast path: a process-wide switch, latched here so
+    # every consumer (store status folds, CKS1 framing, agent _used
+    # bookkeeping) honors the operator's setting
+    from cook_tpu.native import consumefold
+    consumefold.set_enabled(s.native_consume)
     overload = None
     if s.overload_enabled:
         # coordinator-owned shed ladder (scheduler/overload.py); signal
@@ -405,6 +410,7 @@ def build_scheduler(config, read_only=False):
                                            s.max_jobs_considered),
             launch_ack_timeout_s=s.launch_ack_timeout_s,
             consume_workers=s.consume_workers,
+            pipeline_depth=s.pipeline_depth,
             decision_provenance=s.decision_provenance,
             heartbeat_timeout_s=s.heartbeat_timeout_s),
         launch_rate_limiter=make_rl("global_launch"),

@@ -114,6 +114,17 @@ class SchedulerConfig:
     # different pools drain concurrently instead of serializing on the
     # single consumer thread this replaced.
     consume_workers: int = 4
+    # resident pipeline depth: cycles allowed in flight between
+    # dispatch and consume. Sync pools double-/multi-buffer on the
+    # cycle thread itself (cycle N+1 matches on device while cycle N's
+    # consume/launch fan-out runs); async pools size their per-pool
+    # consume-backpressure window from it (min 2, the historical
+    # constant). 0 = classic inline consume — the default, because
+    # matching is depth-invariant (rows invalidate in-kernel, capacity
+    # chains device-side) but tests expect consume effects when
+    # match_cycle returns. enable_resident(pipeline_depth=...) still
+    # overrides per pool; settings wire this through build_scheduler.
+    pipeline_depth: int = 0
     # per-task executor heartbeat timeout (HeartbeatWatcher): a RUNNING
     # task whose executor goes silent this long fails 3000 (mea-culpa).
     # Cook's default of 15 min; settings wire it through build_scheduler
@@ -539,6 +550,10 @@ class Coordinator:
             if q is not None:
                 q.put(None)    # retire the thread
             self._resident.pop(pool, None)
+        # config-level depth applies unless the caller pins one
+        # explicitly (tests pass pipeline_depth=; the server wires
+        # Settings.pipeline_depth through SchedulerConfig)
+        kw.setdefault("pipeline_depth", self.config.pipeline_depth)
         rp = ResidentPool(self, pool, synchronous=synchronous, **kw)
         self._resident[pool] = rp
         if not synchronous:
@@ -559,9 +574,13 @@ class Coordinator:
             self._threads.append(t)
         if not synchronous:
             # per-pool consume backpressure (the role the old shared
-            # maxsize=2 queue played, now per pool): at most 2 cycles
-            # outstanding between dispatch and consumed
-            rp._consume_slots = threading.BoundedSemaphore(2)
+            # maxsize=2 queue played, now per pool): at most
+            # max(2, pipeline_depth) cycles outstanding between
+            # dispatch and consumed — deepening the pipeline lets the
+            # dispatcher run further ahead of a bursty consumer before
+            # blocking (2 stays the floor: it is the minimum overlap)
+            rp._consume_slots = threading.BoundedSemaphore(
+                max(2, rp.pipeline_depth))
         if not synchronous and getattr(self, "_consume_shards",
                                        None) is None:
             # keyed in-order consume executor: cycles of ONE pool stay
@@ -816,8 +835,17 @@ class Coordinator:
             from cook_tpu.scheduler.resident import _NeedResync
             if isinstance(e, _NeedResync):
                 log.info("resident resync (%s)", e)
+                t_rs = time.perf_counter()
                 self.drain_resident(pool)
                 rp.resync()
+                # record the overflow rebuild like the planned paths
+                # do — otherwise its seconds hide inside drain_ms and
+                # the bench's resync ledger reads clean
+                self.metrics[f"match.{pool}.resync_ms"] = \
+                    (time.perf_counter() - t_rs) * 1e3
+                metrics_registry.histogram(
+                    "resync_ms", pool=pool, reason="overflow").observe(
+                    (time.perf_counter() - t_rs) * 1e3)
                 deltas = rp.drain()
                 t_drain = time.perf_counter()
                 bundle = rp._ship(deltas)
@@ -1052,6 +1080,12 @@ class Coordinator:
                         "decisions_total", pool=pool,
                         outcome=dprov.CODE_NAMES.get(code, str(code)),
                     ).inc(n)
+        # fold done: matched rows joined against the mirrors, credits
+        # queued, provenance recorded — the first of the three consume
+        # phases the e2e bench breaks out (fold / frame / bookkeep)
+        t_fold = time.perf_counter()
+        self.metrics[f"match.{pool}.consume_fold_ms"] = \
+            (t_fold - t_rb1) * 1e3
         # policy pass OUTSIDE the mirror lock: a slow launch plugin or
         # port allocator must not block the cycle thread's drain (the
         # same rule _maybe_refresh_locality follows for cost fetches)
@@ -1149,6 +1183,14 @@ class Coordinator:
         t_loop = time.perf_counter()
         self.metrics[f"match.{pool}.launch_loop_ms"] = \
             (t_loop - t_rb1) * 1e3
+        self.metrics[f"match.{pool}.consume_frame_ms"] = \
+            (t_loop - t_fold) * 1e3
+        # chaos: a SIGKILL in the consume window — after the device
+        # readback fold, before the launch-txn append — must lose no
+        # job and launch nothing twice: no instance exists yet, the
+        # device-side depletion dies with the process, and the restart
+        # rebuilds from the last committed event (zero-cost disarmed)
+        procfault.kill_point("consume.window")
         # one span id for the whole bulk launch transaction: it rides
         # on the durable "insts" log record AND appears (same id) as
         # the launch_txn child in every launched traced job's tree
@@ -1157,8 +1199,9 @@ class Coordinator:
         insts = self.store.create_instances_bulk(
             items, origin=("resident", pool, out.cycle_no),
             span_id=txn_sid) if items else []
+        t_txn = time.perf_counter()
         self.metrics[f"match.{pool}.launch_txn_ms"] = \
-            (time.perf_counter() - t_loop) * 1e3
+            (t_txn - t_loop) * 1e3
         if items:
             metrics_registry.histogram("launch_txn_ms", pool=pool) \
                 .observe(self.metrics[f"match.{pool}.launch_txn_ms"])
@@ -1197,6 +1240,12 @@ class Coordinator:
                 self.heartbeats.track(inst.task_id)
             self.launch_rl.spend("global")
             self.reservations.pop(uuid, None)
+        # bookkeep done: the post-txn result fold (credits for refused
+        # rows, heartbeat tracking, rate-limiter spend) — third consume
+        # phase; what follows is the backend hand-off
+        t_book = time.perf_counter()
+        self.metrics[f"match.{pool}.consume_bookkeep_ms"] = \
+            (t_book - t_txn) * 1e3
         launch_q = getattr(rp, "_launch_q", None)
         for cname, specs in by_cluster.items():
             if launch_q is not None:
@@ -1265,6 +1314,9 @@ class Coordinator:
                 "total_ms": (t_end - t_rb0) * 1e3,
                 "readback_ms": (t_rb1 - t_rb0) * 1e3,
                 "loop_ms": (t_loop - t_rb1) * 1e3,
+                "fold_ms": (t_fold - t_rb1) * 1e3,
+                "frame_ms": (t_loop - t_fold) * 1e3,
+                "bookkeep_ms": (t_book - t_txn) * 1e3,
                 "txn_ms": self.metrics[f"match.{pool}.launch_txn_ms"],
                 "backend_ms":
                     self.metrics[f"match.{pool}.backend_launch_ms"],
@@ -1508,6 +1560,11 @@ class Coordinator:
             self.metrics[f"match.{pool}.head_exact"] = head.head
             self.metrics[f"match.{pool}.head_inversions"] = inv
 
+        # chaos: same consume-window site as the resident path — after
+        # the match readback fold, before any launch txn appends. Both
+        # match paths must survive a SIGKILL here with zero lost jobs
+        # and at-most-once launch.
+        procfault.kill_point("consume.window")
         # launch matched tasks: store txn first, then backend launch
         # (launch-matched-tasks! scheduler.clj:754-805)
         # per-host port pools for this cycle, consumed in queue order

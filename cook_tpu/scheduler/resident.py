@@ -545,9 +545,28 @@ class ResidentPool:
         # otherwise start at 1024 running rows and cascade through
         # growth rebuilds as the first cycles launch (rows are ~40
         # bytes each — slack is cheap, rebuilds are seconds).
-        Pcap = bucket(max(len(pending) + len(pending) // 5, 1024))
-        Rcap = bucket(max(len(run_insts) + len(run_insts) // 5,
-                          len(pending) // 8, 1024))
+        # Pipelined consume adds its own headroom term: a matched
+        # pending row is freed (and a completed running row released)
+        # only when the lagging consume folds, up to pipeline_depth
+        # cycles after dispatch, while refills keep claiming fresh
+        # rows — at steady state the transient overshoot is up to
+        # depth x considerable on BOTH tables, and without covering it
+        # the pool full-resyncs every few cycles (the rebuild cost
+        # hiding inside drain_ms).
+        head = self.pipeline_depth * \
+            self.coord.config.max_jobs_considered
+        # caps are monotone non-shrinking for the pool's lifetime:
+        # resizing DOWN to the current backlog re-buckets the jit
+        # shapes (a multi-second recompile) and sits the pool right
+        # back at the edge that overflowed it — a burst-refill then
+        # oscillates between two buckets, full-resyncing every few
+        # cycles. Rows are ~40 bytes; holding the high-water bucket is
+        # noise next to one recompile.
+        Pcap = bucket(max(len(pending) + len(pending) // 5 + head,
+                          1024, getattr(self, "Pcap", 0)))
+        Rcap = bucket(max(len(run_insts) + len(run_insts) // 5 + head,
+                          len(pending) // 8, 1024,
+                          getattr(self, "Rcap", 0)))
         self.Pcap, self.Rcap = Pcap, Rcap
         while True:
             try:

@@ -191,6 +191,98 @@ def rebalance_oracle(running, spare, pending_job, shares,
     return host, tasks, d
 
 
+def run_consume_trace(log_path, pipeline_depth=0, native=True):
+    """Differential-oracle driver for the consume fast path: one fixed
+    deterministic trace through a REAL coordinator on the resident
+    match path — jobs created up front, several match cycles whose
+    per-cycle intake is capped (so multiple cycles do real consume
+    work), a drain, then a mixed terminal status wave through the
+    store's bulk fold.
+
+    Runs differing ONLY in `pipeline_depth` (0/1/2) or in the native
+    consume toggle must produce byte-identical event logs and
+    identical live/cold state hashes: dispatch makes no store calls
+    (matched rows are invalidated in-kernel and capacity chains
+    device-side), so deeper pipelining reorders nothing the log can
+    see, and consumefold's C folds are byte-twins of the Python ones.
+    All job creation happens BEFORE the first cycle on purpose — store
+    writes interleaved between cycles would land at different points
+    relative to the (legitimately lagging) consumes and break byte
+    identity without signifying a bug. Returns the (closed-writer)
+    live store."""
+    import itertools
+
+    import cook_tpu.scheduler.coordinator as coord_mod
+    import cook_tpu.state.store as store_mod
+    from cook_tpu.backends.base import ClusterRegistry
+    from cook_tpu.backends.mock import MockCluster, MockHost
+    from cook_tpu.native import consumefold
+    from cook_tpu.scheduler.coordinator import (Coordinator,
+                                                SchedulerConfig)
+    from cook_tpu.state.model import InstanceStatus, Job
+    from cook_tpu.state.store import JobStore
+
+    tick = itertools.count(1_700_000_000_000)
+    ids = itertools.count()
+    real_now = store_mod.now_ms
+    real_uuid = coord_mod.new_uuid
+    was_enabled = consumefold.enabled()
+    store_mod.now_ms = lambda: next(tick)
+    coord_mod.new_uuid = \
+        lambda: f"33333333-0000-4000-8000-{next(ids):012d}"
+    consumefold.set_enabled(native)
+    try:
+        s = JobStore(log_path=log_path)
+        cluster = MockCluster([MockHost(f"h{i}", mem=4000.0, cpus=64.0)
+                               for i in range(4)])
+        reg = ClusterRegistry()
+        reg.register(cluster)
+        coord = Coordinator(s, reg, config=SchedulerConfig(
+            max_jobs_considered=8,
+            pipeline_depth=pipeline_depth))
+        coord.enable_resident(synchronous=True)
+        # ONE user on purpose: a deeper pipeline ranks cycle N+1
+        # before cycle N's launches are folded into the fair-share run
+        # usage, so multi-user DRU interleave legitimately reorders
+        # the capped intake window across depths. A single user's
+        # cumulative usage shifts every DRU equally (ordering is
+        # priority/start/id only), which makes the matched set — and
+        # therefore the log bytes — depth-invariant, isolating exactly
+        # what this oracle pins: the consume-side folds.
+        jobs = [Job(uuid=f"00000000-0000-4000-8000-{i:012d}",
+                    user="oracle", command="true", mem=50.0 + i,
+                    cpus=1.0 + (i % 2), priority=50 + (i % 5),
+                    max_retries=1)
+                for i in range(24)]
+        s.create_jobs(jobs)
+        for _ in range(5):
+            coord.match_cycle()
+        coord.drain_resident()
+        running = sorted(i.task_id for i in s.running_instances())
+        assert len(running) >= 16, \
+            "deterministic trace must launch most of the backlog"
+        # terminal wave for a third of the fleet, hitting every branch
+        # of the hand-built status line (success, plain fail with exit
+        # code, fail-without-exit, preemption); the rest stay RUNNING
+        # so the DRU ordering check has survivors to rank
+        done = running[: len(running) // 3]
+        s.update_instances_bulk(
+            [(t, InstanceStatus.SUCCESS, None) if n % 4 == 0 else
+             (t, InstanceStatus.FAILED, 1003, {"exit_code": 1 + n})
+             if n % 4 == 1 else
+             (t, InstanceStatus.FAILED, 2000)
+             if n % 4 == 2 else
+             (t, InstanceStatus.FAILED, 1004, {"exit_code": 137})
+             for n, t in enumerate(done)])
+        s._log.sync()
+        s._log.close()
+        return s
+    finally:
+        store_mod.now_ms = real_now
+        coord_mod.new_uuid = real_uuid
+        consumefold.set_enabled(was_enabled)
+
+
 def run_store_shard_trace(log_path, store_shards, native_encoder=True):
     """Differential-oracle driver for the pool-sharded store: apply one
     fixed, fully deterministic multi-pool trace — job submission across

@@ -370,6 +370,155 @@ def test_daemon_outbox_redelivers_terminal_status(stack, tmp_path):
     assert d._outbox == []
 
 
+def _store_with_running_many(n, hostname="ha-agent"):
+    store = JobStore()
+    jobs = [mkjob() for _ in range(n)]
+    store.create_jobs(jobs)
+    tids = []
+    for j in jobs:
+        inst = store.create_instance(j.uuid, hostname, "agents")
+        store.update_instance(inst.task_id, InstanceStatus.RUNNING)
+        tids.append(inst.task_id)
+    return store, jobs, tids
+
+
+def test_status_report_bulk_mixed_batch():
+    """One bulk report folds a mixed event batch through ONE
+    emit_status_bulk call with the exact same event -> status mapping
+    as the singular endpoint, and per-item results line up
+    positionally (unknown tasks rejected in place)."""
+    store, jobs, tids = _store_with_running_many(4)
+    cluster = _fresh_cluster_with_store(store)
+    batches = []
+    cluster.set_bulk_status_callback(lambda updates:
+                                     batches.append(list(updates)))
+    resp = cluster.status_report_bulk([
+        {"task_id": tids[0], "event": "exited", "exit_code": 0,
+         "hostname": "ha-agent", "sandbox": "/s0"},
+        {"task_id": tids[1], "event": "exited", "exit_code": 3,
+         "hostname": "ha-agent"},
+        {"task_id": "bogus", "event": "exited", "exit_code": 0,
+         "hostname": "ha-agent"},
+        {"task_id": tids[2], "event": "killed", "exit_code": 137,
+         "hostname": "ha-agent"},
+        {"task_id": tids[3], "event": "fetch_failed",
+         "hostname": "ha-agent"},
+    ])
+    assert resp["ok"] and resp["applied"] == 4
+    assert [r.get("unknown", False) for r in resp["results"]] == \
+        [False, False, True, False, False]
+    assert len(batches) == 1
+    upd = {u[0]: u for u in batches[0]}
+    assert upd[tids[0]][1] == InstanceStatus.SUCCESS
+    assert upd[tids[0]][3]["exit_code"] == 0
+    assert upd[tids[0]][3]["sandbox"] == "/s0"
+    assert upd[tids[1]][1] == InstanceStatus.FAILED
+    assert upd[tids[1]][2] == 1003
+    assert upd[tids[2]][2] == 1004
+    assert upd[tids[3]][1] == InstanceStatus.FAILED
+    # after the folds the cluster forgot every terminal task
+    assert cluster.known_task_ids() == set()
+
+
+def test_emit_status_bulk_fallback_carries_extras():
+    """Without a bulk callback, emit_status_bulk degrades to per-item
+    singular emits WITH the 4-tuple extras (exit codes must not be
+    dropped by the fallback)."""
+    store, jobs, tids = _store_with_running_many(1)
+    cluster = _fresh_cluster_with_store(store)
+    singles = []
+    cluster.set_status_callback(
+        lambda task_id, status, reason=None, **kw:
+        singles.append((task_id, status, reason, kw)))
+    resp = cluster.status_report_bulk([
+        {"task_id": tids[0], "event": "exited", "exit_code": 7,
+         "hostname": "ha-agent"}])
+    assert resp["applied"] == 1
+    assert singles[0][1] == InstanceStatus.FAILED
+    assert singles[0][2] == 1003
+    assert singles[0][3]["exit_code"] == 7
+
+
+def test_bulk_status_rest_endpoint_validation(stack):
+    store, cluster, coord, server, add_agent = stack
+
+    def post(body):
+        req = urllib.request.Request(
+            server.url + "/agents/status/bulk",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Cook-Agent-Token": "hunter2"}, method="POST")
+        return json.load(urllib.request.urlopen(req, timeout=5))
+
+    resp = post({"updates": [{"task_id": "nope", "event": "exited",
+                              "exit_code": 0, "hostname": "ghost"}]})
+    assert resp["ok"] and resp["applied"] == 0
+    assert resp["results"] == [{"ok": False, "unknown": True}]
+    for bad in ({}, {"updates": []}, {"updates": "x"},
+                {"updates": [{"event": "exited"}]}):
+        req = urllib.request.Request(
+            server.url + "/agents/status/bulk",
+            data=json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Cook-Agent-Token": "hunter2"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+
+
+def test_daemon_coalesces_status_burst(tmp_path):
+    """Statuses queued while a send is on the wire ride ONE bulk POST;
+    a lone status stays on the singular endpoint, and a coordinator
+    without the bulk route (404) latches the JSON-singular fallback."""
+    d = AgentDaemon("http://127.0.0.1:1", hostname="box",
+                    sandbox_root=str(tmp_path / "box"),
+                    heartbeat_interval_s=30.0)
+    posts = []
+
+    def fake_post(path, payload):
+        posts.append((path, payload))
+        return {"ok": True}
+
+    d._post = fake_post
+    # lone status -> singular (with retry semantics)
+    d._on_status("t-0", "running", {})
+    assert [p for p, _ in posts] == ["/agents/status"]
+    # burst: two landed in the queue while a send was "in flight"
+    posts.clear()
+    d._status_q = [{"task_id": "t-1", "event": "exited"},
+                   {"task_id": "t-2", "event": "exited"}]
+    d._on_status("t-3", "exited", {"exit_code": 0, "sandbox": ""})
+    assert [p for p, _ in posts] == ["/agents/status/bulk"]
+    assert [u["task_id"] for u in posts[0][1]["updates"]] == \
+        ["t-1", "t-2", "t-3"]
+    assert d._status_q == [] and d._status_sending is False
+    # a 404 from the bulk route falls back to singular AND latches
+    posts.clear()
+
+    def post_404(path, payload):
+        if path.endswith("/bulk"):
+            raise urllib.error.HTTPError(path, 404, "no route", {}, None)
+        posts.append((path, payload))
+        return {"ok": True}
+
+    d._post = post_404
+    d._status_q = [{"task_id": "t-4", "event": "exited"}]
+    d._on_status("t-5", "exited", {"exit_code": 0, "sandbox": ""})
+    assert [p for p, _ in posts] == ["/agents/status", "/agents/status"]
+    assert d._bulk_unsupported is True
+    # next burst goes straight to singular without probing bulk again
+    posts.clear()
+    d._post = fake_post
+    d._status_q = [{"task_id": "t-6", "event": "exited"}]
+    d._on_status("t-7", "exited", {"exit_code": 0, "sandbox": ""})
+    assert [p for p, _ in posts] == ["/agents/status", "/agents/status"]
+    # queued-but-unsent statuses count as undelivered in /state
+    d._status_q = [{"task_id": "t-8", "event": "exited"}]
+    assert any(u["task_id"] == "t-8"
+               for u in d.state()["undelivered"])
+    d._status_q = []
+
+
 def test_agent_bad_token_rejected(stack):
     """A wrong token is rejected outright (not just a missing one)."""
     store, cluster, coord, server, add_agent = stack

@@ -811,6 +811,131 @@ def test_r9_suppression():
     assert fs == []
 
 
+# ----------------------------------------------------------------------
+# R10 consume fast-path discipline (native/consumefold chokepoints)
+
+_AGENT_PATH = "cook_tpu/backends/agent.py"
+
+
+def test_r10_fold_outside_home_flagged():
+    # right function name, wrong module — and wrong function in the
+    # right module — both bypass the oracle-pinned call site
+    fs = run("""
+        from cook_tpu.native import consumefold
+        def sneak(rows):
+            return consumefold.fold_status_lines(b"h", b"t", rows)
+    """, rules=("R10",), path="cook_tpu/scheduler/coordinator.py")
+    assert rules_of(fs) == ["R10"]
+    assert "state/store.py" in fs[0].message
+    fs = run("""
+        from cook_tpu.native import consumefold
+        class JobStore:
+            def rotate(self, rows):
+                return consumefold.fold_status_lines(b"h", b"t", rows)
+    """, rules=("R10",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R10"]
+
+
+def test_r10_blessed_fold_homes_pass():
+    assert run("""
+        from cook_tpu.native import consumefold
+        class JobStore:
+            def update_instances_bulk(self, rows):
+                return consumefold.fold_status_lines(b"h", b"t", rows)
+    """, rules=("R10",), path=_STORE_PATH) == []
+    assert run("""
+        from cook_tpu.native import consumefold
+        def frame_segments(segments):
+            return consumefold.frame_concat(b"CKS1", segments)
+    """, rules=("R10",), path="cook_tpu/backends/specwire.py") == []
+    assert run("""
+        from cook_tpu.native import consumefold
+        class AgentCluster:
+            def _track_bulk_locked(self, specs, hostname, t0):
+                return consumefold.usage_totals(specs)
+    """, rules=("R10",), path=_AGENT_PATH) == []
+
+
+def test_r10_frame_and_usage_outside_home_flagged():
+    fs = run("""
+        from cook_tpu.native import consumefold
+        def encode(segs):
+            return consumefold.frame_concat(b"CKS1", segs)
+    """, rules=("R10",), path="cook_tpu/agent/daemon.py")
+    assert rules_of(fs) == ["R10"]
+    fs = run("""
+        from cook_tpu.native import consumefold
+        class AgentCluster:
+            def pending_offers(self, specs):
+                return consumefold.usage_totals(specs)
+    """, rules=("R10",), path=_AGENT_PATH)
+    assert rules_of(fs) == ["R10"]
+
+
+def test_r10_status_frag_reads_scoped_to_bulk_fold():
+    # module-level definition + the blessed reader are free
+    clean = """
+        _STATUS_FRAG = {1: "x"}
+        _STATUS_FRAG_B = {s: v.encode() for s, v in _STATUS_FRAG.items()}
+        class JobStore:
+            def update_instances_bulk(self, status):
+                return _STATUS_FRAG_B[status]
+    """
+    assert run(clean, rules=("R10",), path=_STORE_PATH) == []
+    fs = run("""
+        _STATUS_FRAG = {1: "x"}
+        class JobStore:
+            def hand_rolled(self, status):
+                return _STATUS_FRAG[status]
+    """, rules=("R10",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R10"]
+    assert "update_instances_bulk" in fs[0].message
+    # an unrelated module with the same names is not the store
+    assert run("""
+        _STATUS_FRAG = {1: "x"}
+        def other(status):
+            return _STATUS_FRAG[status]
+    """, rules=("R10",), path="cook_tpu/state/other.py") == []
+
+
+def test_r10_used_mutation_writers_pinned():
+    # the three writers (plus __init__) are blessed; reads are free
+    assert run("""
+        class AgentCluster:
+            def __init__(self):
+                self._used = {}
+            def _track_locked(self, h):
+                self._used[h] = [0.0, 0.0, 0.0, 0]
+            def _untrack_locked(self, h):
+                self._used.pop(h, None)
+            def pending_offers(self, h):
+                return self._used.get(h)
+    """, rules=("R10",), path=_AGENT_PATH) == []
+    fs = run("""
+        class AgentCluster:
+            def agent_heartbeat(self, h):
+                self._used[h] = [0.0, 0.0, 0.0, 0]
+            def describe_agents(self):
+                self._used.clear()
+    """, rules=("R10",), path=_AGENT_PATH)
+    assert rules_of(fs) == ["R10", "R10"]
+    assert all("three writers" in f.message for f in fs)
+
+
+def test_r10_suppression_and_chokepoint_exempt():
+    fs = run("""
+        from cook_tpu.native import consumefold
+        def sneak(rows):
+            return consumefold.fold_status_lines(b"h", b"t", rows)  # cookcheck: disable=R10
+    """, rules=("R10",), path="cook_tpu/scheduler/coordinator.py")
+    assert fs == []
+    # consumefold.py itself is the implementation, not a caller
+    assert run("""
+        def fold_status_lines(h, t, rows):
+            return b""
+    """, rules=("R10",), path="cook_tpu/native/consumefold.py") == []
+
+
 def test_syntax_error_reports_r0():
     fs = analyze_source("def broken(:\n", "bad.py")
     assert rules_of(fs) == ["R0"]

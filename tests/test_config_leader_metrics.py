@@ -55,6 +55,8 @@ def test_settings_full_roundtrip(tmp_path):
     {"rate_limits": {"bogus": {}}},
     {"clusters": [{"name": "a"}, {"name": "a"}]},
     {"scheduler": {"launch_fanout_workers": 0}},
+    {"scheduler": {"pipeline_depth": -1}},
+    {"scheduler": {"pipeline_depth": 9}},
     {"scheduler": {"heartbeat_timeout_s": 0}},
     {"scheduler": {"overload_escalate_after": 0}},
     {"clusters": [{"kind": "agent", "liveness_grace_s": -1.0}]},
@@ -96,6 +98,36 @@ def test_build_scheduler_wires_launch_pipeline():
         "launch_group_commit": False,
         "clusters": [{"kind": "mock", "hosts": 1}]})
     assert store2.group_commit is False
+
+
+def test_pipeline_depth_settings_and_wiring():
+    """pipeline_depth flows Settings -> SchedulerConfig -> the enabled
+    ResidentPool, and native_consume flips the process-wide consume
+    fold switch (restored after the test — it is global state)."""
+    from cook_tpu.native import consumefold
+    from cook_tpu.rest.server import build_scheduler
+    s = Settings.from_dict({})
+    assert s.scheduler.pipeline_depth == 2
+    assert s.scheduler.native_consume is True
+    s = Settings.from_dict({"scheduler": {"pipeline_depth": 0,
+                                          "native_consume": False}})
+    assert s.scheduler.pipeline_depth == 0
+    assert s.scheduler.native_consume is False
+    was = consumefold.enabled()
+    try:
+        _, coord, _ = build_scheduler({
+            "clusters": [{"kind": "mock", "hosts": 1}],
+            "scheduler": {"pipeline_depth": 3}})
+        assert coord.config.pipeline_depth == 3
+        assert coord._resident["default"].pipeline_depth == 3
+        assert consumefold.enabled() is True
+        _, coord2, _ = build_scheduler({
+            "clusters": [{"kind": "mock", "hosts": 1}],
+            "scheduler": {"native_consume": False}})
+        assert consumefold.enabled() is False
+        assert coord2.config.pipeline_depth == 2
+    finally:
+        consumefold.set_enabled(was)
 
 
 def test_heartbeat_timeout_settings_and_wiring():

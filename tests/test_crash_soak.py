@@ -103,6 +103,18 @@ SCHEDULES = {
     "G-shard-append": dict(seed=67, max_kills=2,
                            sites={"store.shard_append": 0.5},
                            overrides={"store_shards": 4}),
+    # consume window: the readback->launch-txn gap on BOTH match
+    # paths (legacy cycle and device-resident consume). The kill
+    # lands after matched work exists host-side but before any
+    # instance txn — restart must relaunch every pending job exactly
+    # once (device-side depletion dies with the process; the rebuild
+    # re-offers that capacity)
+    "H-consume": dict(seed=79, max_kills=2,
+                      sites={"consume.window": 0.3}),
+    "H-consume-resident": dict(seed=97, max_kills=2,
+                               sites={"consume.window": 0.3},
+                               overrides={"scheduler":
+                                          {"resident_match": True}}),
 }
 
 

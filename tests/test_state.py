@@ -1282,6 +1282,54 @@ def test_store_shard_differential_oracle(tmp_path):
         assert dru_order(s) == base_order == dru_order(cold)
 
 
+def test_consume_fast_path_differential_oracle(tmp_path):
+    """The consume fast path must be INVISIBLE in every durable
+    artifact: one fixed coordinator trace run at pipeline_depth 0, 1
+    and 2, with the native consume folds on and off, produces
+    byte-identical event logs, identical live and cold-replay state
+    hashes, and identical DRU fair-queue orderings over the surviving
+    tasks. Pipelining and the C folds are performance knobs, never
+    semantics."""
+    from tests.oracles import Task, dru_rank_oracle, run_consume_trace
+
+    runs = {}
+    for depth in (0, 1, 2):
+        for native in ((True, False) if depth == 0 else (True,)):
+            log = str(tmp_path / f"log-d{depth}-n{int(native)}")
+            runs[(depth, native)] = (
+                run_consume_trace(log, pipeline_depth=depth,
+                                  native=native), log)
+    base_store, base_log = runs[(0, True)]
+    with open(base_log, "rb") as f:
+        base_bytes = f.read()
+    assert base_bytes, "trace must write events"
+    base_hash = base_store.state_hash()
+
+    def dru_order(store):
+        users, tasks = {}, []
+        for n, inst in enumerate(sorted(store.running_instances(),
+                                        key=lambda i: i.task_id)):
+            j = store.jobs[inst.job_uuid]
+            u = users.setdefault(j.user, len(users))
+            tasks.append(Task(id=n, user=u, mem=j.mem, cpus=j.cpus,
+                              priority=j.priority,
+                              start_time=inst.start_time_ms))
+        shares = {u: (1000.0, 10.0) for u in users.values()}
+        return [(t.id, round(d, 9))
+                for t, d in dru_rank_oracle(tasks, shares)]
+
+    base_order = dru_order(base_store)
+    assert base_order, "trace must leave running tasks to rank"
+    for (depth, native), (s, log) in runs.items():
+        with open(log, "rb") as f:
+            assert f.read() == base_bytes, \
+                f"log diverged at depth={depth} native={native}"
+        assert s.state_hash() == base_hash
+        cold = JobStore.restore(log_path=log, open_writer=False)
+        assert cold.state_hash() == base_hash
+        assert dru_order(s) == base_order == dru_order(cold)
+
+
 def test_shard_encoder_toggle_byte_identical(tmp_path):
     """The zero-copy segment encoder and the dict->json.dumps fallback
     must write the SAME bytes — the native path is an encoding
