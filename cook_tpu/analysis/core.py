@@ -23,7 +23,11 @@ from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
 ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
-             "R10")
+             "R10", "R11", "R12")
+
+# rules that run over the whole scanned file set at once (the
+# interprocedural model), not per-module
+PACKAGE_RULES = ("R11", "R12")
 
 # which rule families run over which package subdirectories when
 # scanning a tree (explicit file arguments get every AST rule)
@@ -211,6 +215,28 @@ def analyze_source(source: str, path: str,
     return findings
 
 
+def analyze_package(files: list, rules: Iterable[str],
+                    apply_suppressions: bool = True) -> list[Finding]:
+    """Run the interprocedural package rules (R11/R12) over the whole
+    scanned file set. `files` is a list of (repo-relative path, source)
+    pairs — the same shape :func:`interproc.build_model` takes."""
+    from cook_tpu.analysis import durability, lock_order
+    from cook_tpu.analysis.interproc import build_model
+    model = build_model(files)
+    findings: list[Finding] = []
+    if "R11" in rules:
+        findings += lock_order.check(model)
+    if "R12" in rules:
+        findings += durability.check(model)
+    if apply_suppressions:
+        sup_by_path = {rel: collect_suppressions(src)
+                       for rel, src in files}
+        findings = [f for f in findings
+                    if not suppressed(f, sup_by_path.get(f.path, {}))]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
 def _rules_for(relpath: str, selected: Iterable[str]) -> list[str]:
     parts = relpath.replace(os.sep, "/").split("/")
     out = []
@@ -233,13 +259,16 @@ def iter_py_files(path: str) -> Iterable[str]:
 
 
 def analyze_paths(paths: list[str], root: str,
-                  rules: Iterable[str] = ALL_RULES) -> list[Finding]:
+                  rules: Iterable[str] = ALL_RULES,
+                  apply_suppressions: bool = True) -> list[Finding]:
     """Analyze files/trees. `root` anchors repo-relative paths and the
     R4 pair lookup. Directory scans scope rules by RULE_DIRS; files
     named explicitly get every per-module rule."""
     from cook_tpu.analysis import rest_drift
     findings: list[Finding] = []
     api_path = openapi_path = None
+    pkg_files: list[tuple] = []     # (rel, source) for R11/R12
+    want_pkg = any(r in rules for r in PACKAGE_RULES)
     for path in paths:
         explicit_file = os.path.isfile(path)
         for fp in iter_py_files(path):
@@ -254,11 +283,22 @@ def analyze_paths(paths: list[str], root: str,
                 continue
             active = (list(r for r in rules if r != "R4")
                       if explicit_file else _rules_for(rel, rules))
-            if not active:
-                continue
-            with open(fp, encoding="utf-8") as f:
-                src = f.read()
-            findings += analyze_source(src, rel, active)
+            src = None
+            if active:
+                with open(fp, encoding="utf-8") as f:
+                    src = f.read()
+                findings += analyze_source(src, rel, active,
+                                           apply_suppressions)
+            if want_pkg:
+                if src is None:
+                    with open(fp, encoding="utf-8") as f:
+                        src = f.read()
+                pkg_files.append((rel, src))
+    if want_pkg and pkg_files:
+        findings += analyze_package(pkg_files,
+                                    [r for r in rules
+                                     if r in PACKAGE_RULES],
+                                    apply_suppressions)
     if "R4" in rules and api_path and openapi_path:
         with open(api_path, encoding="utf-8") as f:
             api_src = f.read()
@@ -270,7 +310,9 @@ def analyze_paths(paths: list[str], root: str,
                                    openapi_src, openapi_rel)
         sup_by_path = {api_rel: collect_suppressions(api_src),
                        openapi_rel: collect_suppressions(openapi_src)}
-        findings += [f for f in r4
-                     if not suppressed(f, sup_by_path.get(f.path, {}))]
+        if apply_suppressions:
+            r4 = [f for f in r4
+                  if not suppressed(f, sup_by_path.get(f.path, {}))]
+        findings += r4
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

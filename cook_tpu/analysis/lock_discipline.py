@@ -33,6 +33,12 @@ from cook_tpu.analysis.core import Finding, ModuleInfo
 _LOCK_TYPES = {
     "threading.Lock", "threading.RLock", "threading.Condition",
     "Lock", "RLock", "Condition",
+    # the runtime lock-witness wrappers construct (or wrap) the same
+    # threading primitives — instrumented locks are still locks
+    "witness_lock", "witness_condition",
+    "lockwitness.witness_lock", "lockwitness.witness_condition",
+    "cook_tpu.utils.lockwitness.witness_lock",
+    "cook_tpu.utils.lockwitness.witness_condition",
 }
 # initialized-to types that are safe to share without an explicit lock
 _THREADSAFE_TYPES = {

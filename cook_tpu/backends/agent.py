@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from cook_tpu import obs
+from cook_tpu.utils.lockwitness import witness_lock
 from cook_tpu.backends import specwire
 from cook_tpu.backends.base import ComputeCluster, LaunchSpec, Offer
 from cook_tpu.native import consumefold
@@ -139,7 +140,7 @@ class AgentCluster(ComputeCluster):
         import collections
         self.breaker_transitions: "collections.deque[dict]" = \
             collections.deque(maxlen=256)
-        self._lock = threading.RLock()
+        self._lock = witness_lock("AgentCluster._lock", reentrant=True)
 
     # -- agent control-plane entry points (wired to REST routes) -------
     def register_agent(self, payload: dict) -> dict:

@@ -30,6 +30,7 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
+from cook_tpu.utils.lockwitness import witness_lock
 from cook_tpu.backends.base import ClusterRegistry, LaunchSpec, Offer
 from cook_tpu.ops import cycle as cycle_ops
 from cook_tpu.ops import dru as dru_ops
@@ -244,11 +245,11 @@ class Coordinator:
         # thread appends raises "deque mutated during iteration".
         # Single-element ops (append, popleft) are GIL-atomic and the
         # bench's drain relies on that; only iteration needs the lock.
-        self._trace_lock = threading.Lock()
+        self._trace_lock = witness_lock("Coordinator._trace_lock")
         # guards metrics_snapshot() readers against the match/consume
         # threads' writes (same reader-vs-writer contract as
         # consume_trace_snapshot: /debug must copy, never iterate live)
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = witness_lock("Coordinator._metrics_lock")
         # decision provenance ring: per-(job, cycle) reason codes
         # decoded from the device cycle's why_* window, behind
         # GET /unscheduled and GET /debug/decisions

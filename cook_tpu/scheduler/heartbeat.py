@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from cook_tpu.utils.lockwitness import witness_lock
 from cook_tpu.state.model import InstanceStatus
 from cook_tpu.state.store import JobStore
 
@@ -28,7 +29,7 @@ class HeartbeatWatcher:
         self.on_timeout = on_timeout
         self._clock = clock
         self._deadlines: dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = witness_lock("HeartbeatWatcher._lock")
 
     def notify(self, task_id: str) -> None:
         """An executor heartbeat arrived: extend the deadline."""

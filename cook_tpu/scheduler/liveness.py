@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from cook_tpu.utils.lockwitness import witness_lock
 from cook_tpu.state.model import now_ms
 from cook_tpu.utils.metrics import registry as metrics_registry
 
@@ -76,7 +77,7 @@ class AgentLivenessTracker:
             if resurrect_hold_s is not None else self.suspect_after_s
         self._clock = clock
         self._leases: dict[str, _Lease] = {}
-        self._lock = threading.Lock()
+        self._lock = witness_lock("AgentLivenessTracker._lock")
         # bounded transition ledger for /debug (same shape as the
         # breaker_transitions ring)
         self.transitions: "collections.deque[dict]" = \

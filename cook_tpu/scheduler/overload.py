@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from cook_tpu.utils.lockwitness import witness_lock
 from cook_tpu.state.model import now_ms
 from cook_tpu.utils.metrics import registry as metrics_registry
 
@@ -75,7 +76,7 @@ class OverloadController:
         self.relax_after = int(relax_after)
         self.relax_margin = float(relax_margin)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("OverloadController._lock")
         # level is read lock-free on the cycle hot path (int load is
         # atomic); all writers hold the lock
         self.level = 0

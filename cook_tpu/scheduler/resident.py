@@ -68,6 +68,7 @@ log = logging.getLogger(__name__)
 import jax
 import jax.numpy as jnp
 
+from cook_tpu.utils.lockwitness import witness_lock
 from cook_tpu.ops import cycle as cycle_ops
 from cook_tpu.ops import match as match_ops
 from cook_tpu.scheduler import constraints as constraints_mod
@@ -392,11 +393,11 @@ class ResidentPool:
         # A deferred job's row goes invalid until the expiry so the
         # kernel stops re-matching it every cycle.
         self._deferred: dict[str, float] = {}
-        self._ev_lock = threading.Lock()
+        self._ev_lock = witness_lock("ResidentPool._ev_lock")
         # serializes mirror access between the cycle thread (drain) and
         # the consumer thread's launch loop; the device readback — the
         # long pole — happens outside it
-        self.mirror_lock = threading.Lock()
+        self.mirror_lock = witness_lock("ResidentPool.mirror_lock")
         self._events: list = []
         self.cycle_no = 0
         self.consumed_through = -1

@@ -47,6 +47,7 @@ import zlib
 from cook_tpu import chaos
 from cook_tpu.chaos import procfault
 from cook_tpu.native import consumefold
+from cook_tpu.utils.lockwitness import witness_condition, witness_lock
 from dataclasses import asdict, dataclass
 from typing import Any, Callable, Iterable, Optional
 
@@ -254,7 +255,7 @@ class _GroupCommitBarrier:
                  "_on_round", "rounds", "waits")
 
     def __init__(self, on_round: Optional[Callable[[], None]] = None):
-        self._cv = threading.Condition()
+        self._cv = witness_condition("_GroupCommitBarrier._cv")
         self._completed = 0        # rounds fully synced
         self._in_flight = False    # a leader is currently syncing
         self._errs: dict[int, BaseException] = {}
@@ -333,7 +334,7 @@ class SnapshotView:
 class JobStore:
     def __init__(self, log_path: Optional[str] = None,
                  log_writer=None, store_shards: int = 4):
-        self._lock = threading.RLock()
+        self._lock = witness_lock("JobStore._lock", reentrant=True)
         # pool-sharded transaction locks: pool name -> crc32 % N shard.
         # A transaction holds only its pool's shard lock; cross-pool
         # sections hold all of them + self._lock (shard→global order,
@@ -341,11 +342,12 @@ class JobStore:
         # _global_section — cookcheck R9). store_shards=1 degenerates
         # to the pre-sharding single-mutex behavior (the A/B baseline).
         self.store_shards = max(1, int(store_shards))
-        self._shard_locks = [threading.RLock()
-                             for _ in range(self.store_shards)]
+        self._shard_locks = [witness_lock("JobStore._shard_locks[*]",
+                                          reentrant=True, rank=i)
+                             for i in range(self.store_shards)]
         # leaf lock for the listener-emission cursor: _emit runs under
         # a SHARD lock now, and two shards' cursors must not race
-        self._seq_lock = threading.Lock()
+        self._seq_lock = witness_lock("JobStore._seq_lock")
         # per-shard /debug evidence (mutated under the shard's lock)
         self._shard_txns = [0] * self.store_shards
         self._shard_wait_ms = [0.0] * self.store_shards
@@ -417,7 +419,7 @@ class JobStore:
         # Off = one sync per transaction (the pre-coalescing behavior);
         # wired from Settings.launch_group_commit by the server.
         self.group_commit: bool = True
-        self._barrier_init_lock = threading.Lock()
+        self._barrier_init_lock = witness_lock("JobStore._barrier_init_lock")
         # delta-snapshot bookkeeping: every transaction that mutates a
         # job marks its uuid dirty (through _reindex /
         # update_progress); retirement/GC records a tombstone. A FULL
@@ -2884,7 +2886,7 @@ class _PyLogWriter:
             with open(path) as f:
                 self._n = sum(1 for _ in f)
         self._f = open(path, "a", buffering=1)
-        self._lock = threading.Lock()
+        self._lock = witness_lock("_PyLogWriter._lock")
 
     def append(self, line: str) -> None:
         with self._lock:

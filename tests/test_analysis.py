@@ -981,3 +981,417 @@ def test_rule_scoping_by_directory(tmp_path):
     assert analyze_paths([str(tmp_path)], str(tmp_path)) == []
     explicit = analyze_paths([str(mod)], str(tmp_path))
     assert rules_of(explicit) == ["R1"]
+
+
+# ----------------------------------------------------------------------
+# interprocedural model (R11/R12), lock-witness, SARIF
+
+def _model(files):
+    from cook_tpu.analysis.interproc import build_model
+    return [(p, textwrap.dedent(s)) for p, s in files], \
+        build_model([(p, textwrap.dedent(s)) for p, s in files])
+
+
+LISTENER_SRC = """
+    from cook_tpu.utils.lockwitness import witness_lock
+
+    class EventStore:
+        def __init__(self):
+            self._lock = witness_lock("EventStore._lock")
+            self._listeners = []
+
+        def add_listener(self, fn):
+            self._listeners.append(fn)
+
+        def emit(self):
+            for fn in self._listeners:
+                fn("ev")
+
+    class MirrorPool:
+        def __init__(self, store):
+            self.mlock = witness_lock("MirrorPool.mlock")
+            store.add_listener(self.on_event)
+
+        def on_event(self, ev):
+            with self.mlock:
+                pass
+
+    class Driver:
+        def __init__(self):
+            self.store = EventStore()
+
+        def run(self):
+            with self.store._lock:
+                self.store.emit()
+"""
+
+
+def test_interproc_callgraph_methods_and_listeners():
+    _, model = _model([("cook_tpu/scheduler/lmod.py", LISTENER_SRC)])
+    assert model.locks["EventStore._lock"].witnessed
+    assert model.locks["MirrorPool.mlock"].witnessed
+    pairs = {(e.src, e.dst) for e in model.edges}
+    # Driver.run holds the store lock while emit() dispatches the
+    # escaped listener, which takes the mirror lock: the edge must
+    # survive the indirect hop
+    assert ("EventStore._lock", "MirrorPool.mlock") in pairs
+    # and the listener dispatch is slot-partitioned, not global: the
+    # lock graph must not invent the reverse edge
+    assert ("MirrorPool.mlock", "EventStore._lock") not in pairs
+
+
+INVERSION_A = """
+    from cook_tpu.utils.lockwitness import witness_lock
+    from cook_tpu.scheduler.invb import RightSide
+
+    class LeftSide:
+        def __init__(self):
+            self.llk = witness_lock("LeftSide.llk")
+            self.right = RightSide()
+
+        def fwd(self):
+            with self.llk:
+                self.right.rpoke()
+
+        def lpoke(self):
+            with self.llk:
+                pass
+"""
+
+INVERSION_B = """
+    from cook_tpu.utils.lockwitness import witness_lock
+
+    class RightSide:
+        def __init__(self):
+            self.rlk = witness_lock("RightSide.rlk")
+            self.left = None
+
+        def rpoke(self):
+            with self.rlk:
+                pass
+
+        def bwd(self):
+            with self.rlk:
+                self.left.lpoke()
+"""
+
+
+def test_r11_two_lock_inversion_across_modules():
+    from cook_tpu.analysis import lock_order
+    _, model = _model([("cook_tpu/scheduler/inva.py", INVERSION_A),
+                       ("cook_tpu/scheduler/invb.py", INVERSION_B)])
+    pairs = {(e.src, e.dst) for e in model.edges}
+    assert ("LeftSide.llk", "RightSide.rlk") in pairs
+    assert ("RightSide.rlk", "LeftSide.llk") in pairs
+    fs = lock_order.check(model)
+    assert any(f.rule == "R11" and "cycle" in f.message for f in fs)
+
+
+def test_r11_clean_one_direction_has_no_cycle():
+    from cook_tpu.analysis import lock_order
+    # drop bwd(): only llk -> rlk remains, no finding
+    src_b = INVERSION_B[:INVERSION_B.index("def bwd")].rstrip() + "\n"
+    _, model = _model([("cook_tpu/scheduler/inva.py", INVERSION_A),
+                       ("cook_tpu/scheduler/invb.py", src_b)])
+    assert lock_order.check(model) == []
+
+
+R12_API = """
+    class Response:
+        def __init__(self, status, body=None):
+            self.status = status
+
+    class _Router:
+        def add(self, method, path, fn):
+            pass
+
+    class JobStore:
+        def _append_raw(self, rec):
+            pass
+
+        def _barrier(self):
+            pass
+
+        def submit_job(self, spec):
+            self._append_raw(spec)
+
+    class Api:
+        def __init__(self):
+            self.store = JobStore()
+
+        def _build_router(self):
+            r = _Router()
+            r.add("POST", "/jobs", self.post_jobs)
+            r.add("GET", "/jobs", self.get_jobs)
+            return r
+
+        def get_jobs(self, req):
+            return Response(200, [])
+
+        def post_jobs(self, req):
+            self.store.submit_job(req)
+            return Response(201, {})
+"""
+
+
+def test_r12_handler_201_without_sync_flagged():
+    from cook_tpu.analysis import durability
+    _, model = _model([("cook_tpu/rest/rapi.py", R12_API)])
+    fs = durability.check(model)
+    assert any(f.rule == "R12" and f.symbol.endswith("post_jobs")
+               for f in fs), [f.render() for f in fs]
+    # the GET handler mutates nothing and must not be flagged
+    assert not any(f.symbol.endswith("get_jobs") for f in fs)
+
+
+def test_r12_barrier_before_ack_is_clean():
+    from cook_tpu.analysis import durability
+    fixed = R12_API.replace(
+        "self.store.submit_job(req)",
+        "self.store.submit_job(req)\n"
+        "            self.store._barrier()")
+    _, model = _model([("cook_tpu/rest/rapi.py", fixed)])
+    assert [f.render() for f in durability.check(model)] == []
+
+
+def test_r11_r12_through_analyze_package_and_suppression():
+    from cook_tpu.analysis.core import analyze_package
+    files = [("cook_tpu/scheduler/inva.py", textwrap.dedent(INVERSION_A)),
+             ("cook_tpu/scheduler/invb.py", textwrap.dedent(INVERSION_B))]
+    fs = analyze_package(files, ("R11", "R12"))
+    assert fs and all(f.rule == "R11" for f in fs)
+    # a disable comment on the flagged line suppresses it
+    rel, src = files[0] if fs[0].path.endswith("inva.py") else files[1]
+    lines = src.split("\n")
+    lines[fs[0].line - 1] += "  # cookcheck: disable=R11"
+    patched = [(p, "\n".join(lines) if p == fs[0].path else s)
+               for p, s in files]
+    assert analyze_package(patched, ("R11", "R12")) == []
+
+
+def test_lockwitness_runtime_records_and_flushes(tmp_path, monkeypatch):
+    from cook_tpu.utils import lockwitness
+    monkeypatch.setenv("COOK_LOCK_WITNESS", str(tmp_path))
+    monkeypatch.setattr(lockwitness, "_out_dir", None)
+    lockwitness.reset()
+    a = lockwitness.witness_lock("T.a")
+    b = lockwitness.witness_lock("T.b", reentrant=True)
+    s0 = lockwitness.witness_lock("T.sh[*]", rank=0)
+    s1 = lockwitness.witness_lock("T.sh[*]", rank=1)
+    with a:
+        with b:
+            with b:          # same-instance re-entry: no self-edge
+                pass
+    with s0:
+        with s1:             # blessed ascending walk: ordered
+            pass
+    with s1:
+        with s0:             # inversion: unordered
+            pass
+    edges = lockwitness.observed_edges()
+    assert edges[("T.a", "T.b", False)] == 1
+    assert ("T.b", "T.b", False) not in edges
+    assert ("T.sh[*]", "T.sh[*]", True) in edges
+    assert ("T.sh[*]", "T.sh[*]", False) in edges
+    # the flush file is complete-at-every-instant and merge-loadable
+    from cook_tpu.analysis.witness import load_witness
+    merged = load_witness([str(tmp_path)])
+    assert merged[("T.a", "T.b", False)] == 1
+    lockwitness.reset()
+
+
+def test_lockwitness_unarmed_returns_plain_locks(monkeypatch):
+    from cook_tpu.utils import lockwitness
+    monkeypatch.delenv("COOK_LOCK_WITNESS", raising=False)
+    assert not isinstance(lockwitness.witness_lock("X"),
+                          lockwitness.WitnessLock)
+    cv = lockwitness.witness_condition("X")
+    assert isinstance(cv, type(__import__("threading").Condition()))
+
+
+WITNESS_POOL = """
+    from cook_tpu.utils.lockwitness import witness_lock
+
+    class WPool:
+        def __init__(self):
+            self.a = witness_lock("WPool.a")
+            self.b = witness_lock("WPool.b")
+
+        def step(self):
+            with self.a:
+                with self.b:
+                    pass
+"""
+
+
+def test_witness_diff_semantics():
+    from cook_tpu.analysis.witness import diff_witness
+    _, model = _model([("cook_tpu/scheduler/wpool.py", WITNESS_POOL)])
+    # matched edge
+    d = diff_witness(model, {("WPool.a", "WPool.b", False): 3})
+    assert d["matched"] == 1 and d["unexplained"] == [] and d["gaps"] == []
+    # observed inversion the static graph lacks -> unexplained
+    d = diff_witness(model, {("WPool.b", "WPool.a", False): 1})
+    assert len(d["unexplained"]) == 1
+    assert "missed a call path" in d["unexplained"][0]["why"]
+    # unknown lock name -> unexplained
+    d = diff_witness(model, {("WPool.a", "Ghost.x", False): 1})
+    assert len(d["unexplained"]) == 1
+    assert "missing from the static model" in d["unexplained"][0]["why"]
+    # nothing observed -> the static edge is a (non-fatal) coverage gap
+    d = diff_witness(model, {})
+    assert d["unexplained"] == [] and len(d["gaps"]) == 1
+
+
+def test_witness_merge_tolerates_torn_tail(tmp_path):
+    from cook_tpu.analysis.witness import load_witness
+    (tmp_path / "witness-11.jsonl").write_text(
+        '{"from": "A", "to": "B", "ordered": false, "n": 2}\n')
+    (tmp_path / "witness-12.jsonl").write_text(
+        '{"from": "A", "to": "B", "ordered": false, "n": 3}\n'
+        '{"from": "A", "to": "C", "ord')          # SIGKILL mid-write
+    merged = load_witness([str(tmp_path)])
+    assert merged == {("A", "B", False): 5}
+
+
+def test_witness_cli_gate(tmp_path):
+    from cook_tpu.analysis.__main__ import main
+    import pathlib
+    pkg = tmp_path / "cook_tpu" / "scheduler"
+    pkg.mkdir(parents=True)
+    (pkg / "wpool.py").write_text(textwrap.dedent(WITNESS_POOL))
+    good = tmp_path / "w1"
+    good.mkdir()
+    (good / "witness-1.jsonl").write_text(
+        '{"from": "WPool.a", "to": "WPool.b", "ordered": false, "n": 1}\n')
+    assert main([str(pkg), "--witness", str(good)]) == 0
+    bad = tmp_path / "w2"
+    bad.mkdir()
+    (bad / "witness-1.jsonl").write_text(
+        '{"from": "WPool.b", "to": "WPool.a", "ordered": false, "n": 1}\n')
+    assert main([str(pkg), "--witness", str(bad)]) == 1
+
+
+def test_sarif_golden():
+    from cook_tpu.analysis.core import Finding
+    from cook_tpu.analysis.sarif import to_sarif
+    f = Finding("R11", "cook_tpu/state/store.py", 42,
+                "JobStore.rotate_log", "lock-order cycle: a -> b -> a")
+    doc = to_sarif([f])
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "cookcheck"
+    assert run["tool"]["driver"]["rules"][0]["id"] == "R11"
+    assert run["results"] == [{
+        "ruleId": "R11",
+        "ruleIndex": 0,
+        "level": "error",
+        "message": {"text": "lock-order cycle: a -> b -> a"},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": "cook_tpu/state/store.py",
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": 42},
+            },
+            "logicalLocations": [
+                {"fullyQualifiedName": "JobStore.rotate_log"}],
+        }],
+        "partialFingerprints": {"cookcheck/v1": f.fingerprint},
+    }]
+
+
+def test_warn_unused_suppressions(tmp_path, capsys):
+    from cook_tpu.analysis.__main__ import main
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # cookcheck: disable=R6\n")
+    rc = main([str(stale), "--no-baseline", "--warn-unused-suppressions"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "unused suppression" in err and "disable=R6" in err
+    # a suppression that is doing its job is NOT reported
+    live = tmp_path / "live.py"
+    live.write_text(textwrap.dedent("""
+        import time
+
+        def fetch():
+            while True:  # cookcheck: disable=R6
+                try:
+                    do()
+                except Exception:
+                    time.sleep(d)
+                    d *= 2
+    """))
+    rc = main([str(live), "--no-baseline", "--warn-unused-suppressions"])
+    assert rc == 0
+    assert "unused suppression" not in capsys.readouterr().err
+
+
+def test_repo_lock_model_names_match_runtime_witness():
+    """Every witness_lock name literal in the tree must surface in the
+    static model as a witnessed lock — the vocabularies agree by
+    construction, and this pins it."""
+    from cook_tpu.analysis.interproc import build_model
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "cook_tpu")
+    files = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "analysis")]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                fp = os.path.join(dirpath, name)
+                rel = os.path.relpath(fp, os.path.dirname(pkg))
+                with open(fp, encoding="utf-8") as f:
+                    files.append((rel, f.read()))
+    model = build_model(files)
+    witnessed = {n for n, l in model.locks.items() if l.witnessed}
+    assert {"JobStore._lock", "JobStore._shard_locks[*]",
+            "ResidentPool.mirror_lock", "_GroupCommitBarrier._cv",
+            "AgentCluster._lock", "_PyLogWriter._lock"} <= witnessed
+
+
+SECTION_SRC = """
+    import contextlib
+    from cook_tpu.utils.lockwitness import witness_lock
+
+    class ShardBox:
+        def __init__(self, n):
+            self.glock = witness_lock("ShardBox.glock", reentrant=True)
+            self.shards = [witness_lock("ShardBox.shards[*]",
+                                        reentrant=True, rank=i)
+                           for i in range(n)]
+            self.cv = witness_lock("ShardBox.cv")
+
+        @contextlib.contextmanager
+        def _global_section(self):
+            for lk in self.shards:
+                lk.acquire()
+            self.glock.acquire()
+            try:
+                yield
+            finally:
+                self.glock.release()
+                for lk in reversed(self.shards):
+                    lk.release()
+
+        def rotate(self):
+            with self._global_section():
+                with self.cv:
+                    pass
+"""
+
+
+def test_interproc_family_loop_walk_and_yield_held():
+    """The ascending family walk records the ordered self-edge, and a
+    contextmanager's yield-held set includes the loop-acquired family
+    — so everything acquired under the section sees the family as
+    held (the two witness-diff misses the armed tier-1 run caught)."""
+    _, model = _model([("cook_tpu/state/sbox.py", SECTION_SRC)])
+    edges = {(e.src, e.dst): e for e in model.edges}
+    fam = "ShardBox.shards[*]"
+    assert (fam, fam) in edges and edges[(fam, fam)].ordered
+    assert (fam, "ShardBox.glock") in edges
+    # acquired inside the section: both the family AND the global
+    # lock are held
+    assert (fam, "ShardBox.cv") in edges
+    assert ("ShardBox.glock", "ShardBox.cv") in edges
