@@ -759,6 +759,29 @@ class _BodyScan:
             if base is not None and self.ci is not None:
                 cls_name = self.ci.attr_types.get(base + "[]")
         elif isinstance(recv, ast.Call):
+            if isinstance(recv.func, ast.Name) \
+                    and recv.func.id == "super" and self.ci is not None:
+                # super().meth(): the nearest package ancestor's
+                # override per base branch, or nothing when the base
+                # is a builtin — falling through to the all-names
+                # fallback would drag every same-named method in the
+                # package (e.g. every __init__) into this summary
+                out: list = []
+                pending = list(self.ci.bases)
+                seen_bases: set = set()
+                while pending:
+                    b = pending.pop(0)
+                    if b in seen_bases:
+                        continue
+                    seen_bases.add(b)
+                    bi = model.classes.get(b)
+                    if bi is None:
+                        continue
+                    if meth in bi.methods:
+                        out.append(bi.methods[meth])
+                    else:
+                        pending.extend(bi.bases)
+                return tuple(out)
             # self._writer_barrier(w).sync(w): the inner call's return
             # annotation types the receiver
             cls_name = self._return_type(recv)

@@ -323,9 +323,14 @@ class Settings:
     # {fetcher: "pkg.mod:factory", weight: 0.25, batch_size: 500}
     # federated per-pool control plane (scheduler/federation.py):
     # {"group": "blue",
-    #  "groups": {"blue": {"pools": [...], "url": "http://..."}, ...},
-    #  "exchange_interval_s": 2.0, "global_quota": false}
-    # Empty = single-group federation owning every pool.
+    #  "groups": {"blue": {"pools": [...], "url": "http://...",
+    #                      "devices": [0, 1]}, ...},
+    #  "exchange_interval_s": 2.0, "global_quota": false,
+    #  "global_quota_staleness_s": 10.0}
+    # Empty = single-group federation owning every pool. "devices" is
+    # a group's device-placement claim: indices into jax.devices()
+    # over which its pools' resident cycles are spread
+    # (parallel/federation.place_pools).
     federation: dict = field(default_factory=dict)
     # cluster-wide default-checkpoint-config (config/kubernetes
     # :default-checkpoint-config): merged under each job's checkpoint
@@ -414,6 +419,46 @@ class Settings:
                                     or self.ingest_max_batch < 1):
             raise ConfigError("ingest_queue_depth and ingest_max_batch "
                               "must be >= 1 when ingest_workers > 0")
+        if self.federation:
+            fed = self.federation
+            groups = fed.get("groups") or {}
+            if not isinstance(groups, dict):
+                raise ConfigError("federation.groups must be a mapping "
+                                  "of group name -> spec")
+            group = fed.get("group", "")
+            if groups and (not group or group not in groups):
+                raise ConfigError(
+                    f"federation.group {group!r} must name an entry in "
+                    "federation.groups")
+            for name, spec in groups.items():
+                if not isinstance(spec, dict):
+                    raise ConfigError(
+                        f"federation.groups[{name!r}] must be a mapping")
+                unknown = set(spec) - {"pools", "url", "devices"}
+                if unknown:
+                    raise ConfigError(
+                        f"federation.groups[{name!r}]: unknown keys "
+                        f"{sorted(unknown)}")
+                devs = spec.get("devices", [])
+                if not all(isinstance(d, int) and d >= 0 for d in devs):
+                    raise ConfigError(
+                        f"federation.groups[{name!r}].devices must be "
+                        "non-negative device indices")
+            owners: dict = {}
+            for name, spec in groups.items():
+                for p in spec.get("pools", []):
+                    if p in owners:
+                        raise ConfigError(
+                            f"pool {p!r} claimed by both "
+                            f"{owners[p]!r} and {name!r}")
+                    owners[p] = name
+            if float(fed.get("exchange_interval_s", 2.0)) <= 0:
+                raise ConfigError(
+                    "federation.exchange_interval_s must be > 0")
+            if float(fed.get("global_quota_staleness_s", 10.0)) < 0:
+                raise ConfigError(
+                    "federation.global_quota_staleness_s must be >= 0 "
+                    "(0 = never flag folds stale)")
         # a write-capable machine channel must not default open: an
         # agent cluster without an agent token is only a dev setup
         if any(c.kind == "agent" for c in self.clusters) \

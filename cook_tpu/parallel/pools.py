@@ -27,7 +27,13 @@ from cook_tpu.ops import match as match_ops
 POOL_AXIS = "pools"
 
 
-def make_pool_mesh(n_devices: int | None = None) -> Mesh:
+def make_pool_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Mesh over the first n devices, or over an EXPLICIT device list
+    (a federated leader group's placement claim: the group shards its
+    pools only over the chips it owns, parallel/federation.place_pools,
+    so two groups on one host never contend for the same device)."""
+    if devices is not None:
+        return Mesh(list(devices), (POOL_AXIS,))
     devs = jax.devices()
     n = n_devices or len(devs)
     return Mesh(devs[:n], (POOL_AXIS,))

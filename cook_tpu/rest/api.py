@@ -45,7 +45,8 @@ from cook_tpu.state.model import (Group, Instance, InstanceStatus, Job,
                                   JobState, REASONS,
                                   REASON_BY_CODE as _REASON_BY_CODE,
                                   new_uuid, now_ms)
-from cook_tpu.state.store import NotLeaderError, TransactionError
+from cook_tpu.state.store import (NotLeaderError, PoolBusyError,
+                                  TransactionError)
 
 log = logging.getLogger(__name__)
 
@@ -185,6 +186,14 @@ class CookApi:
                 blocked = self._leader_block(agent_channel=True)
                 if blocked is not None:
                     return blocked
+            elif path == "/federation/adopt" and self.auth.agent_token \
+                    and self.auth.agent_token_ok(
+                        headers.get("x-cook-agent-token", "")):
+                # leader-to-leader machine channel: the migration
+                # source authenticates with the shared fleet token
+                # (same trust domain as the agent channel). An admin
+                # user principal works too — the generic branch below.
+                req.user = "federation-peer"
             elif path not in ("/info", "/debug", "/debug/flight",
                               "/debug/decisions", "/metrics",
                               # peer-leader machine channel: read-only
@@ -312,6 +321,11 @@ class CookApi:
         # federated control plane: peers poll each other's per-user
         # usage aggregates for the slow-cadence DRU exchange
         r.add("GET", "/federation/usage", self.federation_usage)
+        # fleet-scale federation: live pool migration between leader
+        # groups — admin kicks it off at the SOURCE, the source hands
+        # the payload to the DESTINATION's adopt endpoint
+        r.add("POST", "/federation/migrate", self.migrate_pool)
+        r.add("POST", "/federation/adopt", self.adopt_pool)
         r.add("GET", "/rebalancer", self.get_rebalancer_params)
         r.add("POST", "/rebalancer", self.set_rebalancer_params)
         # network-agent control plane (the framework-message channel of
@@ -336,6 +350,129 @@ class CookApi:
         if fed is None:
             raise ApiError(404, "federation not configured")
         return Response(200, fed.usage_snapshot())
+
+    # -- fleet federation: live pool migration --------------------------
+    def _fed_or_404(self):
+        fed = getattr(self, "federation", None)
+        if fed is None:
+            raise ApiError(404, "federation not configured")
+        return fed
+
+    def migrate_pool(self, req: Request) -> Response:
+        """Admin route (source side): hand one pool — jobs, routing,
+        placement — to another leader group. The epoch-fenced handoff:
+        drain (resident cycles consumed, backend launches handed off),
+        atomic export + pool-scoped fence mint (store.migrate_pool_out
+        — a submission racing the handoff lands after the fence and
+        503s to the new owner), routing flip (fed.reassign), then the
+        destination adopts via POST /federation/adopt. Any adoption
+        failure rolls the whole thing back — fence lifted by a fresh
+        unscoped mint, payload re-imported, routing restored — so the
+        fleet never ends in a state where no group owns the pool."""
+        fed = self._fed_or_404()
+        require_authorized(self.auth, req.user, "update", None)
+        body = req.body or {}
+        pool = body.get("pool")
+        dest = body.get("to")
+        if not pool or not dest:
+            raise ApiError(400, "pool and to are required")
+        if dest != fed.group and dest not in fed.groups:
+            raise ApiError(400, f"unknown leader group {dest!r}")
+        if not fed.owns(pool):
+            return Response(503, {
+                "error": f"pool {pool} owned by another leader group",
+                "leader": fed.owner_url(pool) or self._leader_hint()},
+                headers={"Retry-After": "1"})
+        if dest == fed.group:
+            return Response(200, {"pool": pool, "from": fed.group,
+                                  "to": dest, "moved": 0, "noop": True})
+        if self.coord is not None:
+            self.coord.retire_resident(pool)
+        try:
+            # at-most-once across the handoff: a RUNNING job's agent
+            # still posts status to THIS group; adopting it elsewhere
+            # would strand those reports (lost completion -> liveness
+            # requeue -> double launch). The store refuses inside the
+            # export's global section — atomic with the fence, so a
+            # waiting job that launches a tick before the handoff
+            # flips the verdict instead of slipping through.
+            payload = self.store.migrate_pool_out(
+                pool, fence_owner=f"fedmove:{fed.group}->{dest}",
+                force=bool(body.get("force")))
+        except PoolBusyError as e:
+            raise ApiError(
+                409, f"pool {pool} has {len(e.running)} RUNNING jobs; "
+                     "wait for drain or pass force:true",
+                {"running": e.running[:16]})
+        fed.reassign(pool, dest, note=f"migrate by {req.user or 'admin'}")
+        url = (fed.groups.get(dest) or {}).get("url", "")
+        err = None
+        if url:
+            import urllib.request
+            data = json.dumps({"pool": pool, "from": fed.group,
+                               "jobs": payload["jobs"],
+                               "groups": payload["groups"]}).encode()
+            for attempt in range(3):
+                try:
+                    req2 = urllib.request.Request(
+                        f"{url}/federation/adopt", data=data,
+                        headers={"Content-Type": "application/json",
+                                 "X-Cook-Agent-Token":
+                                     self.auth.agent_token or ""},
+                        method="POST")
+                    with urllib.request.urlopen(req2,
+                                                timeout=10.0) as resp:
+                        json.loads(resp.read().decode())
+                    err = None
+                    break
+                except Exception as e:   # adopt is idempotent per uuid
+                    err = e
+                    time.sleep(0.2 * (attempt + 1))
+        elif payload["count"]:
+            err = RuntimeError(f"no url configured for group {dest!r}")
+        if err is not None:
+            # rollback: a fresh UNSCOPED mint raises our epoch above
+            # the pool fence (lifting it), then the export re-imports
+            # locally and routing flips back. The pool resumes on the
+            # legacy cycle path; the next enable_resident (or restart)
+            # restores residency.
+            self.store.mint_epoch(owner=f"fedmove-rollback:{pool}")
+            self.store.import_pool(pool, payload["jobs"],
+                                   payload["groups"])
+            fed.reassign(pool, fed.group, note="rollback: adopt failed")
+            return Response(502, {
+                "error": f"adopt failed at {dest!r}: {err!r}",
+                "pool": pool, "rolled_back": True})
+        return Response(200, {"pool": pool, "from": fed.group,
+                              "to": dest, "moved": payload["count"],
+                              "fence_epoch": payload["fence_epoch"]})
+
+    def adopt_pool(self, req: Request) -> Response:
+        """Destination side of a live pool migration: import the
+        payload (idempotent per uuid — a retried POST after a lost
+        response inserts nothing twice), take routing ownership, and
+        run a census-scoped takeover so any instance that was mid-
+        launch at the source settles against cluster truth before this
+        group's first cycle for the pool (at-most-once launch across
+        the epoch handoff)."""
+        fed = self._fed_or_404()
+        if req.user != "federation-peer":
+            require_authorized(self.auth, req.user, "update", None)
+        body = req.body or {}
+        pool = body.get("pool")
+        if not pool:
+            raise ApiError(400, "pool is required")
+        adopted = self.store.import_pool(pool, body.get("jobs") or [],
+                                         body.get("groups") or [])
+        fed.reassign(pool, fed.group,
+                     note=f"adopt from {body.get('from', '?')}")
+        if self.coord is not None:
+            try:
+                self.coord.reconcile_restart(pools=[pool])
+            except Exception:
+                log.exception("post-adopt reconcile for %r failed", pool)
+        return Response(200, {"pool": pool, "group": fed.group,
+                              "adopted": len(adopted)})
 
     def get_openapi(self, req: Request) -> Response:
         """OpenAPI 3.0 description of every served route."""

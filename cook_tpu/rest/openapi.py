@@ -25,6 +25,8 @@ _BODY_HINTS = {
     ("POST", "/share"): "LimitUpdate",
     ("POST", "/quota"): "LimitUpdate",
     ("POST", "/agents/status/bulk"): "AgentStatusBulk",
+    ("POST", "/federation/migrate"): "PoolMigration",
+    ("POST", "/federation/adopt"): "PoolAdoption",
 }
 
 _SCHEMAS = {
@@ -74,6 +76,21 @@ _SCHEMAS = {
                        "gpus": {"type": "number"},
                        "count": {"type": "integer"},
                        "reason": {"type": "string"}},
+    },
+    "PoolMigration": {
+        "type": "object",
+        "required": ["pool", "to"],
+        "properties": {"pool": {"type": "string"},
+                       "to": {"type": "string"},
+                       "force": {"type": "boolean"}},
+    },
+    "PoolAdoption": {
+        "type": "object",
+        "required": ["pool"],
+        "properties": {"pool": {"type": "string"},
+                       "from": {"type": "string"},
+                       "jobs": {"type": "array"},
+                       "groups": {"type": "array"}},
     },
     "AgentStatusBulk": {
         "type": "object",

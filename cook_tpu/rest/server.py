@@ -370,7 +370,9 @@ def build_scheduler(config, read_only=False):
             store=store, url=config.url,
             exchange_interval_s=float(
                 fcfg.get("exchange_interval_s", 2.0)),
-            global_quota=bool(fcfg.get("global_quota", False)))
+            global_quota=bool(fcfg.get("global_quota", False)),
+            global_quota_staleness_s=float(
+                fcfg.get("global_quota_staleness_s", 10.0)))
     else:
         fed = FederationHost.single(store=store, url=config.url)
     quotas = FederatedQuotaView(fed)
@@ -445,9 +447,34 @@ def build_scheduler(config, read_only=False):
                 log.warning(
                     "resident_shard_devices=%d but only %d devices "
                     "visible; running single-device", shard_n, len(devs))
+        # pool -> device placement (fleet federation): when this
+        # group's spec claims devices, each owned pool's resident
+        # cycle pins to its placed chip — two groups on one host never
+        # contend for the same device. An index beyond the visible
+        # device count falls back to the default device (a 4-chip
+        # claim still boots on a 1-chip dev box).
+        placement = fed.placement() if fcfg.get("groups") else {}
+        place_devs = {}
+        if placement:
+            import jax
+            devs = jax.devices()
+            for pname, idx in placement.items():
+                if idx < len(devs):
+                    place_devs[pname] = devs[idx]
+                else:
+                    log.warning(
+                        "pool %r placed on device %d but only %d "
+                        "visible; using default device", pname, idx,
+                        len(devs))
         for p in coord.active_pools():
+            kw = {}
+            # sharded pools (one pool over many chips) and placed
+            # pools (one chip per pool) are mutually exclusive per
+            # ResidentPool's contract; the explicit shard claim wins
+            if shard_devs is None and p.name in place_devs:
+                kw["device"] = place_devs[p.name]
             coord.enable_resident(p.name, synchronous=False,
-                                  devices=shard_devs)
+                                  devices=shard_devs, **kw)
 
     # optimizer cycle (start-optimizer-cycles! mesos.clj:216,
     # optimizer.clj:115): config {"optimizer": {"optimizer": "pkg:fn",

@@ -543,14 +543,7 @@ class Coordinator:
         # first: replacing the ResidentPool while its thread still
         # blocks on the orphaned _launch_q would leak the thread AND
         # silently drop any launches queued on it
-        prev = self._resident.get(pool)
-        if prev is not None:
-            prev.enabled = False
-            self.drain_resident(pool)   # in-flight consumed, queue empty
-            q = getattr(prev, "_launch_q", None)
-            if q is not None:
-                q.put(None)    # retire the thread
-            self._resident.pop(pool, None)
+        self.retire_resident(pool)
         # config-level depth applies unless the caller pins one
         # explicitly (tests pass pipeline_depth=; the server wires
         # Settings.pipeline_depth through SchedulerConfig)
@@ -593,6 +586,24 @@ class Coordinator:
             self._consume_shards = InOrderShards(
                 max(1, self.config.consume_workers),
                 self._consume_one, name="resident-consumer")
+
+    def retire_resident(self, pool: str) -> bool:
+        """Drain and retire one pool's resident state: in-flight cycles
+        consumed, pending backend launches handed off, launcher thread
+        stopped, mirror dropped. Shared by re-enable (above) and the
+        live-migration handoff (rest/api.migrate_pool), whose 'drain'
+        step this is — after it returns, no launch for this pool is in
+        flight anywhere on this node."""
+        prev = getattr(self, "_resident", {}).get(pool)
+        if prev is None:
+            return False
+        prev.enabled = False
+        self.drain_resident(pool)   # in-flight consumed, queue empty
+        q = getattr(prev, "_launch_q", None)
+        if q is not None:
+            q.put(None)    # retire the thread
+        self._resident.pop(pool, None)
+        return True
 
     # store event kinds whose payload names the owning job directly
     # ("obj" = the Job), so delivery can be routed to one pool's mirror

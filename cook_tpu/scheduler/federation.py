@@ -35,10 +35,35 @@ sees exactly the single-coordinator decision sequence:
 Config (Settings.federation):
 
     {"group": "blue",
-     "groups": {"blue":  {"pools": ["default"], "url": "http://...:a"},
-                "green": {"pools": ["gpu"],     "url": "http://...:b"}},
+     "groups": {"blue":  {"pools": ["default"], "url": "http://...:a",
+                          "devices": [0, 1]},
+                "green": {"pools": ["gpu"],     "url": "http://...:b",
+                          "devices": [2]}},
      "exchange_interval_s": 2.0,
-     "global_quota": false}
+     "global_quota": false,
+     "global_quota_staleness_s": 10.0}
+
+Fleet-scale additions (N >= 3 groups carrying real traffic):
+
+  - **Placement**: a group may claim local accelerator devices
+    (``devices``: indices into jax.devices()); each owned pool's
+    resident cycle is pinned to one of them
+    (parallel/federation.place_pools — stable pool-hash spread, so a
+    pool keeps its chip across restarts). Group ownership therefore
+    picks which device a pool's resident state lives on.
+  - **Live migration**: ``reassign`` flips a pool's ownership at
+    runtime (the REST layer's POST /federation/migrate drives the full
+    drain -> durable fedmove -> pool-scoped epoch fence -> adopt
+    handoff; see rest/api.py federation_migrate). The 503 ownership
+    hint follows the overlay immediately, so clients chase the new
+    owner from the first rejected submission.
+  - **Exchange staleness**: every fold is stamped with the LOCAL
+    receive time; ``remote_usage`` EXCLUDES folds older than
+    ``global_quota_staleness_s`` (flagged in /debug and counted in
+    ``federation_stale_folds_total``, never silently trusted). A
+    group gone dark therefore stops shrinking its peers' effective
+    quota — the quota pie rebalances to the live groups instead of
+    being pinned by a dead leader's last report.
 
 A process with no federation config still gets a single-group host
 owning every pool (FederationHost.single), so /debug carries the
@@ -67,25 +92,38 @@ class FederationHost:
     def __init__(self, group: str, groups: Optional[dict] = None,
                  store=None, url: str = "",
                  exchange_interval_s: float = 2.0,
-                 global_quota: bool = False):
+                 global_quota: bool = False,
+                 global_quota_staleness_s: float = 10.0):
         self.group = group
         self.groups: dict[str, dict] = dict(groups or {})
         self.store = store
         self.url = url
         self.exchange_interval_s = float(exchange_interval_s)
         self.global_quota = bool(global_quota)
+        self.global_quota_staleness_s = float(global_quota_staleness_s)
         # pool -> owning group name, from the explicit group specs;
         # pools listed nowhere belong to the LOCAL group (so the
         # default single-group federation owns everything, and a pool
-        # added at runtime is served rather than blackholed)
+        # added at runtime is served rather than blackholed). Live
+        # migration mutates this map at runtime under _owner_lock; all
+        # readers go through _owner_of so a reassignment is visible to
+        # routing, cycle filtering, and the 503 hint atomically.
         self._pool_owner: dict[str, str] = {}
+        self._owner_lock = threading.Lock()
         for name, spec in self.groups.items():
             for pool in spec.get("pools", ()):
                 self._pool_owner[pool] = name
         self.transitions = 0
         self.last_handoff: dict = {}
-        # remote usage fold: peer group -> its last usage snapshot
+        # live-migration evidence: [{pool, from, to, t_ms, ...}]
+        self.migrations: list[dict] = []
+        # remote usage fold: peer group -> its last usage snapshot,
+        # plus the LOCAL monotonic receive stamp the staleness bound
+        # is measured against (a peer's own t_ms is wall clock on a
+        # different box — skew-prone; what "stale" means here is "WE
+        # have not heard from it", which only our clock can say)
         self._remote: dict[str, dict] = {}
+        self._remote_rx: dict[str, float] = {}
         self._remote_lock = threading.Lock()
         self._exchange_stop: Optional[threading.Event] = None
 
@@ -97,21 +135,78 @@ class FederationHost:
 
     # ------------------------------------------------------------------
     # ownership / routing
+    def _owner_of(self, pool: str) -> str:
+        with self._owner_lock:
+            return self._pool_owner.get(pool, self.group)
+
     def owns(self, pool: str) -> bool:
-        return self._pool_owner.get(pool, self.group) == self.group
+        return self._owner_of(pool) == self.group
 
     def owned_pools(self) -> list[str]:
-        return sorted(p for p, g in self._pool_owner.items()
-                      if g == self.group)
+        with self._owner_lock:
+            return sorted(p for p, g in self._pool_owner.items()
+                          if g == self.group)
 
     def owner_url(self, pool: str) -> Optional[str]:
         """The owning group's leader address (the 503 hint for a
         misrouted submission); None when we own it / nothing better
         than the caller's fallback is known."""
-        owner = self._pool_owner.get(pool, self.group)
+        owner = self._owner_of(pool)
         if owner == self.group:
             return None
         return self.groups.get(owner, {}).get("url") or None
+
+    def reassign(self, pool: str, group: str, note: str = "") -> dict:
+        """Flip a pool's ownership at runtime — the routing half of a
+        live migration. After this returns, owns()/owner_url() answer
+        for the NEW owner: misrouted submissions 503 with the new
+        leader's address, and the cycle loops (narrowed by
+        Coordinator.pool_filter = owns) stop/start serving the pool on
+        their next round. The durable half (drain, fedmove txn,
+        pool-scoped epoch fence, adopt) is orchestrated by the REST
+        migration route; this method only moves the map and records
+        the evidence /debug serves."""
+        if group != self.group and group not in self.groups:
+            raise ValueError(f"unknown leader group {group!r}")
+        with self._owner_lock:
+            prev = self._pool_owner.get(pool, self.group)
+            self._pool_owner[pool] = group
+        rec = {"pool": pool, "from": prev, "to": group,
+               "t_ms": int(time.time() * 1e3)}
+        if note:
+            rec["note"] = note
+        self.migrations.append(rec)
+        if prev != group:
+            from cook_tpu.utils.metrics import registry
+            registry.counter("federation_pool_migrations_total",
+                             group=self.group).inc()
+        return rec
+
+    # ------------------------------------------------------------------
+    # pool -> device placement (tentpole: group ownership picks which
+    # device a pool's resident cycle runs on)
+    def placement_index(self, pool: str) -> Optional[int]:
+        """Device index (into jax.devices()) this pool's resident
+        state should live on, per the owning group's ``devices`` claim;
+        None when the group claims none (default-device behavior).
+        Only meaningful for pools THIS group owns — a peer's pools run
+        on the peer's devices."""
+        spec = self.groups.get(self._owner_of(pool), {})
+        devices = spec.get("devices") or ()
+        if not devices:
+            return None
+        from cook_tpu.parallel.federation import place_pools
+        return place_pools([pool], devices)[pool]
+
+    def placement(self) -> dict:
+        """pool -> device index for every owned pool with a claim (the
+        /debug placement block + the server's enable_resident hook)."""
+        spec = self.groups.get(self.group, {})
+        devices = spec.get("devices") or ()
+        if not devices:
+            return {}
+        from cook_tpu.parallel.federation import place_pools
+        return place_pools(self.owned_pools(), devices)
 
     def peers(self) -> list[tuple[str, str]]:
         """[(group, url)] for every OTHER group with an address."""
@@ -142,29 +237,42 @@ class FederationHost:
 
     def debug(self) -> dict:
         pools = {}
-        names = set(self._pool_owner)
+        with self._owner_lock:
+            names = set(self._pool_owner)
+            owner_map = dict(self._pool_owner)
         if self.store is not None:
             # pools with live state but no explicit spec: owned locally
             names |= set(getattr(self.store, "_pending", {}))
+        placement = self.placement()
         for pool in sorted(names):
-            owner = self._pool_owner.get(pool, self.group)
+            owner = owner_map.get(pool, self.group)
             pools[pool] = {
                 "group": owner,
                 "leader": (self.url if owner == self.group
                            else self.groups.get(owner, {}).get("url")),
                 "local": owner == self.group}
+            if pool in placement:
+                pools[pool]["device"] = placement[pool]
+        now = time.monotonic()
+        bound = self.global_quota_staleness_s
         with self._remote_lock:
-            exchange = {g: {"pools": sorted(s.get("pools", {})),
-                            "epoch": s.get("epoch", 0),
-                            "t_ms": s.get("t_ms", 0)}
-                        for g, s in self._remote.items()}
+            exchange = {}
+            for g, s in self._remote.items():
+                age_s = now - self._remote_rx.get(g, now)
+                exchange[g] = {"pools": sorted(s.get("pools", {})),
+                               "epoch": s.get("epoch", 0),
+                               "t_ms": s.get("t_ms", 0),
+                               "age_s": round(age_s, 3),
+                               "stale": bool(bound > 0 and age_s > bound)}
         return {"group": self.group,
                 "pools": pools,
                 "epoch": self.epoch,
                 "transitions": self.transitions,
                 "last_handoff": dict(self.last_handoff),
+                "migrations": [dict(m) for m in self.migrations[-16:]],
                 "exchange": exchange,
-                "global_quota": self.global_quota}
+                "global_quota": self.global_quota,
+                "global_quota_staleness_s": bound}
 
     # ------------------------------------------------------------------
     # cross-shard usage exchange
@@ -188,7 +296,9 @@ class FederationHost:
         """Absorb a peer's usage snapshot. Epoch-monotone per group: a
         partitioned old leader's report (lower epoch than one already
         folded) is dropped, the same staleness rule the store applies
-        to log entries."""
+        to log entries. Every accepted fold is stamped with the local
+        monotonic receive time — the clock the staleness bound below
+        is measured against (a frozen/dead peer stops refreshing it)."""
         if not isinstance(snapshot, dict) or group == self.group:
             return
         with self._remote_lock:
@@ -196,21 +306,48 @@ class FederationHost:
             if prev and snapshot.get("epoch", 0) < prev.get("epoch", 0):
                 return
             self._remote[group] = snapshot
+            self._remote_rx[group] = time.monotonic()
+
+    def _fresh_snaps(self) -> tuple[list, list]:
+        """(fresh snapshots, stale group names): a fold whose local
+        receive stamp is older than global_quota_staleness_s is
+        EXCLUDED from the quota fold and flagged — trusting it would
+        let a dead leader's last report pin its users' fleet-wide
+        quota forever. Exclusion IS the quota-pie rebalance: the dark
+        group's usage stops shrinking the live groups' effective
+        ceilings until its successor reports again."""
+        now = time.monotonic()
+        bound = self.global_quota_staleness_s
+        fresh, stale = [], []
+        with self._remote_lock:
+            items = [(g, s, self._remote_rx.get(g, now))
+                     for g, s in self._remote.items()]
+        for g, snap, rx in items:
+            if bound > 0 and (now - rx) > bound:
+                stale.append(g)
+            else:
+                fresh.append(snap)
+        if stale:
+            from cook_tpu.utils.metrics import registry
+            registry.counter("federation_stale_folds_total",
+                             group=self.group).inc(len(stale))
+        return fresh, stale
 
     def remote_usage(self, user: str, pool: str) -> dict:
         """The user's usage as reported by PEER groups, for the quota
         fold. {} unless global_quota is on (the default keeps the
         federation byte-equal to a single coordinator, which enforces
         quota per pool independently). With it on, the user's total
-        remote usage — every peer, every pool — shrinks the effective
-        quota, so a blanket ceiling binds fleet-wide."""
+        remote usage — every FRESH peer report, every pool — shrinks
+        the effective quota, so a blanket ceiling binds fleet-wide.
+        Folds past the staleness bound are excluded (see
+        _fresh_snaps), never silently trusted."""
         if not self.global_quota:
             return {}
         del pool  # blanket fold: the ceiling is global by definition
         out = {"mem": 0.0, "cpus": 0.0, "gpus": 0.0, "jobs": 0.0}
         any_usage = False
-        with self._remote_lock:
-            snaps = list(self._remote.values())
+        snaps, _ = self._fresh_snaps()
         for snap in snaps:
             for usage in snap.get("pools", {}).values():
                 u = usage.get(user)

@@ -222,6 +222,21 @@ class StaleEpochError(NotLeaderError):
     mint, no matter what its elector thread believes."""
 
 
+class PoolBusyError(RuntimeError):
+    """Pool migration refused: the pool still has RUNNING jobs. Raised
+    INSIDE migrate_pool_out's global section so the verdict is atomic
+    with the export — a route-level pre-scan alone races the match
+    cycle (a waiting job can launch between the scan and the fence,
+    exporting a live instance whose agent still reports to the source
+    group). Carries the offending uuids for the 409 body."""
+
+    def __init__(self, pool: str, running: list):
+        super().__init__(
+            f"pool {pool!r} has {len(running)} RUNNING job(s)")
+        self.pool = pool
+        self.running = running
+
+
 class _GroupCommitBarrier:
     """Cross-lane fsync coalescer: leader/follower group commit above a
     single log writer (the transactor-ack amortization the reference
@@ -409,6 +424,12 @@ class JobStore:
         # steady-state cost to one stat per gate check.
         self._epoch_ledger_stat: Optional[tuple] = None
         self._epoch_ledger_max: int = 0
+        # pool-scoped fences (live pool migration): a mint record
+        # carrying {"pools": [...]} fences ONLY those pools — writes
+        # to a migrated-away pool reject while every other pool keeps
+        # flowing at the old epoch. Kept out of _epoch_ledger_max so a
+        # pool-scoped mint never fences the whole source store.
+        self._epoch_pool_fences: dict = {}
         self._log_path = log_path
         self._log = log_writer
         if log_path and log_writer is None:
@@ -741,7 +762,7 @@ class JobStore:
             ev["ep"] = self.epoch
         self._append_raw(json.dumps(ev, separators=(",", ":")))
 
-    def _check_writable(self) -> None:
+    def _check_writable(self, pools=None) -> None:
         """Primary write-fencing gate, evaluated at TRANSACTION ENTRY
         (inside the store lock, before any in-memory mutation): a
         fenced (deposed or stalled) leader must neither append to the
@@ -749,27 +770,40 @@ class JobStore:
         hint, which clients follow. The durable epoch fence runs here
         too, so a superseded leader rejects BEFORE mutating in-memory
         state (the append-time backstop in _append_raw can only reject
-        after)."""
+        after). ``pools`` names the pools the transaction touches, so
+        a pool that migrated to another leader group (pool-scoped mint)
+        rejects here while unrelated pools keep writing."""
         if getattr(self, "_replaying", False):
             return
         gate = getattr(self, "append_gate", None)
         if gate is not None and not gate():
             raise NotLeaderError("write fenced: not the leader")
-        self._fence_stale_epoch()
+        self._fence_stale_epoch(pools=pools)
 
     @property
     def _epoch_ledger_path(self) -> Optional[str]:
         return f"{self._log_path}.epoch" if self._log_path else None
 
-    def _fence_stale_epoch(self) -> None:
+    def _fence_stale_epoch(self, pools=None) -> None:
         """Durable append-time fence (tentpole of the epoch-fenced
         failover design, docs/robustness.md): reject the write when the
         epoch ledger records a mint newer than our own epoch. Cost is
         one stat() per check; the ledger is only re-read when its
         (size, mtime_ns) changed — i.e. once per takeover. Epochless
         stores (epoch 0: in-memory, dev single-node, pre-HA logs) are
-        exempt; the fence arms at the first mint_epoch."""
-        if not self.epoch:
+        exempt; the fence arms at the first mint_epoch.
+
+        The GLOBAL comparison uses the max over UNSCOPED mint records
+        only: a pool-scoped mint (live migration handing one pool to
+        another leader group) must fence exactly the named pools, not
+        depose the minting store wholesale. Per-pool fences apply when
+        the caller names the pools its transaction touches — and they
+        arm even at epoch 0: a store that fenced a pool away via its
+        own migrate_pool_out must refuse that pool's writes whether or
+        not it ever minted a takeover epoch (the epochless exemption
+        is about not deposing dev stores, not about un-fencing a
+        migration)."""
+        if not self.epoch and not self._epoch_pool_fences:
             return
         path = self._epoch_ledger_path
         if not path:
@@ -780,15 +814,28 @@ class JobStore:
             return
         key = (st.st_size, st.st_mtime_ns)
         if key != self._epoch_ledger_stat:
-            self._epoch_ledger_max = _read_epoch_ledger(path)
+            unscoped, fences = _read_epoch_fences(path)
+            self._epoch_ledger_max = unscoped
+            self._epoch_pool_fences = fences
             self._epoch_ledger_stat = key
-        if self._epoch_ledger_max > self.epoch:
+        if self.epoch and self._epoch_ledger_max > self.epoch:
             from cook_tpu.obs.metrics import registry as metrics_registry
             metrics_registry.counter(
                 "stale_epoch_writes_rejected_total").inc()
             raise StaleEpochError(
                 f"write fenced: epoch {self.epoch} superseded by "
                 f"{self._epoch_ledger_max} in epoch ledger")
+        if pools and self._epoch_pool_fences:
+            for p in pools:
+                fence = self._epoch_pool_fences.get(p, 0)
+                if fence > self.epoch:
+                    from cook_tpu.obs.metrics import \
+                        registry as metrics_registry
+                    metrics_registry.counter(
+                        "stale_epoch_writes_rejected_total").inc()
+                    raise StaleEpochError(
+                        f"write fenced: pool {p!r} migrated away at "
+                        f"epoch {fence} (ours {self.epoch})")
 
     def _emit(self, kind: str, data: dict) -> None:
         if getattr(self, "_replaying", False):
@@ -901,7 +948,7 @@ class JobStore:
         jobs = list(jobs)
         groups = list(groups)
         with self._pools_section({j.pool for j in jobs}, txn=True):
-            self._check_writable()
+            self._check_writable(pools={j.pool for j in jobs})
             # duplicate check FIRST, before any mutation (group member
             # lists included): a rejected batch must leave no trace, so
             # the coalescing ingest layer can retry its requests
@@ -971,7 +1018,7 @@ class JobStore:
         uuids = list(uuids)
         pools = {self.jobs[u].pool for u in uuids}
         with self._pools_section(pools, txn=True):
-            self._check_writable()
+            self._check_writable(pools=pools)
             flipped = []
             for u in uuids:
                 job = self.jobs[u]
@@ -1134,7 +1181,7 @@ class JobStore:
         if j0 is None:
             raise TransactionError(f"job {job_uuid} not allowed to start")
         with self._pool_section(j0.pool, txn=True):
-            self._check_writable()
+            self._check_writable(pools=(j0.pool,))
             if not self.allowed_to_start(job_uuid):
                 raise TransactionError(f"job {job_uuid} not allowed to start")
             job = self.jobs[job_uuid]
@@ -1197,7 +1244,7 @@ class JobStore:
         pools = {j.pool for it in items
                  if (j := self.jobs.get(it[0])) is not None}
         with self._pools_section(pools, txn=True):
-            self._check_writable()
+            self._check_writable(pools=pools)
             out = []
             created = []
             log_rows = []
@@ -1591,7 +1638,8 @@ class JobStore:
         replay)."""
         self.epoch = max(lease_epoch, self._replay_max_epoch + 1)
 
-    def mint_epoch(self, owner: str = "", floor: int = 0) -> int:
+    def mint_epoch(self, owner: str = "", floor: int = 0,
+                   pools=None) -> int:
         """Mint a monotone fencing epoch and PERSIST it in the epoch
         ledger before taking log authorship — the durable half of the
         failover fence. Strictly above: any elector lease epoch
@@ -1604,34 +1652,178 @@ class JobStore:
         this closes the split-brain window end to end. Returns the
         minted epoch.
 
+        ``pools`` mints a POOL-SCOPED fence instead (live migration
+        handoff): the record carries the pool names, the minter's own
+        epoch does NOT advance, and only writes touching those pools
+        reject afterwards — the durable "this pool left the building"
+        marker between drain and adoption. A later unscoped mint
+        (e.g. the rollback path re-adopting a failed migration) lifts
+        pool fences naturally by raising self.epoch above them.
+
         Runs in the global section: a mint must quiesce every shard —
         a straggler transaction stamping the OLD epoch after a newer
         mint would append a record replay drops, losing an acked
         txn."""
         with self._global_section():
-            path = self._epoch_ledger_path
-            ledger_max = _read_epoch_ledger(path) if path else 0
-            new = max(floor, self.epoch, self._replay_max_epoch,
-                      ledger_max) + 1
-            if path:
-                rec = json.dumps(
-                    {"epoch": new, "owner": owner, "t": now_ms()},
-                    separators=(",", ":"))
-                fd = os.open(path,
-                             os.O_WRONLY | os.O_CREAT | os.O_APPEND,
-                             0o644)
-                try:
-                    os.write(fd, (rec + "\n").encode("utf-8"))
-                    os.fsync(fd)
-                finally:
-                    os.close(fd)
-                _fsync_dir(os.path.dirname(os.path.abspath(path)))
-                st = os.stat(path)
-                self._epoch_ledger_stat = (st.st_size, st.st_mtime_ns)
-                self._epoch_ledger_max = new
-            self.epoch = new
+            new = self._mint_epoch_locked(owner, floor, pools)
         procfault.kill_point("store.epoch_mint")
         return new
+
+    def _mint_epoch_locked(self, owner: str = "", floor: int = 0,
+                           pools=None) -> int:
+        """Mint body, caller holds the global section (mint_epoch, and
+        migrate_pool_out's atomic export+fence)."""
+        pools = sorted(pools) if pools else None
+        path = self._epoch_ledger_path
+        ledger_max = _read_epoch_ledger(path) if path else 0
+        new = max(floor, self.epoch, self._replay_max_epoch,
+                  ledger_max) + 1
+        if path:
+            body = {"epoch": new, "owner": owner, "t": now_ms()}
+            if pools:
+                body["pools"] = pools
+            rec = json.dumps(body, separators=(",", ":"))
+            fd = os.open(path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, (rec + "\n").encode("utf-8"))
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            _fsync_dir(os.path.dirname(os.path.abspath(path)))
+            st = os.stat(path)
+            self._epoch_ledger_stat = (st.st_size, st.st_mtime_ns)
+            if pools:
+                for p in pools:
+                    self._epoch_pool_fences[p] = max(
+                        self._epoch_pool_fences.get(p, 0), new)
+            else:
+                self._epoch_ledger_max = new
+        if not pools:
+            self.epoch = new
+        return new
+
+    # ------------------------------------------------------------------
+    # live pool migration (fleet federation): export a pool's jobs out
+    # of this store / adopt them into another. Paired with pool-scoped
+    # mint_epoch(pools=[...]) fences: the source appends "fedmove"
+    # (durable before the fence mint), the destination appends
+    # "fedadopt", and replay applies both — so either store restores to
+    # exactly its post-migration state and state_hash stays a valid
+    # restore oracle across the handoff.
+    def migrate_pool_out(self, pool: str, fence_owner: str = "",
+                         force: bool = False) -> dict:
+        """Export-and-remove one pool for live migration to another
+        leader group. Returns the portable payload: the pool's jobs as
+        event-log dicts plus the group specs they reference (a group
+        spanning pools splits — each store keeps its own members, the
+        same shape _retire_job leaves behind). Runs in the global
+        section: the full-jobs scan needs every shard quiesced, and a
+        migration is a rare admin op — latency is not the constraint
+        here, atomicity is.
+
+        ``fence_owner`` (non-empty) mints the pool-scoped epoch fence
+        INSIDE the same section, so export and fence are atomic: a
+        submission thread queued on the locks lands after both and
+        rejects at its _check_writable — no job can slip into the pool
+        between "exported" and "fenced" and be acked by a store whose
+        cycles will never serve it again.
+
+        Unless ``force``, RUNNING jobs abort the export with
+        PoolBusyError — checked HERE (not just at the route) because
+        only inside this section is the verdict atomic with the fence;
+        launches take the pool shard lock, which the global section
+        excludes."""
+        t_ms = now_ms()
+        with self._global_section():
+            self._check_writable(pools=(pool,))
+            if not force:
+                running = sorted(
+                    u for u, j in self.jobs.items()
+                    if j.pool == pool and j.state == JobState.RUNNING)
+                if running:
+                    raise PoolBusyError(pool, running)
+            uuids = [u for u, j in self.jobs.items() if j.pool == pool]
+            items = []
+            group_ids = []
+            for u in uuids:
+                job = self.jobs[u]
+                items.append(_job_dict(job))
+                if job.group and job.group not in group_ids:
+                    group_ids.append(job.group)
+            groups = [asdict(self.groups[g]) for g in group_ids
+                      if g in self.groups]
+            if uuids:
+                # the event carries the FULL export (not just uuids):
+                # a crash after the fence but before the destination
+                # adopted leaves the payload recoverable from this
+                # log record instead of only in a dead process's memory
+                self._append("fedmove", {"pool": pool,
+                                         "jobs": list(uuids),
+                                         "items": items,
+                                         "groups": groups}, t_ms=t_ms)
+                # exported-but-not-fsynced window: a crash here replays
+                # the move (or drops the torn tail and keeps the pool)
+                # — either way one store owns every job
+                procfault.kill_point("store.fedmove")
+                for u in uuids:
+                    self._retire_job(u)
+                self._emit("retire", {"jobs": list(uuids)})
+            fence = self._mint_epoch_locked(
+                fence_owner, pools=(pool,)) if fence_owner else 0
+        self._barrier()
+        return {"pool": pool, "jobs": items, "groups": groups,
+                "count": len(items), "fence_epoch": fence}
+
+    def _adopt_pool_state(self, items, groups) -> list:
+        """Shared mutation body for import_pool and "fedadopt" replay —
+        one code path, so the live store and a replayed one land on the
+        same state_hash. Caller holds the lock."""
+        for gd in groups:
+            gd = dict(gd)
+            gd["jobs"] = []
+            g = Group(**gd)
+            if g.uuid not in self.groups:
+                # member list rebuilt below: _replay_job re-links each
+                # adopted job into its group in item order
+                self.groups[g.uuid] = g
+        out = []
+        for d in items:
+            job = _job_from_dict(dict(d))   # copy: it pops "instances"
+            if job.uuid in self.jobs:
+                continue
+            self._replay_job(job)
+            out.append(job.uuid)
+        return out
+
+    def import_pool(self, pool: str, items, groups=()) -> list:
+        """Adopt a migrated pool's jobs (the payload migrate_pool_out
+        returned on the source). Idempotent per uuid — a retried adopt
+        after a lost HTTP response re-delivers the same payload and
+        inserts nothing twice."""
+        t_ms = now_ms()
+        with self._global_section():
+            self._check_writable(pools=(pool,))
+            kept = [dict(d) for d in items
+                    if d.get("uuid") not in self.jobs]
+            adopted_ids = {d.get("uuid") for d in kept}
+            gspecs = []
+            for gd in groups:
+                gd = dict(gd)
+                gd["jobs"] = [u for u in (gd.get("jobs") or ())
+                              if u in adopted_ids]
+                if gd["jobs"] and gd.get("uuid") not in self.groups:
+                    gspecs.append(gd)
+            adopted = self._adopt_pool_state(kept, gspecs)
+            if adopted:
+                self._append("fedadopt", {"pool": pool, "items": kept,
+                                          "groups": gspecs}, t_ms=t_ms)
+                procfault.kill_point("store.fedadopt")
+                for u in adopted:
+                    self._emit("job", {"obj": self.jobs[u]})
+        self._barrier()
+        return adopted
 
     def log_lines(self) -> int:
         """Lines appended to the current log segment (0 when no log) —
@@ -2592,6 +2784,14 @@ class JobStore:
         elif k == "retire":
             for u in ev.get("jobs", ()):
                 self._retire_job(u)
+        elif k == "fedmove":
+            # pool migrated to another leader group: its jobs left this
+            # store's state (they live on in the destination's log)
+            for u in ev.get("jobs", ()):
+                self._retire_job(u)
+        elif k == "fedadopt":
+            self._adopt_pool_state(ev.get("items", ()),
+                                   ev.get("groups", ()))
         elif k == "rebalancer_config":
             self.rebalancer_config = dict(ev.get("cfg", {}))
         elif k == "inst":
@@ -2778,6 +2978,37 @@ def _read_epoch_ledger(path: str) -> int:
     except OSError:
         return 0
     return top
+
+
+def _read_epoch_fences(path: str) -> tuple:
+    """(max unscoped epoch, {pool: max pool-scoped epoch}) from the
+    ledger. Splitting the two is what keeps a pool-scoped mint (live
+    migration) from fencing the whole source store: the global fence
+    compares against unscoped mints only, while migrated pools carry
+    their own per-pool fence. Torn/garbage lines skip, same contract
+    as _read_epoch_ledger."""
+    top = 0
+    fences: dict = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    ep = int(rec.get("epoch", 0))
+                except (ValueError, TypeError):
+                    continue
+                pools = rec.get("pools")
+                if pools:
+                    for p in pools:
+                        fences[p] = max(fences.get(p, 0), ep)
+                else:
+                    top = max(top, ep)
+    except OSError:
+        return 0, {}
+    return top, fences
 
 
 def _fsync_dir(path: str) -> None:

@@ -429,6 +429,361 @@ def run_failover_soak(store_root, seed, tag=None, jobs=8, agents=2,
                 pass
 
 
+def _admin_post(url, path, body, timeout_s=15.0):
+    """Admin-channel POST (header auth, user=admin). Returns
+    (status, parsed body); HTTP errors come back as their status +
+    body instead of raising, so callers can assert on 409/503."""
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Cook-User": "admin"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.getcode(), json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except Exception:
+            return e.code, {}
+
+
+def run_fleet_soak(store_root, seed, tag=None, groups=3,
+                   jobs_per_group=6, agents_per_group=1, window_s=6.0,
+                   wall_s=120.0, group_kill=True, migrate=True,
+                   migrate_burst=4):
+    """One compressed fleet day: N single-leader groups, each with its
+    own durable store dir and its own agent(s), federated by config —
+    every member's federation block names every group, so misrouted
+    submissions 503-hint to the owner and the fleet client follows.
+
+    Faults exercised (both optional):
+      - ``group_kill``: SIGKILL one group's leader mid-traffic; the
+        supervisor respawns it over its own store dir and the harness
+        measures kill -> epoch-advanced-and-serving as that group's
+        MTTR (no standby — a fleet group's availability story is
+        restart-from-durable-state; the HA-pair soak covers standby
+        takeover).
+      - ``migrate``: burst-submit into one group's pool, then drive the
+        live migration admin route to hand the pool (pending jobs
+        included) to another group. Evidence pins the 503 ownership
+        hint BEFORE (source serves) and AFTER (source redirects to the
+        destination), and the burst uuids ride the shared completeness
+        + at-most-once gates.
+
+    Returns an evidence dict; asserts nothing (tests/test_fleet.py and
+    the CI fleet-smoke job own the gates)."""
+    from tests.livestack import free_port
+    tag = tag or f"fleet{seed}"
+    violations: list[str] = []
+    launch_counts: dict[str, int] = {}
+    gnames = [f"g{i}" for i in range(groups)]
+    pools = {g: f"pool-{g}" for g in gnames}
+    ports = {g: free_port() for g in gnames}
+    urls = {g: f"http://127.0.0.1:{ports[g]}" for g in gnames}
+    fleet_urls = ",".join(urls.values())
+    fed_groups = {g: {"pools": [pools[g]], "url": urls[g]}
+                  for g in gnames}
+    all_pools = [{"name": p} for p in pools.values()]
+
+    servers: dict[str, LiveServer] = {}
+    for g in gnames:
+        overrides = {
+            "default_pool": pools[g],
+            "pools": all_pools,   # every pool known everywhere: a
+            # misrouted submission must 503-hint, not 400
+            "auth": {"admins": ["admin"]},
+            "federation": {"group": g, "groups": fed_groups,
+                           "exchange_interval_s": 0.5,
+                           "global_quota_staleness_s": 5.0},
+        }
+        servers[g] = LiveServer(os.path.join(str(store_root), g),
+                                name=g, port=ports[g], seed=seed,
+                                max_kills=0, overrides=overrides)
+
+    def _fed(srv):
+        try:
+            return srv.debug().get("federation", {})
+        except Exception:
+            return {}
+
+    def _wait_group(g, min_epoch=1, timeout_s=READY_BOUND_S):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            ep = _fed(servers[g]).get("epoch", 0)
+            if ep >= min_epoch:
+                return ep
+            time.sleep(0.05)
+        return 0
+
+    def make_daemon(g, host, pool=None):
+        # offers are pool-keyed (backends/agent.pending_offers filters
+        # on the agent's registered pool), so each daemon carries its
+        # group's pool — and a migration must bring capacity to the
+        # destination, hence the extra migrated-pool daemon below
+        d = AgentDaemon(urls[g], hostname=host, mem=4096.0, cpus=8.0,
+                        pool=pool or pools[g],
+                        sandbox_root=os.path.join(
+                            str(store_root), g, f"sbx-{host}",
+                            str(time.monotonic_ns())),
+                        heartbeat_interval_s=0.4,
+                        agent_token=LiveServer.AGENT_TOKEN)
+        orig = d.executor.launch
+
+        def counted(task_id, *a, _orig=orig, **kw):
+            launch_counts[task_id] = launch_counts.get(task_id, 0) + 1
+            return _orig(task_id, *a, **kw)
+
+        d.executor.launch = counted
+        return d
+
+    # ONE fleet client per user, given every member's URL: misrouted
+    # submissions follow the federation ownership hint to the owner.
+    # Dedup probes and the completeness poll instead ask each group
+    # DIRECTLY (admin clients): stores are disjoint here — unlike the
+    # HA pair — so "did it land" means "does ANY group have it", and a
+    # non-owner legitimately 404s.
+    clients: dict[str, JobClient] = {}
+    admin_clients = {g: JobClient(urls[g], user="admin", timeout=5.0)
+                     for g in gnames}
+    uuids: list[tuple] = []
+
+    def _find_job(u):
+        for g in gnames:
+            try:
+                got = admin_clients[g].query_jobs([u])
+            except Exception:   # 404 here = this group doesn't own it
+                continue
+            if got:
+                return got[0]
+        return None
+
+    def submit_with_retry(user, pool, priority=50):
+        cli = clients.setdefault(
+            user, JobClient(fleet_urls, user=user, timeout=5.0))
+        u = str(uuidlib.uuid4())
+        for _ in range(SUBMIT_RETRIES):
+            try:
+                cli.submit(command="sleep 0.3", mem=64.0, cpus=1.0,
+                           uuid=u, pool=pool, priority=priority,
+                           max_retries=4)
+                break
+            except Exception:
+                if _find_job(u) is not None:
+                    break   # landed before the response was lost
+                time.sleep(0.5)
+        else:
+            violations.append(f"submit of {u} (pool {pool}) never "
+                              "landed")
+        uuids.append((u, user, pool))
+
+    daemons: list[AgentDaemon] = []
+    transitions: list[dict] = []
+    migration: dict = {}
+    jobs_final: dict = {}
+    try:
+        for g in gnames:
+            servers[g].start()
+        for g in gnames:
+            if not _wait_group(g):
+                violations.append(f"group {g} never minted an epoch")
+        for g in gnames:
+            for i in range(agents_per_group):
+                d = make_daemon(g, f"{tag}-{g}-a{i}")
+                d.start()
+                daemons.append(d)
+
+        # traffic: every group carries its own pool's jobs, submitted
+        # through the fleet client (ownership hints exercised when the
+        # client's first URL is a non-owner)
+        t0 = time.time()
+        trace = generate_trace(n_jobs=jobs_per_group * groups,
+                               n_users=3, seed=seed,
+                               submit_window_ms=int(window_s * 1e3))
+        kill_at = window_s * 0.4 if group_kill else None
+        victim = gnames[-1] if group_kill else None
+        for i, t in enumerate(sorted(trace,
+                                     key=lambda t: t["submit-time-ms"])):
+            delay = t["submit-time-ms"] / 1e3
+            now = time.time() - t0
+            if delay > now:
+                time.sleep(delay - now)
+            if kill_at is not None and time.time() - t0 >= kill_at:
+                # ---- group-kill: restart-from-durable-state MTTR ----
+                ep_before = _fed(servers[victim]).get("epoch", 0)
+                tk = time.monotonic()
+                servers[victim].sup.kill()
+                # SIGKILL delivery is async: wait for the reap so
+                # ensure_alive sees a dead child and actually respawns
+                dd = time.monotonic() + 5.0
+                while servers[victim].sup.alive() and \
+                        time.monotonic() < dd:
+                    time.sleep(0.02)
+                try:
+                    servers[victim].ensure_alive(READY_BOUND_S)
+                except Exception as e:
+                    violations.append(
+                        f"killed group {victim} failed to respawn: {e}")
+                ep_after = _wait_group(victim, ep_before + 1)
+                mttr_ms = (time.monotonic() - tk) * 1e3
+                if not ep_after:
+                    violations.append(
+                        f"group {victim} did not re-mint past epoch "
+                        f"{ep_before} within {READY_BOUND_S}s")
+                transitions.append(
+                    {"action": "group_kill", "victim": victim,
+                     "epoch_before": ep_before,
+                     "epoch_after": ep_after,
+                     "mttr_ms": round(mttr_ms, 1)})
+                kill_at = None
+            pool = pools[gnames[i % groups]]
+            submit_with_retry(t["job/user"], pool, t["job/priority"])
+
+        if migrate and groups >= 2:
+            # ---- live pool migration under traffic ----
+            src, dst = gnames[0], gnames[1]
+            mpool = pools[src]
+            for _ in range(migrate_burst):
+                submit_with_retry("migrator", mpool)
+            burst = [u for u, user, p in uuids if user == "migrator"]
+            hint_before = _admin_post(
+                urls[src], "/jobs",
+                {"jobs": [{"uuid": str(uuidlib.uuid4()),
+                           "command": "true", "mem": 1.0, "cpus": 0.1}],
+                 "pool": mpool})
+            # 409 (RUNNING jobs) is expected while the burst drains:
+            # retry until the guard admits the handoff
+            status, resp = 0, {}
+            deadline = time.monotonic() + READY_BOUND_S
+            while time.monotonic() < deadline:
+                status, resp = _admin_post(
+                    urls[src], "/federation/migrate",
+                    {"pool": mpool, "to": dst})
+                if status != 409:
+                    break
+                time.sleep(0.3)
+            if status != 200:
+                violations.append(
+                    f"migration of {mpool} {src}->{dst} failed: "
+                    f"{status} {resp}")
+            # ownership hint must now flip to the destination
+            status_h, resp_h = _admin_post(
+                urls[src], "/jobs",
+                {"jobs": [{"uuid": str(uuidlib.uuid4()),
+                           "command": "true", "mem": 1.0, "cpus": 0.1}],
+                 "pool": mpool})
+            migration = {
+                "pool": mpool, "from": src, "to": dst,
+                "result": {"status": status, **(resp or {})},
+                "burst_uuids": burst,
+                "hint_before": {"status": hint_before[0],
+                                "leader": (hint_before[1] or {}).get(
+                                    "leader")},
+                "hint_after": {"status": status_h,
+                               "leader": (resp_h or {}).get("leader")},
+                "expected_owner_url": urls[dst],
+            }
+            if status == 200:
+                if status_h != 503 or \
+                        resp_h.get("leader") != urls[dst]:
+                    violations.append(
+                        f"post-migration ownership hint did not flip "
+                        f"to {urls[dst]}: {status_h} {resp_h}")
+                # the pool's capacity moves with it: the destination
+                # gets an agent registered in the migrated pool
+                d = make_daemon(dst, f"{tag}-{dst}-migrated",
+                                pool=mpool)
+                d.start()
+                daemons.append(d)
+                # a few more submissions must follow the new hint and
+                # land at the destination
+                for _ in range(2):
+                    submit_with_retry("postmigrate", mpool)
+
+        # ---- completeness: every submission completes SOMEWHERE ----
+        # (after a migration "somewhere" is a different group than the
+        # one that acked the submit — exactly the zero-lost property)
+        deadline = time.time() + wall_s
+        while time.time() < deadline:
+            done = {}
+            for u, _user, _pool in uuids:
+                j = _find_job(u)
+                if j is not None:
+                    done[u] = j
+            jobs_final = done
+            if len(done) == len(uuids) and all(
+                    j.status == "completed" for j in done.values()):
+                break
+            time.sleep(0.5)
+
+        # per-group durable evidence
+        epoch_ledgers = {}
+        inst_tasks = []
+        for g in gnames:
+            glog = os.path.join(str(store_root), g, "events.log")
+            epoch_ledgers[g] = [
+                r.get("epoch", 0) for r in
+                _read_epoch_ledger(glog + ".epoch")]
+            for e in _scan_inst_events(glog):
+                inst_tasks.append({"group": g, "task": e.get("task"),
+                                   "ep": e.get("ep", 0)})
+        stale_info = {g: _fed(servers[g]).get("exchange", {})
+                      for g in gnames}
+        evidence = {
+            "seed": seed,
+            "tag": tag,
+            "groups": gnames,
+            "pools": pools,
+            "urls": urls,
+            "violations": violations,
+            "jobs": jobs_final,
+            "expected_jobs": len(uuids),
+            "launch_counts": dict(launch_counts),
+            "transitions": transitions,
+            "migration": migration,
+            "epoch_ledgers": epoch_ledgers,
+            "inst_tasks": inst_tasks,
+            "exchange": stale_info,
+            "server_deaths": {g: len(s.sup.deaths)
+                              for g, s in servers.items()},
+        }
+        _dump_fleet_artifacts(tag, servers, evidence)
+        return evidence
+    finally:
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception:
+                pass
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def _dump_fleet_artifacts(tag, servers, evidence):
+    out = os.environ.get("CHAOS_ARTIFACTS_DIR")
+    if not out:
+        return
+    os.makedirs(out, exist_ok=True)
+    for name, s in servers.items():
+        if os.path.exists(s.server_log):
+            shutil.copy(s.server_log,
+                        os.path.join(out, f"fleet-{tag}-server-{name}.log"))
+        ep = os.path.join(s.store_dir, "events.log.epoch")
+        if os.path.exists(ep):
+            shutil.copy(ep, os.path.join(
+                out, f"fleet-{tag}-epoch-{name}.jsonl"))
+    slim = {k: v for k, v in evidence.items() if k != "jobs"}
+    slim["job_statuses"] = {u: j.status
+                           for u, j in evidence["jobs"].items()}
+    with open(os.path.join(out, f"fleet-{tag}-evidence.json"),
+              "w") as f:
+        json.dump(slim, f, indent=1)
+
+
 def _dump_artifacts(tag, servers, schedule, shared_log, evidence):
     out = os.environ.get("CHAOS_ARTIFACTS_DIR")
     if not out:

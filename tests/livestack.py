@@ -114,15 +114,19 @@ class LiveServer:
     AGENT_TOKEN = "livestack-secret"
 
     def __init__(self, store_dir, sites=None, seed=0, max_kills=2,
-                 overrides=None, name=None):
+                 overrides=None, name=None, port=None):
         """``name`` suffixes the per-process files (config, kill
         budget, server log) so an HA PAIR can share one store_dir —
         the durable snapshot+log stay shared (that's the point of the
-        pair) while each member keeps its own supervisor evidence."""
+        pair) while each member keeps its own supervisor evidence.
+        ``port`` pins the listen port: a FLEET topology must know every
+        member's URL before any member's config is written (each
+        group's federation block names all peers), so the fleet soak
+        pre-allocates ports and passes them in."""
         self.store_dir = str(store_dir)
         self.name = name
         os.makedirs(self.store_dir, exist_ok=True)
-        self.port = free_port()
+        self.port = port if port is not None else free_port()
         self.url = f"http://127.0.0.1:{self.port}"
         cfg = {
             "port": self.port,

@@ -1395,3 +1395,48 @@ def test_interproc_family_loop_walk_and_yield_held():
     # lock are held
     assert (fam, "ShardBox.cv") in edges
     assert ("ShardBox.glock", "ShardBox.cv") in edges
+
+
+SUPER_SRC = """
+    from cook_tpu.utils.lockwitness import witness_lock
+
+    class Locker:
+        def __init__(self):
+            self.llk = witness_lock("Locker.llk")
+            with self.llk:
+                pass
+
+    class BaseErr(Exception):
+        def __init__(self, msg):
+            self.msg = msg
+
+    class ChildErr(BaseErr):
+        def __init__(self, pool):
+            super().__init__("busy")
+            self.pool = pool
+
+    class Holder:
+        def __init__(self):
+            self.hlk = witness_lock("Holder.hlk")
+
+        def check(self):
+            with self.hlk:
+                raise ChildErr("p")
+"""
+
+
+def test_interproc_super_resolves_to_ancestor_only():
+    """super().__init__ dispatches to the nearest package ancestor's
+    override — not through the all-names fallback, which would drag
+    every __init__ in the package (here the lock-acquiring
+    Locker.__init__) into the raising class's summary and invent a
+    hlk -> llk edge under Holder.check's held set."""
+    _, model = _model([("cook_tpu/state/supbox.py", SUPER_SRC)])
+    pairs = {(e.src, e.dst) for e in model.edges}
+    assert ("Holder.hlk", "Locker.llk") not in pairs
+    # the ancestor hop itself is still modeled: ChildErr.__init__
+    # reaches BaseErr.__init__
+    fns = model.functions
+    child = next(k for k in fns if k.endswith("ChildErr.__init__"))
+    assert any(any(t.endswith("BaseErr.__init__") for t in cs.targets)
+               for cs in fns[child].calls)

@@ -507,3 +507,131 @@ def test_reconcile_restart_scoped_by_pool_filter():
     report = coord.reconcile_restart()
     assert report["unknown"] == 1                 # only alpha's censused
     assert [i.status for i in jb.instances] == [InstanceStatus.UNKNOWN]
+
+
+# ----------------------------------------------------------------------
+# fleet-scale federation: exchange staleness, live reassignment,
+# pool -> device placement (scheduler/federation + parallel/federation)
+
+def test_stale_fold_excluded_from_quota_pie():
+    """A fold older than global_quota_staleness_s is EXCLUDED from
+    remote_usage (the quota pie rebalances onto live groups) and the
+    stale counter moves — never silently trusted."""
+    blue = FederationHost(group="blue", groups=GROUPS,
+                          global_quota=True,
+                          global_quota_staleness_s=5.0)
+    blue.fold_remote("green", {
+        "group": "green", "epoch": 1,
+        "pools": {"beta": {"u": {"mem": 30.0, "cpus": 2.0, "gpus": 0.0,
+                                 "jobs": 2}}}})
+    assert blue.remote_usage("u", "alpha")["mem"] == 30.0
+    # age the fold past the bound by rolling back its receive stamp
+    blue._remote_rx["green"] -= 6.0
+    before = metrics_registry.counter(
+        "federation_stale_folds_total", group="blue").value
+    assert blue.remote_usage("u", "alpha") == {}
+    assert metrics_registry.counter(
+        "federation_stale_folds_total", group="blue").value == before + 1
+    # the evidence surface agrees: flagged, with its age
+    entry = blue.debug()["exchange"]["green"]
+    assert entry["stale"] is True
+    assert entry["age_s"] > 5.0
+    # a fresh fold from the recovered peer un-stales it
+    blue.fold_remote("green", {
+        "group": "green", "epoch": 2,
+        "pools": {"beta": {"u": {"mem": 10.0, "cpus": 1.0, "gpus": 0.0,
+                                 "jobs": 1}}}})
+    assert blue.remote_usage("u", "alpha")["mem"] == 10.0
+    assert blue.debug()["exchange"]["green"]["stale"] is False
+
+
+def test_staleness_bound_zero_disables_flagging():
+    blue = FederationHost(group="blue", groups=GROUPS,
+                          global_quota=True,
+                          global_quota_staleness_s=0.0)
+    blue.fold_remote("green", {
+        "group": "green", "epoch": 1,
+        "pools": {"beta": {"u": {"mem": 30.0, "cpus": 2.0, "gpus": 0.0,
+                                 "jobs": 2}}}})
+    blue._remote_rx["green"] -= 3600.0
+    assert blue.remote_usage("u", "alpha")["mem"] == 30.0
+    assert blue.debug()["exchange"]["green"]["stale"] is False
+
+
+def test_stale_fold_shrinks_federated_quota_view_only_when_fresh():
+    """FederatedQuotaView must stop subtracting a dark group's usage:
+    the user's effective quota RECOVERS when the peer goes stale."""
+    blue = FederationHost(group="blue", groups=GROUPS,
+                          global_quota=True,
+                          global_quota_staleness_s=5.0)
+    fq = FederatedQuotaView(blue)
+    fq.set("u", "alpha", mem=100.0, cpus=10.0, count=5)
+    blue.fold_remote("green", {
+        "group": "green", "epoch": 1,
+        "pools": {"beta": {"u": {"mem": 40.0, "cpus": 4.0, "gpus": 0.0,
+                                 "jobs": 2}}}})
+    assert fq.get("u", "alpha")["mem"] == 60.0
+    blue._remote_rx["green"] -= 10.0
+    assert fq.get("u", "alpha")["mem"] == 100.0
+
+
+def test_reassign_flips_routing_and_records_evidence():
+    blue = FederationHost(group="blue", groups=GROUPS,
+                          url="http://blue:1")
+    assert blue.owns("alpha")
+    before = metrics_registry.counter(
+        "federation_pool_migrations_total", group="blue").value
+    rec = blue.reassign("alpha", "green", note="test handoff")
+    assert rec["from"] == "blue" and rec["to"] == "green"
+    assert not blue.owns("alpha")
+    assert blue.owner_url("alpha") == "http://green:2"
+    assert blue.owned_pools() == []
+    assert metrics_registry.counter(
+        "federation_pool_migrations_total", group="blue").value \
+        == before + 1
+    d = blue.debug()
+    assert d["migrations"][-1]["pool"] == "alpha"
+    assert d["migrations"][-1]["note"] == "test handoff"
+    assert d["pools"]["alpha"]["leader"] == "http://green:2"
+    # adopting it back on the green side (its own view)
+    green = FederationHost(group="green", groups=GROUPS,
+                           url="http://green:2")
+    green.reassign("alpha", "green", note="adopt")
+    assert green.owns("alpha")
+    assert sorted(green.owned_pools()) == ["alpha", "beta"]
+    with pytest.raises(ValueError):
+        blue.reassign("alpha", "nosuchgroup")
+
+
+def test_place_pools_stable_and_covering():
+    from cook_tpu.parallel.federation import place_pools
+
+    pools = [f"p{i}" for i in range(16)]
+    m1 = place_pools(pools, [0, 1, 2, 3])
+    m2 = place_pools(list(reversed(pools)), [0, 1, 2, 3])
+    assert m1 == m2                      # order-independent (stable)
+    assert set(m1) == set(pools)
+    assert set(m1.values()) <= {0, 1, 2, 3}
+    # adding a pool never moves an existing one (crc32(pool) % n only
+    # depends on the pool's own name while the device list is fixed)
+    m3 = place_pools(pools + ["extra"], [0, 1, 2, 3])
+    assert all(m3[p] == m1[p] for p in pools)
+    assert place_pools([], [0, 1]) == {}
+
+
+def test_host_placement_uses_owning_groups_devices():
+    groups = {"blue": {"pools": ["alpha", "gamma"],
+                       "url": "http://blue:1", "devices": [0, 1]},
+              "green": {"pools": ["beta"], "url": "http://green:2"}}
+    blue = FederationHost(group="blue", groups=groups,
+                          url="http://blue:1")
+    pl = blue.placement()
+    assert set(pl) == {"alpha", "gamma"}
+    assert set(pl.values()) <= {0, 1}
+    assert blue.placement_index("alpha") == pl["alpha"]
+    # a peer's pool places on the PEER's devices (none claimed: None)
+    assert blue.placement_index("beta") is None
+    # no claim -> default-device behavior
+    green = FederationHost(group="green", groups=groups,
+                           url="http://green:2")
+    assert green.placement() == {}

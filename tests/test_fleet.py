@@ -1,0 +1,348 @@
+"""Fleet-scale federation gates (tests.fedsoak.run_fleet_soak).
+
+The HA-pair soak (test_federation_soak) proves ONE group's failover
+story; this tier proves the N-group FLEET story on live subprocess
+servers with disjoint durable stores:
+
+  - zero lost jobs fleet-wide: every submitted uuid completes at SOME
+    group, including uuids whose pool migrated mid-soak;
+  - at-most-once launch across groups AND across the migration epoch
+    handoff: each task_id hits an executor at most once, and appears
+    at most once across ALL groups' event logs;
+  - per-group monotone fencing epochs (each group keeps its own
+    ledger; a group kill re-mints only that group's);
+  - bounded group recovery: a SIGKILLed group restarts from its own
+    durable state under the same MTTR ceiling as the HA pair —
+    restart-from-log IS a single-member group's availability story;
+  - live pool migration: the admin handoff moves pending jobs without
+    loss, and the foreign-pool 503 ownership hint flips from the
+    source to the destination;
+  - exchange staleness: a SIGSTOPped peer's last usage fold ages past
+    ``global_quota_staleness_s`` and is FLAGGED stale (quota-pie
+    rebalances onto fresh groups) rather than silently trusted.
+"""
+import json
+import os
+import signal
+import time
+import urllib.request
+import uuid as uuidlib
+
+import pytest
+
+from cook_tpu.client import JobClient
+from tests.fedsoak import run_fleet_soak, _admin_post
+from tests.livestack import LiveServer, free_port
+
+MTTR_CEILING_MS = 20_000.0
+
+FLEET_QUICK = dict(groups=3, jobs_per_group=4, agents_per_group=1,
+                   window_s=4.0, wall_s=90.0, group_kill=True,
+                   migrate=True, migrate_burst=3)
+FLEET_FULL = dict(groups=4, jobs_per_group=10, agents_per_group=2,
+                  window_s=12.0, wall_s=240.0, group_kill=True,
+                  migrate=True, migrate_burst=6)
+
+
+def _assert_fleet_gates(r, group_kill=True, migrate=True):
+    ctx = f"seed={r['seed']} tag={r['tag']}"
+    assert not r["violations"], \
+        f"[{ctx}] in-flight violations: {r['violations']}"
+    # zero lost jobs, fleet-wide
+    assert len(r["jobs"]) == r["expected_jobs"], \
+        f"[{ctx}] lost jobs: {len(r['jobs'])}/{r['expected_jobs']}"
+    for j in r["jobs"].values():
+        assert j.status == "completed", \
+            f"[{ctx}] {j.uuid} stuck in {j.status} (pool {j.pool})"
+    # at-most-once launch across the whole fleet
+    doubled = {t: n for t, n in r["launch_counts"].items() if n > 1}
+    assert not doubled, f"[{ctx}] double-launched: {doubled}"
+    seen: dict = {}
+    for rec in r["inst_tasks"]:
+        seen[rec["task"]] = seen.get(rec["task"], 0) + 1
+    dup = {t: n for t, n in seen.items() if n > 1}
+    assert not dup, \
+        f"[{ctx}] task ids duplicated across group logs: {dup}"
+    # per-group monotone epoch ledgers
+    for g, eps in r["epoch_ledgers"].items():
+        assert all(a < b for a, b in zip(eps, eps[1:])), \
+            f"[{ctx}] group {g} epoch ledger not increasing: {eps}"
+        assert eps, f"[{ctx}] group {g} never minted"
+    if group_kill:
+        kills = [t for t in r["transitions"]
+                 if t["action"] == "group_kill"]
+        assert kills, f"[{ctx}] no group-kill transition recorded"
+        for t in kills:
+            assert t["epoch_after"] > t["epoch_before"], \
+                f"[{ctx}] group restart without epoch advance: {t}"
+            assert t["mttr_ms"] <= MTTR_CEILING_MS, \
+                f"[{ctx}] group recovery took {t['mttr_ms']}ms: {t}"
+        assert sum(r["server_deaths"].values()) >= len(kills), \
+            f"[{ctx}] kill never landed: {r['server_deaths']}"
+    if migrate:
+        m = r["migration"]
+        assert m and m["result"].get("status") == 200, \
+            f"[{ctx}] migration failed: {m}"
+        assert m["hint_after"]["status"] == 503, \
+            f"[{ctx}] source still accepts after handoff: {m}"
+        assert m["hint_after"]["leader"] == m["expected_owner_url"], \
+            f"[{ctx}] ownership hint did not flip: {m}"
+        # the migrated burst completed (already covered by the global
+        # completeness gate; this pins WHICH uuids rode the handoff)
+        for u in m["burst_uuids"]:
+            assert u in r["jobs"] and r["jobs"][u].status == \
+                "completed", f"[{ctx}] migrated job {u} lost"
+
+
+@pytest.mark.parametrize("seed", [41])
+def test_fleet_soak_quick(tmp_path, seed):
+    """Quick tier: 3-group fleet, one group-kill, one live pool
+    migration under traffic."""
+    r = run_fleet_soak(tmp_path / "fleet", seed, **FLEET_QUICK)
+    _assert_fleet_gates(r, group_kill=True, migrate=True)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [41, 83])
+def test_fleet_soak_full_magnitude(tmp_path, seed):
+    """Nightly tier: the 4-group fleet day at full traffic."""
+    r = run_fleet_soak(tmp_path / "fleet", seed, **FLEET_FULL)
+    _assert_fleet_gates(r, group_kill=True, migrate=True)
+
+
+# ---------------------------------------------------------------------
+# deterministic live-migration regression (pending launches)
+# ---------------------------------------------------------------------
+
+def _fleet_pair(tmp_path, extra_fed=None):
+    """Two single-member groups with disjoint stores; g0 owns pool-a,
+    g1 owns pool-b; every member's config names both pools and both
+    groups."""
+    ports = {g: free_port() for g in ("g0", "g1")}
+    urls = {g: f"http://127.0.0.1:{ports[g]}" for g in ports}
+    fed_groups = {"g0": {"pools": ["pool-a"], "url": urls["g0"]},
+                  "g1": {"pools": ["pool-b"], "url": urls["g1"]}}
+    servers = {}
+    for g in ports:
+        fed = {"group": g, "groups": fed_groups,
+               "exchange_interval_s": 0.2,
+               "global_quota_staleness_s": 1.0}
+        fed.update(extra_fed or {})
+        servers[g] = LiveServer(
+            tmp_path / g, name=g, port=ports[g], max_kills=0,
+            overrides={
+                "default_pool": "pool-a" if g == "g0" else "pool-b",
+                "pools": [{"name": "pool-a"}, {"name": "pool-b"}],
+                "auth": {"admins": ["admin"]},
+                "federation": fed,
+            })
+    return servers, urls
+
+
+def test_live_migration_pending_jobs(tmp_path):
+    """Reassign a pool that has PENDING jobs and no agents at the
+    source: the handoff must move every job (zero lost), the 503
+    ownership hint must flip to the new owner, and once the
+    destination's agent appears each job launches exactly once —
+    at-most-once across the epoch handoff."""
+    from cook_tpu.agent.daemon import AgentDaemon
+    servers, urls = _fleet_pair(tmp_path)
+    launch_counts: dict = {}
+    daemon = None
+    try:
+        for s in servers.values():
+            s.start()
+        cli = JobClient(",".join(urls.values()), user="mover",
+                        timeout=5.0)
+        uuids = [str(uuidlib.uuid4()) for _ in range(4)]
+        for u in uuids:
+            # source has NO agents: the jobs are pending launches by
+            # construction when the migration fires
+            cli.submit(command="sleep 0.1", mem=32.0, cpus=1.0,
+                       uuid=u, pool="pool-a", max_retries=2)
+        st, resp = _admin_post(urls["g0"], "/federation/migrate",
+                               {"pool": "pool-a", "to": "g1"})
+        assert st == 200 and resp["moved"] == len(uuids), (st, resp)
+        assert resp["fence_epoch"] > 0, resp
+        # ownership hint flipped: the old owner now redirects
+        st2, resp2 = _admin_post(
+            urls["g0"], "/jobs",
+            {"jobs": [{"uuid": str(uuidlib.uuid4()),
+                       "command": "true", "mem": 1.0, "cpus": 0.1}],
+             "pool": "pool-a"})
+        assert st2 == 503 and resp2.get("leader") == urls["g1"], \
+            (st2, resp2)
+        # destination owns the jobs, still pending
+        g1 = JobClient(urls["g1"], user="admin", timeout=5.0)
+        got = g1.query_jobs(uuids)
+        assert len(got) == len(uuids), "jobs lost in handoff"
+        # an agent joins the destination: exactly-once launches
+        daemon = AgentDaemon(
+            urls["g1"], hostname="mig-agent", mem=4096.0, cpus=8.0,
+            pool="pool-a", sandbox_root=str(tmp_path / "sbx"),
+            heartbeat_interval_s=0.4,
+            agent_token=LiveServer.AGENT_TOKEN)
+        orig = daemon.executor.launch
+
+        def counted(task_id, *a, **kw):
+            launch_counts[task_id] = launch_counts.get(task_id, 0) + 1
+            return orig(task_id, *a, **kw)
+
+        daemon.executor.launch = counted
+        daemon.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            got = g1.query_jobs(uuids)
+            if all(j.status == "completed" for j in got):
+                break
+            time.sleep(0.3)
+        got = g1.query_jobs(uuids)
+        assert all(j.status == "completed" for j in got), \
+            [(j.uuid, j.status) for j in got]
+        doubled = {t: n for t, n in launch_counts.items() if n > 1}
+        assert not doubled, f"double launch across handoff: {doubled}"
+        assert sum(launch_counts.values()) == len(uuids)
+        # source's store is fenced for the pool: direct submit names
+        # the new owner, and the source's job table no longer has them
+        g0 = JobClient(urls["g0"], user="admin", timeout=5.0)
+        try:
+            g0.query_jobs(uuids[:1])
+            assert False, "source still serves migrated job"
+        except Exception:
+            pass
+    finally:
+        if daemon is not None:
+            daemon.stop()
+        for s in servers.values():
+            s.stop()
+
+
+def test_migration_refused_while_running(tmp_path):
+    """The RUNNING guard: with an agent attached and a long job
+    running, /federation/migrate answers 409 (listing the uuids) and
+    the pool stays put — the atomic in-store check, not just the
+    route's courtesy scan."""
+    from cook_tpu.agent.daemon import AgentDaemon
+    servers, urls = _fleet_pair(tmp_path)
+    daemon = None
+    try:
+        for s in servers.values():
+            s.start()
+        daemon = AgentDaemon(
+            urls["g0"], hostname="busy-agent", mem=4096.0, cpus=8.0,
+            pool="pool-a", sandbox_root=str(tmp_path / "sbx0"),
+            heartbeat_interval_s=0.4,
+            agent_token=LiveServer.AGENT_TOKEN)
+        daemon.start()
+        cli = JobClient(urls["g0"], user="busy", timeout=5.0)
+        u = str(uuidlib.uuid4())
+        cli.submit(command="sleep 30", mem=32.0, cpus=1.0, uuid=u,
+                   pool="pool-a", max_retries=1)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            j = cli.query_jobs([u])[0]
+            if j.status == "running":
+                break
+            time.sleep(0.2)
+        assert cli.query_jobs([u])[0].status == "running"
+        st, resp = _admin_post(urls["g0"], "/federation/migrate",
+                               {"pool": "pool-a", "to": "g1"})
+        assert st == 409, (st, resp)
+        assert u in resp.get("running", []), resp
+        # still owned and served by g0
+        st2, _ = _admin_post(
+            urls["g0"], "/jobs",
+            {"jobs": [{"uuid": str(uuidlib.uuid4()),
+                       "command": "true", "mem": 1.0, "cpus": 0.1}],
+             "pool": "pool-a"})
+        assert st2 == 201, st2
+    finally:
+        if daemon is not None:
+            daemon.stop()
+        for s in servers.values():
+            s.stop()
+
+
+# ---------------------------------------------------------------------
+# exchange staleness (satellite: SIGSTOPped peer must be flagged)
+# ---------------------------------------------------------------------
+
+def test_stale_fold_flagged_not_trusted(tmp_path):
+    """``global_quota: true`` with a frozen peer: the survivor keeps
+    the peer's last fold but FLAGS it stale once its age passes
+    ``global_quota_staleness_s`` — remote usage stops counting it (the
+    quota pie rebalances onto live groups) and the stale counter
+    moves. SIGCONT un-stales it again."""
+    from cook_tpu.agent.daemon import AgentDaemon
+    servers, urls = _fleet_pair(tmp_path,
+                                extra_fed={"global_quota": True})
+    frozen_pid = None
+    daemon = None
+    try:
+        for s in servers.values():
+            s.start()
+        # wait until g0 has folded g1 at least once
+        deadline = time.time() + 20
+        fed = {}
+        while time.time() < deadline:
+            fed = servers["g0"].debug().get("federation", {})
+            ex = fed.get("exchange", {})
+            if ex.get("g1", {}).get("epoch", 0) >= 1 or \
+                    "g1" in ex:
+                break
+            time.sleep(0.2)
+        assert "g1" in fed.get("exchange", {}), \
+            f"peer fold never arrived: {fed}"
+        frozen_pid = servers["g1"].sup._proc.pid
+        os.kill(frozen_pid, signal.SIGSTOP)
+        # age past the bound (1.0s in _fleet_pair) and re-check
+        time.sleep(2.5)
+        fed = servers["g0"].debug().get("federation", {})
+        entry = fed["exchange"]["g1"]
+        assert entry.get("stale") is True, \
+            f"frozen peer's fold not flagged stale: {entry}"
+        assert entry.get("age_s", 0) > 1.0, entry
+        # the counter moves when a real quota fold runs: one match
+        # cycle at g0 (agent + job) exercises FederatedQuotaView.get
+        # -> remote_usage -> _fresh_snaps with the frozen peer stale
+        daemon = AgentDaemon(
+            urls["g0"], hostname="stale-agent", mem=4096.0, cpus=8.0,
+            pool="pool-a", sandbox_root=str(tmp_path / "sbx-stale"),
+            heartbeat_interval_s=0.4,
+            agent_token=LiveServer.AGENT_TOKEN)
+        daemon.start()
+        cli = JobClient(urls["g0"], user="staleuser", timeout=5.0)
+        u = str(uuidlib.uuid4())
+        cli.submit(command="true", mem=32.0, cpus=1.0, uuid=u,
+                   pool="pool-a", max_retries=1)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if cli.query_jobs([u])[0].status == "completed":
+                break
+            time.sleep(0.3)
+        with urllib.request.urlopen(urls["g0"] + "/metrics",
+                                    timeout=5.0) as r:
+            metrics = r.read().decode()
+        assert "cook_federation_stale_folds_total" in metrics, \
+            "stale-fold counter never exported"
+        os.kill(frozen_pid, signal.SIGCONT)
+        frozen_pid = None
+        deadline = time.time() + 20
+        fresh = False
+        while time.time() < deadline:
+            fed = servers["g0"].debug().get("federation", {})
+            if not fed["exchange"]["g1"].get("stale"):
+                fresh = True
+                break
+            time.sleep(0.3)
+        assert fresh, f"fold never un-staled after SIGCONT: {fed}"
+    finally:
+        if frozen_pid is not None:
+            try:
+                os.kill(frozen_pid, signal.SIGCONT)
+            except OSError:
+                pass
+        if daemon is not None:
+            daemon.stop()
+        for s in servers.values():
+            s.stop()

@@ -1388,3 +1388,124 @@ def test_concurrent_shard_lanes_replay_to_live_hash(tmp_path):
     cold = JobStore.restore(log_path=log, open_writer=False)
     assert cold.state_hash() == want
     assert len(cold.task_to_job) == 48
+
+
+# ----------------------------------------------------------------------
+# fleet federation: pool-scoped epoch fences + live pool migration
+# (migrate_pool_out / import_pool / fedmove / fedadopt replay)
+
+def _durable(tmp_path, name="a"):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    path = str(d / "events.log")
+    return JobStore(log_path=path), path
+
+
+def test_read_epoch_fences_splits_scopes(tmp_path):
+    from cook_tpu.state.store import _read_epoch_fences
+
+    s, log = _durable(tmp_path)
+    s.create_jobs([mkjob(pool="p1"), mkjob(pool="p2")])
+    s.mint_epoch(owner="boot")                       # unscoped: 1
+    f1 = s.mint_epoch(owner="mv1", pools=("p1",))    # scoped: 2
+    s.mint_epoch(owner="boot2")                      # unscoped: 3
+    f2 = s.mint_epoch(owner="mv2", pools=("p1", "p2"))
+    path = log + ".epoch"
+    unscoped, fences = _read_epoch_fences(path)
+    assert unscoped == 3                 # scoped mints don't raise it
+    assert fences == {"p1": f2, "p2": f2}
+    assert f1 == 2 and f2 == 4
+    # torn trailing line tolerated
+    with open(path, "ab") as f:
+        f.write(b'{"epoch": 99, "poo')
+    assert _read_epoch_fences(path) == (unscoped, fences)
+
+
+def test_pool_scoped_mint_fences_only_that_pool(tmp_path):
+    s, _ = _durable(tmp_path)
+    s.create_jobs([mkjob(pool="p1")])
+    epoch_before = s.epoch
+    fence = s.mint_epoch(owner="mover", pools=("p1",))
+    # the minter's own epoch does NOT advance (it is fencing a pool
+    # away from itself, not taking over)
+    assert s.epoch == epoch_before
+    assert fence > epoch_before
+    from cook_tpu.state.store import StaleEpochError
+    with pytest.raises(StaleEpochError):
+        s.create_jobs([mkjob(pool="p1")])
+    # other pools flow
+    s.create_jobs([mkjob(pool="p2")])
+    # an unscoped mint raises the epoch ABOVE the fence: the pool is
+    # writable again (the rollback path)
+    s.mint_epoch(owner="rollback")
+    s.create_jobs([mkjob(pool="p1")])
+
+
+def test_migrate_pool_out_atomic_export_and_fence(tmp_path):
+    from cook_tpu.state.model import Group
+
+    (src, src_log), (dst, dst_log) = (_durable(tmp_path, "src"),
+                                      _durable(tmp_path, "dst"))
+    grp = "g-" + new_uuid()
+    jobs = [mkjob(pool="mig", group=grp) for _ in range(3)]
+    keep = mkjob(pool="stay")
+    src.create_jobs(jobs + [keep],
+                    groups=[Group(uuid=grp, name="mig-group",
+                                  user="alice")])
+    payload = src.migrate_pool_out("mig", fence_owner="fedmove:test")
+    assert payload["count"] == 3
+    assert payload["fence_epoch"] > 0
+    assert {d["uuid"] for d in payload["jobs"]} == \
+        {j.uuid for j in jobs}
+    assert [g["uuid"] for g in payload["groups"]] == [grp]
+    # source: gone, fenced, but unrelated pools writable
+    assert all(j.uuid not in src.jobs for j in jobs)
+    assert keep.uuid in src.jobs
+    from cook_tpu.state.store import StaleEpochError
+    with pytest.raises(StaleEpochError):
+        src.create_jobs([mkjob(pool="mig")])
+    src.create_jobs([mkjob(pool="stay")])
+    # destination adopts; idempotent per uuid
+    adopted = dst.import_pool("mig", payload["jobs"],
+                              payload["groups"])
+    assert sorted(adopted) == sorted(j.uuid for j in jobs)
+    assert dst.import_pool("mig", payload["jobs"],
+                           payload["groups"]) == []
+    assert sorted(dst.groups[grp].jobs) == sorted(j.uuid for j in jobs)
+    # cold replay lands both stores on their live state hashes
+    for st, lp in ((src, src_log), (dst, dst_log)):
+        want = st.state_hash()
+        st._log.sync()
+        cold = JobStore.restore(log_path=lp, open_writer=False)
+        assert cold.state_hash() == want
+
+
+def test_migrate_pool_out_refuses_running_unless_forced(tmp_path):
+    from cook_tpu.state.store import PoolBusyError
+
+    s, _ = _durable(tmp_path)
+    j = mkjob(pool="busy")
+    s.create_jobs([j])
+    s.create_instance(j.uuid, "h1", "mock")
+    assert j.state == JobState.RUNNING
+    with pytest.raises(PoolBusyError) as ei:
+        s.migrate_pool_out("busy", fence_owner="x")
+    assert ei.value.running == [j.uuid]
+    # the refusal left no trace: not fenced, job still here
+    s.create_jobs([mkjob(pool="busy")])
+    assert j.uuid in s.jobs
+    # force exports it anyway (operator's explicit call)
+    payload = s.migrate_pool_out("busy", fence_owner="x", force=True)
+    assert payload["count"] == 2
+    assert j.uuid not in s.jobs
+
+
+def test_migrate_empty_pool_still_fences(tmp_path):
+    s, _ = _durable(tmp_path)
+    s.create_jobs([mkjob(pool="other")])
+    payload = s.migrate_pool_out("ghost", fence_owner="mv")
+    assert payload["count"] == 0
+    assert payload["fence_epoch"] > 0
+    from cook_tpu.state.store import StaleEpochError
+    with pytest.raises(StaleEpochError):
+        s.create_jobs([mkjob(pool="ghost")])
