@@ -1322,6 +1322,117 @@ def bench_trace_overhead(out_path="/tmp/cook_trace.json",
     }), flush=True)
 
 
+def bench_profile_overhead(out_path="/tmp/cook_profile.json",
+                           cycles=120, warmup=20):
+    """A/B the always-on cycle profiler on the e2e coordinator path
+    and cross-validate its critical-path attribution against the
+    bench's own phase means.
+
+    The profiler's bargain mirrors the flight recorder's: the stamps
+    ARE the metrics stamps the coordinator always pays for, and only
+    ``commit()`` is gated — so enabling it may add at most ring-append
+    + streaming-histogram cost per cycle. This mode runs the SAME
+    small e2e config twice in one process (commit disabled, then
+    enabled), publishes overhead_ok against the 2% budget, and then
+    checks the blame ledger tells the same story as the bench: the
+    phase the profiler names dominant for consume cycles must be the
+    phase with the largest bench-measured mean (after mapping the
+    profiler's finer bookkeep/backend split onto the bench's combined
+    backend_launch_ms key). The worst cycles export as Chrome-trace
+    JSON."""
+    from cook_tpu import obs
+
+    cfg = dict(P0=20_000, H=2_000, cycles=cycles, warmup=warmup)
+    runs = {}
+    for mode, enabled in (("disabled", False), ("enabled", True)):
+        obs.profiler.reset()
+        obs.profiler.enabled = enabled
+        stats = {}
+        bench_e2e(label=f"profile-overhead [{mode}] @ 20k-pending x "
+                        "2k-offers", stats_out=stats, **cfg)
+        runs[mode] = stats
+    snap = obs.profiler.snapshot()
+    with open(out_path, "w") as f:
+        json.dump(obs.profiler.chrome_trace(16), f)
+    obs.profiler.enabled = True   # restore the process-wide default
+    dps_off = float(runs["disabled"]["value"])
+    dps_on = float(runs["enabled"]["value"])
+    overhead = ((dps_off - dps_on) / dps_off * 100.0) if dps_off else 0.0
+    # blame-vs-bench cross-validation on the consume cycle: map the
+    # profiler's phases onto the bench keys that aggregate them
+    enabled_stats = runs["enabled"]
+    bench_equiv = {
+        "readback": float(enabled_stats["readback_mean_ms"]),
+        "fold": float(enabled_stats["phase_means_ms"]["consume_fold_ms"]),
+        "frame": float(
+            enabled_stats["phase_means_ms"]["consume_frame_ms"]),
+        "launch_txn": float(
+            enabled_stats["phase_means_ms"]["launch_txn_ms"]),
+        "backend_launch": float(
+            enabled_stats["phase_means_ms"]["backend_launch_ms"]),
+    }
+    dominant_bench = max(bench_equiv, key=bench_equiv.get)
+    consume = (snap.get("kinds") or {}).get("consume") or {}
+    # mean-based profiler dominance over the SAME key set: the bench
+    # means come from the same stamps, so these must agree — that's
+    # the cross-validation. The blame ledger (per-cycle critical-path
+    # counts) is reported alongside; it can legitimately diverge from
+    # means when one phase owns a few huge outliers and another wins
+    # most cycles by a hair.
+    prof_phases = consume.get("phases") or {}
+
+    def _pmean(name):
+        return float((prof_phases.get(name) or {}).get("mean_ms", 0.0))
+
+    prof_equiv = {
+        "readback": _pmean("readback"),
+        "fold": _pmean("fold"),
+        "frame": _pmean("frame"),
+        "launch_txn": _pmean("launch_txn"),
+        "backend_launch": _pmean("bookkeep") + _pmean("backend_launch"),
+    }
+    dominant_prof = max(prof_equiv, key=prof_equiv.get) \
+        if any(prof_equiv.values()) else ""
+    blame_dominant = consume.get("dominant", "")
+    if blame_dominant == "bookkeep":
+        blame_dominant = "backend_launch"
+    # tie tolerance: the two ledgers sample slightly different windows
+    # (bench means exclude warmup; the profiler ring keeps it), so two
+    # phases within 20% of each other in BOTH ledgers is a statistical
+    # tie, not a disagreement — either name is a truthful "dominant"
+    dominant_match = dominant_prof == dominant_bench
+    if not dominant_match and dominant_prof and dominant_bench:
+        a = (bench_equiv[dominant_prof], bench_equiv[dominant_bench])
+        b = (prof_equiv[dominant_prof], prof_equiv[dominant_bench])
+        dominant_match = (min(a) > 0.8 * max(a)
+                          and min(b) > 0.8 * max(b))
+    print(json.dumps({
+        "metric": "cycle profiler overhead, e2e @ 20k-pending x "
+                  "2k-offers",
+        "value": round(overhead, 2),
+        "unit": "% decisions/sec lost with profiler commit enabled",
+        "budget_pct": 2.0,
+        "overhead_ok": overhead <= 2.0,
+        "decisions_per_sec_disabled": dps_off,
+        "decisions_per_sec_enabled": dps_on,
+        "p99_cycle_ms_disabled": runs["disabled"]["p99_cycle_ms"],
+        "p99_cycle_ms_enabled": runs["enabled"]["p99_cycle_ms"],
+        "dominant_phase_profiler": dominant_prof,
+        "dominant_phase_bench": dominant_bench,
+        "dominant_match": dominant_match,
+        "bench_phase_means_ms": {k: round(v, 2)
+                                 for k, v in bench_equiv.items()},
+        "profiler_phase_means_ms": {k: round(v, 2)
+                                    for k, v in prof_equiv.items()},
+        "blame_dominant": blame_dominant,
+        "blame": consume.get("blame", {}),
+        "committed": snap.get("committed", 0),
+        "chrome_trace": out_path,
+        "chrome_trace_note": "16 worst cycles with phase children; "
+                             "open in Perfetto or chrome://tracing",
+    }), flush=True)
+
+
 def bench_decision_overhead(cycles=120, warmup=20, rounds=2):
     """A/B the decision-provenance readback on the e2e coordinator
     path.
@@ -2181,6 +2292,12 @@ def main():
         # A/B of the obs flight recorder on the e2e path + Chrome-trace
         # export; optional argv[2] = output JSON path
         bench_trace_overhead(*(sys.argv[2:3] or ["/tmp/cook_trace.json"]))
+    elif which == "profile-overhead":
+        # A/B of the always-on cycle profiler (commit disabled vs
+        # enabled) on the e2e path + blame-vs-bench cross-validation;
+        # optional argv[2] = Chrome-trace output path
+        bench_profile_overhead(*(sys.argv[2:3]
+                                 or ["/tmp/cook_profile.json"]))
     elif which == "decision-overhead":
         # A/B of the decision-provenance readback + DecisionBook
         # recording (disabled vs enabled) on the e2e path
@@ -2231,6 +2348,7 @@ def main():
                          "e2e-small e2e-smoke e2e-batched e2e-async "
                          "longevity "
                          "longevity-async trace-overhead "
+                         "profile-overhead "
                          "decision-overhead chaos-overhead "
                          "crash-soak day-soak failover fleet launch "
                          "store-shard pallas")

@@ -110,7 +110,8 @@ def main(argv: list[str] | None = None) -> int:
                     "metrics discipline (R7), epoch discipline (R8), "
                     "shard-lock discipline (R9), consume discipline "
                     "(R10), whole-program lock order (R11), "
-                    "durability-ack dominance (R12)")
+                    "durability-ack dominance (R12), profiler "
+                    "discipline (R13)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the cook_tpu "
                          "package)")

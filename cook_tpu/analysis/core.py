@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
 ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
-             "R10", "R11", "R12")
+             "R10", "R11", "R12", "R13")
 
 # rules that run over the whole scanned file set at once (the
 # interprocedural model), not per-module
@@ -44,6 +44,7 @@ RULE_DIRS = {
     "R8": ("state",),
     "R9": ("state",),
     "R10": ("state", "backends", "scheduler", "native", "agent"),
+    "R13": ("scheduler", "obs"),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -174,14 +175,16 @@ def diff_baseline(findings: list[Finding], baseline: dict[str, int]
 
 def analyze_source(source: str, path: str,
                    rules: Iterable[str] = ("R1", "R2", "R3", "R5", "R6",
-                                           "R7", "R8", "R9", "R10"),
+                                           "R7", "R8", "R9", "R10",
+                                           "R13"),
                    apply_suppressions: bool = True) -> list[Finding]:
     """Run the per-module AST rules over one source text."""
     from cook_tpu.analysis import (async_hygiene, consume_discipline,
                                    epoch_discipline, lock_discipline,
-                                   metrics_discipline, retry_discipline,
-                                   shard_discipline, span_discipline,
-                                   trace_purity)
+                                   metrics_discipline,
+                                   profiler_discipline,
+                                   retry_discipline, shard_discipline,
+                                   span_discipline, trace_purity)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -208,6 +211,8 @@ def analyze_source(source: str, path: str,
         findings += shard_discipline.check(mod)
     if "R10" in rules:
         findings += consume_discipline.check(mod)
+    if "R13" in rules:
+        findings += profiler_discipline.check(mod)
     if apply_suppressions:
         sup = collect_suppressions(source)
         findings = [f for f in findings if not suppressed(f, sup)]

@@ -313,8 +313,18 @@ class Settings:
     metrics_jsonl: Optional[str] = None
     metrics_interval_s: float = 60.0
     # event-driven span export (obs tracer): one JSON line per
-    # finished span, alongside the interval-driven metric reporters
+    # finished span, alongside the interval-driven metric reporters.
+    # spans_jsonl_max_mb > 0 bounds the file: at the bound it rotates
+    # to <path>.1 (one old generation kept), so a long-lived server
+    # holds at most ~2x the bound on disk. 0 = unbounded (legacy).
     spans_jsonl: Optional[str] = None
+    spans_jsonl_max_mb: float = 0.0
+    # always-on cycle profiler (obs/profiler.py): ring of per-cycle
+    # phase ledgers behind /debug/profile. profile_ring sizes the
+    # bounded ring (entries, not bytes); profile_jsonl streams one
+    # JSON line per committed cycle record for offline analysis.
+    profile_ring: int = 2048
+    profile_jsonl: Optional[str] = None
     plugins: dict = field(default_factory=dict)
     # {"optimizer": "pkg.mod:factory" | "capacity-planning",
     #  "host_feed": "pkg.mod:factory", "interval_s": 30}

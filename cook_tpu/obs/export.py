@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import json
+import os
 import threading
 from typing import Iterable, Optional
 
@@ -12,20 +13,48 @@ class SpanJsonlExporter:
     Sits alongside the metric reporters (utils.metrics.JsonlReporter)
     but is event-driven rather than interval-driven: attach with
     ``tracer.add_listener(exporter)``.
+
+    ``max_mb`` bounds the file: when an append would cross the bound
+    the current file is atomically renamed to ``<path>.1`` (replacing
+    any previous generation) and a fresh file is started — a long-
+    lived server holds at most ~2x the bound on disk instead of
+    growing without limit.  ``max_mb=0`` disables rotation.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_mb: float = 0.0):
         self.path = path
+        self.max_bytes = int(max(0.0, float(max_mb)) * 1024 * 1024)
         self._lock = threading.Lock()
         self._f = open(path, "a", encoding="utf-8")
+        self._size = os.path.getsize(path)
 
     def __call__(self, span: dict) -> None:
-        line = json.dumps(span, separators=(",", ":"))
+        line = json.dumps(span, separators=(",", ":")) + "\n"
         with self._lock:
             if self._f is None:
                 return
-            self._f.write(line + "\n")
+            if self.max_bytes and self._size \
+                    and self._size + len(line) > self.max_bytes:
+                self._rotate_locked()
+            self._f.write(line)
             self._f.flush()
+            self._size += len(line)
+
+    def _rotate_locked(self) -> None:
+        """Swap in a fresh file; the old one becomes ``<path>.1``.
+
+        ``os.replace`` is atomic on POSIX, so a tail-follower sees
+        either the old generation or the new file, never a torn one.
+        Rotation failure (e.g. a read-only dir racing a permission
+        change) falls back to continuing in the current file — losing
+        the bound beats losing the spans."""
+        try:
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._size = os.path.getsize(self.path)
 
     def close(self) -> None:
         with self._lock:

@@ -132,6 +132,32 @@ class _NoopSpan:
 NOOP_SPAN = _NoopSpan()
 
 
+def assemble_tree(spans: Iterable[dict]) -> list:
+    """Assemble finished span dicts into a forest: roots with nested
+    ``children``, siblings ordered by start time.
+
+    Module-level (not a Tracer method) so the federation-aware
+    ``/trace`` path can assemble a MERGED span set — local spans plus
+    the owning peer groups' — into one connected tree."""
+    spans = list(spans)
+    nodes = {s["span"]: dict(s, children=[]) for s in spans}
+    roots = []
+    for s in spans:
+        node = nodes[s["span"]]
+        parent = nodes.get(s["parent"])
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+
+    def _sort(ns):
+        ns.sort(key=lambda n: n["t0"])
+        for n in ns:
+            _sort(n["children"])
+    _sort(roots)
+    return roots
+
+
 class Tracer:
     """Thread-safe span sink: flight ring + bounded per-trace index.
 
@@ -257,22 +283,7 @@ class Tracer:
     def tree(self, trace_id: str) -> list:
         """Assembled span forest for a trace: roots with nested
         ``children``, siblings ordered by start time."""
-        spans = self.trace(trace_id)
-        nodes = {s["span"]: dict(s, children=[]) for s in spans}
-        roots = []
-        for s in spans:
-            node = nodes[s["span"]]
-            parent = nodes.get(s["parent"])
-            if parent is not None:
-                parent["children"].append(node)
-            else:
-                roots.append(node)
-        def _sort(ns):
-            ns.sort(key=lambda n: n["t0"])
-            for n in ns:
-                _sort(n["children"])
-        _sort(roots)
-        return roots
+        return assemble_tree(self.trace(trace_id))
 
     def recent(self, limit: int = 64) -> list:
         """Newest-first flight-recorder entries (per-cycle spans)."""

@@ -30,6 +30,7 @@ import json
 import logging
 import re
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -195,12 +196,16 @@ class CookApi:
                 # user principal works too — the generic branch below.
                 req.user = "federation-peer"
             elif path not in ("/info", "/debug", "/debug/flight",
-                              "/debug/decisions", "/metrics",
+                              "/debug/decisions", "/debug/profile",
+                              "/metrics",
                               # peer-leader machine channel: read-only
                               # per-user aggregates for the cross-shard
-                              # DRU exchange (same sensitivity class as
-                              # the /metrics exposition)
-                              "/federation/usage"):
+                              # DRU exchange and the fleet health/trace
+                              # rollup (same sensitivity class as the
+                              # /metrics exposition)
+                              "/federation/usage",
+                              "/federation/health") \
+                    and not path.startswith("/federation/trace/"):
                 # conditional-auth-bypass
                 req.user = authenticate(self.auth, headers)
             if method in ("POST", "PUT", "DELETE") \
@@ -315,6 +320,8 @@ class CookApi:
         # cycle flight recorder (obs/ tracer)
         r.add("GET", "/trace/:uuid", self.get_trace)
         r.add("GET", "/debug/flight", self.get_debug_flight)
+        # always-on cycle profiler: phase stats + critical-path blame
+        r.add("GET", "/debug/profile", self.get_debug_profile)
         r.add("GET", "/data-local", self.data_local_status)
         r.add("GET", "/data-local/:uuid", self.data_local_costs)
         r.add("GET", "/metrics", self.get_metrics)
@@ -326,6 +333,13 @@ class CookApi:
         # the payload to the DESTINATION's adopt endpoint
         r.add("POST", "/federation/migrate", self.migrate_pool)
         r.add("POST", "/federation/adopt", self.adopt_pool)
+        # fleet observability plane: health rollup across every leader
+        # group + the peer-facing span reads get_trace merges from
+        r.add("GET", "/federation/health", self.federation_health)
+        r.add("GET", "/federation/trace/job/:uuid",
+              self.federation_trace_job)
+        r.add("GET", "/federation/trace/:trace_id",
+              self.federation_trace)
         r.add("GET", "/rebalancer", self.get_rebalancer_params)
         r.add("POST", "/rebalancer", self.set_rebalancer_params)
         # network-agent control plane (the framework-message channel of
@@ -388,6 +402,13 @@ class CookApi:
                                   "to": dest, "moved": 0, "noop": True})
         if self.coord is not None:
             self.coord.retire_resident(pool)
+        # one migration span id for the whole handoff (the launch-txn
+        # precedent: the same id rides the durable "fedmove" record AND
+        # appears as the fed.migrate span in every affected traced
+        # job's tree, and the destination parents fed.adopt under it —
+        # that link is what makes the cross-group tree ONE tree)
+        migrate_sid = obs.new_span_id() if obs.tracer.enabled else ""
+        t_mig0 = obs.now_ms()
         try:
             # at-most-once across the handoff: a RUNNING job's agent
             # still posts status to THIS group; adopting it elsewhere
@@ -398,7 +419,7 @@ class CookApi:
             # flips the verdict instead of slipping through.
             payload = self.store.migrate_pool_out(
                 pool, fence_owner=f"fedmove:{fed.group}->{dest}",
-                force=bool(body.get("force")))
+                force=bool(body.get("force")), span_id=migrate_sid)
         except PoolBusyError as e:
             raise ApiError(
                 409, f"pool {pool} has {len(e.running)} RUNNING jobs; "
@@ -411,7 +432,10 @@ class CookApi:
             import urllib.request
             data = json.dumps({"pool": pool, "from": fed.group,
                                "jobs": payload["jobs"],
-                               "groups": payload["groups"]}).encode()
+                               "groups": payload["groups"],
+                               # span context: the destination parents
+                               # its fed.adopt span under this id
+                               "span": migrate_sid}).encode()
             for attempt in range(3):
                 try:
                     req2 = urllib.request.Request(
@@ -443,6 +467,19 @@ class CookApi:
             return Response(502, {
                 "error": f"adopt failed at {dest!r}: {err!r}",
                 "pool": pool, "rolled_back": True})
+        if migrate_sid:
+            # per-traced-job migration span (same id across jobs, the
+            # bulk-txn convention): parented on each job's root so the
+            # source half of the tree stays connected
+            end_ms = obs.now_ms()
+            for jd in payload["jobs"]:
+                ctx = obs.parse_traceparent(jd.get("traceparent") or "")
+                if ctx is None:
+                    continue
+                obs.tracer.record(
+                    "fed.migrate", trace_id=ctx[0], span_id=migrate_sid,
+                    parent_id=ctx[1], start_ms=t_mig0, end_ms=end_ms,
+                    attrs={"pool": pool, "from": fed.group, "to": dest})
         return Response(200, {"pool": pool, "from": fed.group,
                               "to": dest, "moved": payload["count"],
                               "fence_epoch": payload["fence_epoch"]})
@@ -462,15 +499,54 @@ class CookApi:
         pool = body.get("pool")
         if not pool:
             raise ApiError(400, "pool is required")
-        adopted = self.store.import_pool(pool, body.get("jobs") or [],
-                                         body.get("groups") or [])
+        # continue the migration's span context: the source shipped its
+        # fed.migrate span id in the body; our fed.adopt parents under
+        # it, and reconcile parents under adopt — migrate -> adopt ->
+        # reconcile reads as one connected tree across both groups
+        migrate_sid = body.get("span") or ""
+        adopt_sid = obs.new_span_id() if obs.tracer.enabled else ""
+        t_ad0 = obs.now_ms()
+        jobs = body.get("jobs") or []
+        adopted = self.store.import_pool(pool, jobs,
+                                         body.get("groups") or [],
+                                         span_id=adopt_sid)
         fed.reassign(pool, fed.group,
                      note=f"adopt from {body.get('from', '?')}")
+        t_ad1 = obs.now_ms()
+        if adopt_sid:
+            adopted_set = set(adopted)
+            for jd in jobs:
+                if jd.get("uuid") not in adopted_set:
+                    continue
+                ctx = obs.parse_traceparent(jd.get("traceparent") or "")
+                if ctx is None:
+                    continue
+                obs.tracer.record(
+                    "fed.adopt", trace_id=ctx[0], span_id=adopt_sid,
+                    parent_id=migrate_sid or ctx[1],
+                    start_ms=t_ad0, end_ms=t_ad1,
+                    attrs={"pool": pool, "group": fed.group,
+                           "from": body.get("from", "?")})
         if self.coord is not None:
             try:
                 self.coord.reconcile_restart(pools=[pool])
             except Exception:
                 log.exception("post-adopt reconcile for %r failed", pool)
+            finally:
+                if adopt_sid:
+                    rec_sid = obs.new_span_id()
+                    t_rc1 = obs.now_ms()
+                    for jd in jobs:
+                        ctx = obs.parse_traceparent(
+                            jd.get("traceparent") or "")
+                        if ctx is None \
+                                or jd.get("uuid") not in adopted_set:
+                            continue
+                        obs.tracer.record(
+                            "fed.reconcile", trace_id=ctx[0],
+                            span_id=rec_sid, parent_id=adopt_sid,
+                            start_ms=t_ad1, end_ms=t_rc1,
+                            attrs={"pool": pool, "group": fed.group})
         return Response(200, {"pool": pool, "group": fed.group,
                               "adopted": len(adopted)})
 
@@ -635,11 +711,24 @@ class CookApi:
         # 503 + the owning leader's address + Retry-After.
         fed = getattr(self, "federation", None)
         if fed is not None and pool_name and not fed.owns(pool_name):
+            owner_url = fed.owner_url(pool_name) or self._leader_hint()
+            if obs.tracer.enabled:
+                # redirect hint span: a traced caller bouncing between
+                # groups sees WHERE the 503 detour happened instead of
+                # an unexplained gap before the owning group's submit
+                inbound = obs.parse_traceparent(
+                    req.headers.get("traceparent", ""))
+                if inbound is not None:
+                    t_ms = obs.now_ms()
+                    obs.tracer.record(
+                        "fed.redirect", trace_id=inbound[0],
+                        parent_id=inbound[1], start_ms=t_ms, end_ms=t_ms,
+                        attrs={"pool": pool_name, "group": fed.group,
+                               "leader": owner_url or ""})
             return Response(503, {
                 "error": f"pool {pool_name} owned by another leader "
                          "group",
-                "leader": fed.owner_url(pool_name)
-                or self._leader_hint()},
+                "leader": owner_url},
                 headers={"Retry-After": "1"})
 
         groups = [self._parse_group(g, req.user)
@@ -1467,22 +1556,105 @@ class CookApi:
             body["chaos"] = chaos.controller.stats()
         return Response(200, body)
 
+    # -- federation-aware tracing ---------------------------------------
+    #
+    # A migrated job's spans live in TWO groups' tracers: the source
+    # recorded submit/match/fed.migrate, the destination recorded
+    # fed.adopt/reconcile/launch.  /trace/<uuid> on EITHER group must
+    # return the whole story, so the serving group fans out to its
+    # peers over two dumb, non-recursive read endpoints
+    # (/federation/trace/...) and merges before assembling the tree.
+    # All recursion risk stays here: the peer endpoints only ever read
+    # their local tracer/store.
+
+    _PEER_TRACE_TIMEOUT_S = 1.5
+
+    def _peer_get(self, url: str,
+                  timeout: float = _PEER_TRACE_TIMEOUT_S
+                  ) -> Optional[dict]:
+        """GET a peer's read-only endpoint on the leader-to-leader
+        machine channel; None on any failure (a dark peer degrades the
+        answer, never the request)."""
+        import urllib.request
+        try:
+            r = urllib.request.Request(url, headers={
+                "X-Cook-Agent-Token": self.auth.agent_token or ""})
+            with urllib.request.urlopen(r, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except Exception:
+            return None
+
     def get_trace(self, req: Request, uuid: str) -> Response:
         """Assembled span tree for one job's lifecycle: REST submit ->
         store txn -> match-cycle phases -> launch txn -> backend/agent
         launch -> completion, across process boundaries (the agent's
-        spans arrive via the status-post echo)."""
+        spans arrive via the status-post echo).
+
+        Federation-aware: when the job is unknown locally (migrated
+        away, or submitted to another group) the owning peer is found
+        via /federation/trace/job/<uuid>; once a trace id is in hand
+        every peer's spans for it are merged (dedup by span id) so
+        migrate -> adopt -> reconcile reads as ONE connected tree no
+        matter which group serves the request."""
+        fed = getattr(self, "federation", None)
+        peers = fed.peers() if fed is not None else []
         job = self.store.get_job(uuid)
-        if job is None:
-            raise ApiError(404, f"job {uuid} unknown")
-        ctx = obs.parse_traceparent(job.traceparent)
+        trace_id, traceparent = "", ""
+        if job is not None:
+            ctx = obs.parse_traceparent(job.traceparent)
+            if ctx is None:
+                raise ApiError(404, f"no trace recorded for job {uuid}")
+            trace_id, traceparent = ctx[0], job.traceparent
+        else:
+            # local miss: ask each peer to resolve uuid -> trace id
+            # from ITS store (dumb lookup, no further fan-out)
+            for _g, url in peers:
+                got = self._peer_get(
+                    f"{url}/federation/trace/job/{uuid}")
+                if got and got.get("trace_id"):
+                    trace_id = got["trace_id"]
+                    traceparent = got.get("traceparent") or ""
+                    break
+            if not trace_id:
+                raise ApiError(404, f"job {uuid} unknown")
+        spans = {s["span"]: s for s in obs.tracer.trace(trace_id)}
+        if peers:
+            # merge every peer's spans for this trace id; dedup by
+            # span id (the migration span is recorded per-job with one
+            # shared id — txn-span convention — so it folds to one)
+            with ThreadPoolExecutor(max_workers=max(1, len(peers))) \
+                    as pool:
+                fetched = pool.map(
+                    lambda p: self._peer_get(
+                        f"{p[1]}/federation/trace/{trace_id}"), peers)
+            for got in fetched:
+                for s in (got or {}).get("spans") or []:
+                    if isinstance(s, dict) and s.get("span"):
+                        spans.setdefault(s["span"], s)
+        merged = sorted(spans.values(),
+                        key=lambda s: s.get("t0", 0.0))
+        return Response(200, {"uuid": uuid, "trace_id": trace_id,
+                              "traceparent": traceparent,
+                              "spans": merged,
+                              "tree": obs.assemble_tree(merged)})
+
+    def federation_trace(self, req: Request, trace_id: str) -> Response:
+        """Peer-facing span read: THIS group's spans for one trace id.
+        Deliberately dumb — never fans out — so a get_trace on any
+        group terminates after one hop."""
+        return Response(200, {"trace_id": trace_id,
+                              "spans": obs.tracer.trace(trace_id)})
+
+    def federation_trace_job(self, req: Request, uuid: str) -> Response:
+        """Peer-facing uuid -> trace-id resolution from the LOCAL
+        store only (the get_trace fan-out's discovery half)."""
+        job = self.store.get_job(uuid)
+        ctx = obs.parse_traceparent(job.traceparent) if job else None
         if ctx is None:
-            raise ApiError(404, f"no trace recorded for job {uuid}")
-        spans = obs.tracer.trace(ctx[0])
+            raise ApiError(404, f"job {uuid} unknown or untraced")
         return Response(200, {"uuid": uuid, "trace_id": ctx[0],
                               "traceparent": job.traceparent,
-                              "spans": spans,
-                              "tree": obs.tracer.tree(ctx[0])})
+                              "spans": obs.tracer.trace(ctx[0])})
 
     def get_debug_flight(self, req: Request) -> Response:
         """The coordinator's cycle flight recorder: the most recent
@@ -1494,6 +1666,110 @@ class CookApi:
             limit = 64
         return Response(200, {"tracer": obs.tracer.stats(),
                               "spans": obs.tracer.recent(limit)})
+
+    def get_debug_profile(self, req: Request) -> Response:
+        """The always-on cycle profiler: streaming per-phase stats,
+        critical-path blame shares and the dominant phase per cycle
+        kind.  ``?worst=K`` appends the K worst cycles (full phase
+        ledgers); ``?chrome=K`` returns those cycles as Chrome-trace
+        JSON instead (open in Perfetto / chrome://tracing)."""
+        from cook_tpu.obs import profiler
+
+        def _k(name: str) -> int:
+            try:
+                return max(0, min(256, int(req.qp(name, "0") or 0)))
+            except (TypeError, ValueError):
+                return 0
+
+        chrome_k = _k("chrome")
+        if chrome_k:
+            return Response(200, profiler.chrome_trace(chrome_k))
+        body = profiler.snapshot()
+        worst_k = _k("worst")
+        if worst_k:
+            body["worst"] = profiler.worst(worst_k)
+        return Response(200, body)
+
+    # -- federated health rollup ---------------------------------------
+
+    def _health_local(self) -> dict:
+        """This group's health block: the numbers an operator triages a
+        fleet with, cheap enough to serve on every peer poll.  Status
+        is always "healthy" when this code runs at all — reachability
+        is the caller's judgment (a group that answers is alive; a dark
+        one is marked unreachable by the poller, never by itself)."""
+        from cook_tpu.obs import profiler
+        from cook_tpu.utils.metrics import registry
+        fed = getattr(self, "federation", None)
+        out: dict = {"status": "healthy", "version": VERSION}
+        if fed is not None:
+            fdbg = fed.debug()
+            exchange = fdbg.get("exchange") or {}
+            out.update({
+                "group": fed.group,
+                "epoch": fdbg.get("epoch", 0),
+                "pools": sorted(p for p, e in
+                                (fdbg.get("pools") or {}).items()
+                                if e.get("local")),
+                "exchange": {
+                    g: {"age_s": e.get("age_s"), "stale": e.get("stale")}
+                    for g, e in exchange.items()},
+                "stale_folds": registry.counter(
+                    "federation_stale_folds_total",
+                    group=fed.group).value,
+            })
+        prof = profiler.snapshot()
+        out["decisions_per_s"] = prof.get("decisions_per_s", 0.0)
+        out["profile"] = {
+            kind: {"dominant": ks.get("dominant"),
+                   "blame": {p: b.get("share")
+                             for p, b in (ks.get("blame") or {}).items()}}
+            for kind, ks in (prof.get("kinds") or {}).items()}
+        ovl = getattr(self.coord, "overload", None) \
+            if self.coord is not None else None
+        if ovl is not None:
+            snap = ovl.snapshot()
+            out["overload_level"] = snap.get("level", 0)
+        # store shard lock-wait p99: max across shards, read from the
+        # registry histograms (shard_stats() totals are cumulative
+        # sums, not distributions)
+        p99 = 0.0
+        for key, m in registry.snapshot().items():
+            if key.startswith("store_shard_lock_wait_ms"):
+                p99 = max(p99, float(m.get("p99", 0.0) or 0.0))
+        out["shard_lock_wait_p99_ms"] = round(p99, 3)
+        return out
+
+    def federation_health(self, req: Request) -> Response:
+        """Fleet-wide health rollup: this group's block plus every
+        peer's, fetched concurrently over the machine channel
+        (``?local=1`` — the form peers request — skips the fan-out so
+        polling never recurses).  A dark peer degrades to
+        ``status: "unreachable"``; it never blocks or fails the
+        rollup — that IS the signal the operator is here for."""
+        local = self._health_local()
+        if req.qp("local"):
+            return Response(200, local)
+        fed = getattr(self, "federation", None)
+        peers = fed.peers() if fed is not None else []
+        groups = {local.get("group", "local"): local}
+        if peers:
+            with ThreadPoolExecutor(max_workers=max(1, len(peers))) \
+                    as pool:
+                fetched = pool.map(
+                    lambda p: (p, self._peer_get(
+                        f"{p[1]}/federation/health?local=1")), peers)
+            for (g, url), got in fetched:
+                if got is None:
+                    got = {"group": g, "url": url,
+                           "status": "unreachable"}
+                groups[got.get("group", g)] = got
+        statuses = [e.get("status") for e in groups.values()]
+        return Response(200, {
+            "fleet": {"groups": len(groups),
+                      "healthy": statuses.count("healthy"),
+                      "unreachable": statuses.count("unreachable")},
+            "groups": groups})
 
     # -- data-locality debug endpoints (data_locality.clj debug REST,
     # rest/api.clj data-local routes) ----------------------------------

@@ -383,6 +383,11 @@ def build_scheduler(config, read_only=False):
     # bookkeeping) honors the operator's setting
     from cook_tpu.native import consumefold
     consumefold.set_enabled(s.native_consume)
+    # always-on cycle profiler: another process-wide switch — size the
+    # ring here so /debug/profile serves the configured window from
+    # the first cycle
+    from cook_tpu import obs
+    obs.profiler.configure(ring=config.profile_ring)
     overload = None
     if s.overload_enabled:
         # coordinator-owned shed ladder (scheduler/overload.py); signal
@@ -814,7 +819,15 @@ def main(argv=None) -> None:
                       interval_s=settings.metrics_interval_s).start()
     if settings.spans_jsonl:
         from cook_tpu import obs
-        obs.tracer.add_listener(obs.SpanJsonlExporter(settings.spans_jsonl))
+        obs.tracer.add_listener(obs.SpanJsonlExporter(
+            settings.spans_jsonl, max_mb=settings.spans_jsonl_max_mb))
+    if settings.profile_jsonl:
+        from cook_tpu import obs
+        # profiler entries are plain dicts — the span exporter's
+        # line-per-record JSONL (and its size bound) fits unchanged
+        obs.profiler.add_listener(obs.SpanJsonlExporter(
+            settings.profile_jsonl,
+            max_mb=settings.spans_jsonl_max_mb))
     server = ApiServer(api, port=settings.port).start()
     log.info("cook_tpu scheduler listening on %s (leader=%s)", server.url,
              elector.is_leader() if elector is not None else "api-only")

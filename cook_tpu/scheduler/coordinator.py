@@ -396,6 +396,9 @@ class Coordinator:
                     job_uuid in self.reservations:
                 self.reservations.pop(job_uuid, None)
             if status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
+                self._record_complete_span(
+                    job, task_id, status,
+                    item[2] if len(item) > 2 else None)
                 if self.plugins is not None:
                     inst = self.store.get_instance(task_id)
                     try:
@@ -406,6 +409,25 @@ class Coordinator:
                 if self.forbidden_builder is not None \
                         and job.state == JobState.COMPLETED:
                     self.forbidden_builder.forget(job.uuid)
+
+    @staticmethod
+    def _record_complete_span(job, task_id: str, status,
+                              reason) -> None:
+        """Terminal ``job.complete`` marker closing the job's span
+        tree — shared by the per-item and bulk status channels (the
+        bulk channel used to skip it, leaving traces of daemon-batched
+        completions unclosed)."""
+        if not (job.traceparent and obs.tracer.enabled):
+            return
+        ctx = obs.parse_traceparent(job.traceparent)
+        if ctx is None:
+            return
+        end = obs.now_ms()
+        obs.tracer.record(
+            "job.complete", trace_id=ctx[0], parent_id=ctx[1],
+            start_ms=end, end_ms=end,
+            attrs={"task": task_id, "status": status.name,
+                   "reason": reason})
 
     def _status_entry(self, task_id: str, status, reason=None,
                       **extra) -> None:
@@ -470,20 +492,12 @@ class Coordinator:
         job = self.store.update_instance(
             task_id, status, reason_code=reason, preempted=preempted,
             exit_code=exit_code, sandbox=sandbox, output_url=output_url)
-        if job is not None and job.traceparent and obs.tracer.enabled \
-                and status in (InstanceStatus.SUCCESS,
-                               InstanceStatus.FAILED):
-            ctx = obs.parse_traceparent(job.traceparent)
-            if ctx is not None:
-                # terminal marker closing the job's lifecycle tree (the
-                # agent's launch/run spans arrive separately via the
-                # status-post echo in backends/agent.py)
-                end = obs.now_ms()
-                obs.tracer.record(
-                    "job.complete", trace_id=ctx[0], parent_id=ctx[1],
-                    start_ms=end, end_ms=end,
-                    attrs={"task": task_id, "status": status.name,
-                           "reason": reason})
+        if job is not None and status in (InstanceStatus.SUCCESS,
+                                          InstanceStatus.FAILED):
+            # terminal marker closing the job's lifecycle tree (the
+            # agent's launch/run spans arrive separately via the
+            # status-post echo in backends/agent.py)
+            self._record_complete_span(job, task_id, status, reason)
         # completion plugin (write-status path, scheduler.clj:305-316)
         if self.plugins is not None and job is not None and \
                 status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
@@ -754,7 +768,7 @@ class Coordinator:
 
         pipeline_depth=0 is the classic serial cycle; async pools get
         the same overlap from the depth-2 consume queue instead."""
-        t0 = time.perf_counter()
+        rec = obs.profiler.cycle("match", pool)
         stats = MatchStats()
         self._purge_reservations()
         # periodic drift backstop: LIGHT membership reconcile (no
@@ -773,17 +787,17 @@ class Coordinator:
             # the in-flight drain plus the O(changes) catch-up.
             from cook_tpu.scheduler.resident import _NeedResync
             if rp.rebuild_ready():
-                t_rs = time.perf_counter()
-                self.drain_resident(pool)
-                swapped = False
-                try:
-                    swapped = rp.swap_in_shadow()
-                except _NeedResync as e:
-                    log.info("rebuild swap overflowed (%s); falling "
-                             "back to sync rebuild", e)
-                if not swapped:
-                    rp.resync()
-                swap_ms = (time.perf_counter() - t_rs) * 1e3
+                with rec.phase("resync") as ph:
+                    self.drain_resident(pool)
+                    swapped = False
+                    try:
+                        swapped = rp.swap_in_shadow()
+                    except _NeedResync as e:
+                        log.info("rebuild swap overflowed (%s); falling "
+                                 "back to sync rebuild", e)
+                    if not swapped:
+                        rp.resync()
+                swap_ms = ph.ms
                 self.metrics[f"match.{pool}.resync_ms"] = swap_ms
                 self.metrics[f"match.{pool}.rebuild_build_ms"] = \
                     getattr(rp, "last_build_ms", 0.0)
@@ -794,76 +808,75 @@ class Coordinator:
             reason = None   # handled (or deferred until the build lands)
         if reason is not None:
             from cook_tpu.scheduler.resident import _NeedResync
-            t_rs = time.perf_counter()
-            if reason in ("full", "full-urgent"):
-                self.drain_resident(pool)
-                rp.resync()
-            elif reason == "hosts":
-                # incremental host-set reconcile; full rebuild only
-                # when it reports impossible (slots exhausted, est
-                # lane must activate) or a sparse cap overflows
-                ok = False
-                try:
-                    ok = rp.reconcile_hosts()
-                except _NeedResync as e:
-                    log.info("host reconcile overflowed (%s)", e)
-                if not ok:
-                    reason = "full"
+            with rec.phase("resync") as ph:
+                if reason in ("full", "full-urgent"):
                     self.drain_resident(pool)
                     rp.resync()
-            else:
-                try:
-                    rp.reconcile_membership()
-                    # O(H) offer re-read: live-host attribute relabels
-                    # and port-range reconfigurations don't bump
-                    # offer_generation, so without this probe the light
-                    # rung would leave constraint masks / the
-                    # est-completion lane stale until the next FULL
-                    # rebuild (resync_interval * full_resync_every
-                    # cycles — hours at production cadence)
-                    if not rp.reconcile_hosts():
-                        raise _NeedResync(
-                            "host drift needs capacity growth")
-                except _NeedResync as e:
-                    # backlog outgrew the row slack between full
-                    # rebuilds: fall back to the full rebuild (which
-                    # re-sizes Pcap/Rcap) instead of wedging —
-                    # reconcile's partial mutations are wiped by it
-                    log.info("light resync overflowed (%s); "
-                             "falling back to full rebuild", e)
-                    reason = "full"
-                    self.drain_resident(pool)
-                    rp.resync()
-            self.metrics[f"match.{pool}.resync_ms"] = \
-                (time.perf_counter() - t_rs) * 1e3
+                elif reason == "hosts":
+                    # incremental host-set reconcile; full rebuild only
+                    # when it reports impossible (slots exhausted, est
+                    # lane must activate) or a sparse cap overflows
+                    ok = False
+                    try:
+                        ok = rp.reconcile_hosts()
+                    except _NeedResync as e:
+                        log.info("host reconcile overflowed (%s)", e)
+                    if not ok:
+                        reason = "full"
+                        self.drain_resident(pool)
+                        rp.resync()
+                else:
+                    try:
+                        rp.reconcile_membership()
+                        # O(H) offer re-read: live-host attribute
+                        # relabels and port-range reconfigurations
+                        # don't bump offer_generation, so without this
+                        # probe the light rung would leave constraint
+                        # masks / the est-completion lane stale until
+                        # the next FULL rebuild (resync_interval *
+                        # full_resync_every cycles — hours at
+                        # production cadence)
+                        if not rp.reconcile_hosts():
+                            raise _NeedResync(
+                                "host drift needs capacity growth")
+                    except _NeedResync as e:
+                        # backlog outgrew the row slack between full
+                        # rebuilds: fall back to the full rebuild
+                        # (which re-sizes Pcap/Rcap) instead of
+                        # wedging — reconcile's partial mutations are
+                        # wiped by it
+                        log.info("light resync overflowed (%s); "
+                                 "falling back to full rebuild", e)
+                        reason = "full"
+                        self.drain_resident(pool)
+                        rp.resync()
+            self.metrics[f"match.{pool}.resync_ms"] = ph.ms
             metrics_registry.histogram(
-                "resync_ms", pool=pool, reason=str(reason)).observe(
-                (time.perf_counter() - t_rs) * 1e3)
+                "resync_ms", pool=pool, reason=str(reason)).observe(ph.ms)
         try:
             deltas = rp.drain()
-            t_drain = time.perf_counter()
+            rec.stamp("drain")
             bundle = rp._ship(deltas)
         except Exception as e:
             from cook_tpu.scheduler.resident import _NeedResync
             if isinstance(e, _NeedResync):
                 log.info("resident resync (%s)", e)
-                t_rs = time.perf_counter()
-                self.drain_resident(pool)
-                rp.resync()
                 # record the overflow rebuild like the planned paths
                 # do — otherwise its seconds hide inside drain_ms and
                 # the bench's resync ledger reads clean
-                self.metrics[f"match.{pool}.resync_ms"] = \
-                    (time.perf_counter() - t_rs) * 1e3
+                with rec.phase("resync") as ph:
+                    self.drain_resident(pool)
+                    rp.resync()
+                self.metrics[f"match.{pool}.resync_ms"] = ph.ms
                 metrics_registry.histogram(
                     "resync_ms", pool=pool, reason="overflow").observe(
-                    (time.perf_counter() - t_rs) * 1e3)
+                    ph.ms)
                 deltas = rp.drain()
-                t_drain = time.perf_counter()
+                rec.stamp("drain")
                 bundle = rp._ship(deltas)
             else:
                 raise
-        t_ship = time.perf_counter()
+        rec.stamp("ship")
         qm, qc, qn = quota_arrays(self.quotas, self.interner, pool)
         # per-user launch rate limit folds into the count quota; the
         # global limiter gates the whole cycle (scheduler.clj:627-657)
@@ -890,7 +903,7 @@ class Coordinator:
             sequential=C <= self.config.sequential_match_threshold,
             dru_mode="gpu" if gpu_pool else "default",
             use_pallas=self.config.use_pallas)
-        t_dispatch = time.perf_counter()
+        rec.stamp("dispatch")
         stats.offers = len(rp.host_names)
         if rp.synchronous:
             # double-buffer handoff (pipeline_depth > 0): the cycle just
@@ -900,16 +913,19 @@ class Coordinator:
             # to the classic inline consume (the loop runs once, on
             # `out` itself).
             c_stats = None
-            while len(rp._inflight) > rp.pipeline_depth:
-                cur = rp._inflight[0]
-                try:
-                    c_stats = self._consume_cycle(pool, rp, cur)
-                except Exception:
-                    rp.consumed_through = cur.cycle_no
-                    if rp._inflight and rp._inflight[0] is cur:
-                        rp._inflight.popleft()
-                    rp.request_resync()
-                    raise
+            try:
+                while len(rp._inflight) > rp.pipeline_depth:
+                    cur = rp._inflight[0]
+                    try:
+                        c_stats = self._consume_cycle(pool, rp, cur)
+                    except Exception:
+                        rp.consumed_through = cur.cycle_no
+                        if rp._inflight and rp._inflight[0] is cur:
+                            rp._inflight.popleft()
+                        rp.request_resync()
+                        raise
+            finally:
+                rec.stamp("consume")
             if c_stats is not None:
                 stats.considerable = c_stats["considerable"]
                 stats.matched = c_stats["matched"]
@@ -929,22 +945,20 @@ class Coordinator:
             # producer — a keeping-up consumer pays ~0, so the metric
             # lets the bench (and /debug) separate dispatch work from
             # backpressure in the cycle wall
-            t_q = time.perf_counter()
-            rp._consume_slots.acquire()
-            self._consume_shards.submit(pool, pool, rp, out)
-            self.metrics[f"match.{pool}.queue_wait_ms"] = \
-                (time.perf_counter() - t_q) * 1e3
+            with rec.phase("queue_wait") as ph_q:
+                rp._consume_slots.acquire()
+                self._consume_shards.submit(pool, pool, rp, out)
+            self.metrics[f"match.{pool}.queue_wait_ms"] = ph_q.ms
             last = rp.stats_last
             if last is not None:
                 stats.considerable = last["considerable"]
                 stats.matched = last["matched"]
                 stats.head_matched = last["head_matched"]
-        stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+        stats.cycle_ms = rec.elapsed_ms()
         self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
-        self.metrics[f"match.{pool}.drain_ms"] = (t_drain - t0) * 1e3
-        self.metrics[f"match.{pool}.ship_ms"] = (t_ship - t_drain) * 1e3
-        self.metrics[f"match.{pool}.dispatch_ms"] = \
-            (t_dispatch - t_ship) * 1e3
+        self.metrics[f"match.{pool}.drain_ms"] = rec.ms("drain")
+        self.metrics[f"match.{pool}.ship_ms"] = rec.ms("ship")
+        self.metrics[f"match.{pool}.dispatch_ms"] = rec.ms("dispatch")
         metrics_registry.histogram("match_cycle_ms", pool=pool).observe(
             stats.cycle_ms)
         metrics_registry.counter("match_matched_total", pool=pool).inc(
@@ -953,27 +967,24 @@ class Coordinator:
         if self.overload is not None:
             self.overload.note_cycle_ms(stats.cycle_ms)
         if obs.tracer.enabled:
-            # flight-recorder entry: this cycle with the phase stamps it
-            # already took — the tail segment is the inline consume for
-            # sync pools, the queue handoff wait for the async consumer
-            end, t_now = obs.now_ms(), time.perf_counter()
-            w = lambda t: end - (t_now - t) * 1e3
-            tail = "queue_wait" if not rp.synchronous else "consume"
+            # flight-recorder entry: this cycle with the phase stamps
+            # the profiler record already holds — the tail segment is
+            # the inline consume for sync pools, the queue handoff
+            # wait for the async consumer
             obs.tracer.record_cycle(
-                "cycle.match", w(t0), end,
-                phases=[("drain", w(t0), w(t_drain)),
-                        ("ship", w(t_drain), w(t_ship)),
-                        ("dispatch", w(t_ship), w(t_dispatch)),
-                        (tail, w(t_dispatch), end)],
+                "cycle.match", rec.t0_ms, obs.now_ms(),
+                phases=rec.walls(),
                 attrs={"pool": pool, "cycle": rp.cycle_no,
                        "matched": stats.matched})
+        obs.profiler.commit(rec, cycle=rp.cycle_no,
+                            matched=stats.matched)
         return stats
 
     def _consume_cycle(self, pool: str, rp, out) -> dict:
         """Block on one cycle's compact readback, run the bulk launch
         transaction, hand specs to the backends. Returns cycle stats."""
         import jax
-        t_rb0 = time.perf_counter()
+        rec = obs.profiler.cycle("consume", pool)
         # scalars first: 3 values tell us exactly how much else to pull
         head_matched, n_matched, n_considerable = jax.device_get(
             (out.head_matched, out.n_matched, out.n_considerable))
@@ -1019,8 +1030,8 @@ class Coordinator:
             # decision-overhead bench measures
             why_rows = jax.device_get(
                 (out.why_idx, out.why_code, out.why_amt))
-        t_rb1 = time.perf_counter()
-        self.metrics[f"match.{pool}.readback_ms"] = (t_rb1 - t_rb0) * 1e3
+        pc_rb1 = rec.stamp("readback")
+        self.metrics[f"match.{pool}.readback_ms"] = rec.ms("readback")
         items = []        # (uuid, hostname, cluster_name, task_id)
         item_jobs = []    # (job, ports, credit_snapshot, spec, trace)
         # per-cycle launch plugins run against the compact batch, the
@@ -1095,9 +1106,8 @@ class Coordinator:
         # fold done: matched rows joined against the mirrors, credits
         # queued, provenance recorded — the first of the three consume
         # phases the e2e bench breaks out (fold / frame / bookkeep)
-        t_fold = time.perf_counter()
-        self.metrics[f"match.{pool}.consume_fold_ms"] = \
-            (t_fold - t_rb1) * 1e3
+        rec.stamp("fold")
+        self.metrics[f"match.{pool}.consume_fold_ms"] = rec.ms("fold")
         # policy pass OUTSIDE the mirror lock: a slow launch plugin or
         # port allocator must not block the cycle thread's drain (the
         # same rule _maybe_refresh_locality follows for cost fetches)
@@ -1192,11 +1202,10 @@ class Coordinator:
             with rp.mirror_lock:
                 for uuid, until in deferrals:
                     rp.defer_job_locked(uuid, until)
-        t_loop = time.perf_counter()
+        pc_loop = rec.stamp("frame")
         self.metrics[f"match.{pool}.launch_loop_ms"] = \
-            (t_loop - t_rb1) * 1e3
-        self.metrics[f"match.{pool}.consume_frame_ms"] = \
-            (t_loop - t_fold) * 1e3
+            rec.ms("fold") + rec.ms("frame")
+        self.metrics[f"match.{pool}.consume_frame_ms"] = rec.ms("frame")
         # chaos: a SIGKILL in the consume window — after the device
         # readback fold, before the launch-txn append — must lose no
         # job and launch nothing twice: no instance exists yet, the
@@ -1211,9 +1220,9 @@ class Coordinator:
         insts = self.store.create_instances_bulk(
             items, origin=("resident", pool, out.cycle_no),
             span_id=txn_sid) if items else []
-        t_txn = time.perf_counter()
+        rec.stamp("launch_txn")
         self.metrics[f"match.{pool}.launch_txn_ms"] = \
-            (t_txn - t_loop) * 1e3
+            rec.ms("launch_txn")
         if items:
             metrics_registry.histogram("launch_txn_ms", pool=pool) \
                 .observe(self.metrics[f"match.{pool}.launch_txn_ms"])
@@ -1255,9 +1264,9 @@ class Coordinator:
         # bookkeep done: the post-txn result fold (credits for refused
         # rows, heartbeat tracking, rate-limiter spend) — third consume
         # phase; what follows is the backend hand-off
-        t_book = time.perf_counter()
+        rec.stamp("bookkeep")
         self.metrics[f"match.{pool}.consume_bookkeep_ms"] = \
-            (t_book - t_txn) * 1e3
+            rec.ms("bookkeep")
         launch_q = getattr(rp, "_launch_q", None)
         for cname, specs in by_cluster.items():
             if launch_q is not None:
@@ -1305,9 +1314,11 @@ class Coordinator:
             for ci, cluster in enumerate(clusters):
                 extra = 1 if ci < n_pending % len(clusters) else 0
                 cluster.autoscale(pool, share + extra, pending_sizes=sizes)
+        rec.stamp("backend_launch")
+        # same ledger the pre-profiler code kept: bookkeep rides inside
+        # the reported backend_launch_ms (the whole post-txn tail)
         self.metrics[f"match.{pool}.backend_launch_ms"] = \
-            (time.perf_counter() - t_loop) * 1e3 \
-            - self.metrics[f"match.{pool}.launch_txn_ms"]
+            rec.ms("bookkeep") + rec.ms("backend_launch")
         if by_cluster:
             metrics_registry.histogram("backend_launch_ms", pool=pool) \
                 .observe(self.metrics[f"match.{pool}.backend_launch_ms"])
@@ -1319,36 +1330,33 @@ class Coordinator:
         # the moment the last in-flight entry pops, and readers then
         # iterate consume_trace — an append after the pop would race
         # them (deque mutated during iteration / missing final record)
-        t_end = time.perf_counter()
         with self._trace_lock:
             self.consume_trace.append({
                 "pool": pool, "cycle": out.cycle_no, "matched": launched,
-                "total_ms": (t_end - t_rb0) * 1e3,
-                "readback_ms": (t_rb1 - t_rb0) * 1e3,
-                "loop_ms": (t_loop - t_rb1) * 1e3,
-                "fold_ms": (t_fold - t_rb1) * 1e3,
-                "frame_ms": (t_loop - t_fold) * 1e3,
-                "bookkeep_ms": (t_book - t_txn) * 1e3,
+                "total_ms": rec.elapsed_ms(),
+                "readback_ms": rec.ms("readback"),
+                "loop_ms": rec.ms("fold") + rec.ms("frame"),
+                "fold_ms": rec.ms("fold"),
+                "frame_ms": rec.ms("frame"),
+                "bookkeep_ms": rec.ms("bookkeep"),
                 "txn_ms": self.metrics[f"match.{pool}.launch_txn_ms"],
                 "backend_ms":
                     self.metrics[f"match.{pool}.backend_launch_ms"],
             })
         if obs.tracer.enabled:
             # flight-recorder entry (cycle-level) + per-traced-job span
-            # reconstruction from the stamps this function already took
-            # — no extra clocks, no device work, nothing on the hot
-            # path when tracing is disabled
+            # reconstruction from the profiler record's stamps — no
+            # extra clocks, no device work, nothing on the hot path
+            # when tracing is disabled
             end = obs.now_ms()
-            w = lambda t: end - (t_end - t) * 1e3
             txn_ms = self.metrics[f"match.{pool}.launch_txn_ms"]
-            wall_rb0, wall_rb1, wall_loop = w(t_rb0), w(t_rb1), w(t_loop)
+            wall_rb0 = rec.t0_ms
+            wall_rb1 = rec.wall_ms(pc_rb1)
+            wall_loop = rec.wall_ms(pc_loop)
             wall_txn = wall_loop + txn_ms
             obs.tracer.record_cycle(
                 "cycle.consume", wall_rb0, end,
-                phases=[("readback", wall_rb0, wall_rb1),
-                        ("launch_loop", wall_rb1, wall_loop),
-                        ("launch_txn", wall_loop, wall_txn),
-                        ("backend_launch", wall_txn, end)],
+                phases=rec.walls(),
                 attrs={"pool": pool, "cycle": out.cycle_no,
                        "matched": launched})
             for tid, root_sid, launch_sid, task_id in traced:
@@ -1369,6 +1377,7 @@ class Coordinator:
                 obs.tracer.record("backend_launch", trace_id=tid,
                                   span_id=launch_sid, parent_id=cyc_sid,
                                   start_ms=wall_txn, end_ms=end)
+        obs.profiler.commit(rec, cycle=out.cycle_no, matched=launched)
         rp.consumed_through = out.cycle_no
         if rp._inflight and rp._inflight[0] is out:
             rp._inflight.popleft()
@@ -1404,7 +1413,7 @@ class Coordinator:
             stats = self._match_cycle_resident(pool, rp)
             self._maybe_refreeze(stats.cycle_ms)
             return stats
-        t0 = time.perf_counter()
+        rec = obs.profiler.cycle("match", pool)
         stats = MatchStats()
         self._purge_reservations()
 
@@ -1430,7 +1439,7 @@ class Coordinator:
         pending = self.store.pending_jobs(pool)
         stats.offers = len(offers)
         if not offers or not pending:
-            stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+            stats.cycle_ms = rec.elapsed_ms()
             return stats
 
         # per-user launch rate limit: drop whole users up front
@@ -1447,7 +1456,7 @@ class Coordinator:
             # (pool_mover): it belongs to the destination pool's cycle
             pending = [j for j in pending if j.pool == pool]
         if not pending:
-            stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+            stats.cycle_ms = rec.elapsed_ms()
             return stats
 
         num_considerable = self._num_considerable.get(
@@ -1587,7 +1596,7 @@ class Coordinator:
                                          for p in range(lo, hi + 1)]
         by_cluster: dict[str, list[LaunchSpec]] = {}
         launched = 0
-        t_launch0 = time.perf_counter()
+        pc_launch0 = rec.stamp("tensorize_match")
         traced = []   # (ctx, txn_sid, launch_sid, task_id, t_ci0, t_ci1)
         for idx in np.argsort(queue_rank[:len(pending)]):
             h = job_host[idx]
@@ -1610,7 +1619,7 @@ class Coordinator:
             ctx = obs.parse_traceparent(job.traceparent) \
                 if job.traceparent and obs.tracer.enabled else None
             txn_sid = obs.new_span_id() if ctx is not None else ""
-            t_ci0 = time.perf_counter()
+            t_ci0 = rec.now()
             try:
                 inst = self.store.create_instance(job.uuid, hostname,
                                                   offer_cluster[hostname],
@@ -1622,7 +1631,7 @@ class Coordinator:
                 launch_sid = obs.new_span_id()
                 tp_launch = obs.make_traceparent(ctx[0], launch_sid)
                 traced.append((ctx, txn_sid, launch_sid, inst.task_id,
-                               t_ci0, time.perf_counter()))
+                               t_ci0, rec.now()))
             inst.ports = assigned_ports
             env = dict(job.env)
             for i, p in enumerate(assigned_ports):
@@ -1675,28 +1684,28 @@ class Coordinator:
                 metrics_registry.counter(
                     "cluster_launch_errors_total", pool=pool).inc(errors)
         stats.matched = launched
-        t_launch1 = time.perf_counter()
+        pc_launch1 = rec.stamp("launch")
         if traced:
             # per-traced-job lifecycle spans, reconstructed from the
             # stamps the loop above already took (legacy path: the
             # launch txn is per-job, the backend launch per-cycle)
-            end = obs.now_ms()
-            w = lambda t: end - (t_launch1 - t) * 1e3
+            w = rec.wall_ms
             for ctx, txn_sid, launch_sid, task_id, t_ci0, t_ci1 in traced:
                 cyc_sid = obs.tracer.record(
                     "match.cycle", trace_id=ctx[0], parent_id=ctx[1],
-                    start_ms=w(t0), end_ms=w(t_launch1),
+                    start_ms=rec.t0_ms, end_ms=w(pc_launch1),
                     attrs={"pool": pool, "task": task_id,
                            "path": "legacy"})
                 obs.tracer.record("tensorize_match", trace_id=ctx[0],
-                                  parent_id=cyc_sid, start_ms=w(t0),
-                                  end_ms=w(t_launch0))
+                                  parent_id=cyc_sid, start_ms=rec.t0_ms,
+                                  end_ms=w(pc_launch0))
                 obs.tracer.record("launch_txn", trace_id=ctx[0],
                                   span_id=txn_sid, parent_id=cyc_sid,
                                   start_ms=w(t_ci0), end_ms=w(t_ci1))
                 obs.tracer.record("backend_launch", trace_id=ctx[0],
                                   span_id=launch_sid, parent_id=cyc_sid,
-                                  start_ms=w(t_ci1), end_ms=w(t_launch1))
+                                  start_ms=w(t_ci1),
+                                  end_ms=w(pc_launch1))
 
         # placement-failure bookkeeping for /unscheduled_jobs
         # (record-placement-failures! fenzo_utils.clj:74): structured
@@ -1739,7 +1748,8 @@ class Coordinator:
                               pending_sizes=[(j.mem, j.cpus)
                                              for j in mine[:64]])
 
-        stats.cycle_ms = (time.perf_counter() - t0) * 1e3
+        rec.stamp("bookkeeping")
+        stats.cycle_ms = rec.elapsed_ms()
         self.metrics[f"match.{pool}.cycle_ms"] = stats.cycle_ms
         self.metrics[f"match.{pool}.matched"] = launched
         # registry families — the codahale instrumentation of the
@@ -1753,15 +1763,12 @@ class Coordinator:
         if self.overload is not None:
             self.overload.note_cycle_ms(stats.cycle_ms)
         if obs.tracer.enabled:
-            end, t_now = obs.now_ms(), time.perf_counter()
-            w = lambda t: end - (t_now - t) * 1e3
             obs.tracer.record_cycle(
-                "cycle.match", w(t0), end,
-                phases=[("tensorize_match", w(t0), w(t_launch0)),
-                        ("launch", w(t_launch0), w(t_launch1)),
-                        ("bookkeeping", w(t_launch1), end)],
+                "cycle.match", rec.t0_ms, obs.now_ms(),
+                phases=rec.walls(),
                 attrs={"pool": pool, "matched": launched,
                        "offers": stats.offers})
+        obs.profiler.commit(rec, matched=launched)
         self._maybe_refreeze(stats.cycle_ms)
         return stats
 

@@ -264,6 +264,7 @@ class FederationHost:
                                "t_ms": s.get("t_ms", 0),
                                "age_s": round(age_s, 3),
                                "stale": bool(bound > 0 and age_s > bound)}
+        self._export_exchange_age(exchange)
         return {"group": self.group,
                 "pools": pools,
                 "epoch": self.epoch,
@@ -333,6 +334,26 @@ class FederationHost:
                              group=self.group).inc(len(stale))
         return fresh, stale
 
+    def _export_exchange_age(self, exchange: Optional[dict] = None) \
+            -> None:
+        """Refresh the per-peer ``cook_federation_exchange_age_s``
+        gauge (labeled by the REPORTING group) so dashboards see fold
+        age climbing BEFORE it crosses the staleness bound — the
+        leading indicator for the ``federation_stale_folds_total``
+        counter's step.  Called from the exchange poll loop each round
+        and from debug(), which already computed the ages."""
+        from cook_tpu.utils.metrics import registry
+        if exchange is None:
+            now = time.monotonic()
+            with self._remote_lock:
+                exchange = {
+                    g: {"age_s": round(now - self._remote_rx.get(g, now),
+                                       3)}
+                    for g in self._remote}
+        for g, ent in exchange.items():
+            registry.gauge("federation_exchange_age_s",
+                           group=g).set(ent["age_s"])
+
     def remote_usage(self, user: str, pool: str) -> dict:
         """The user's usage as reported by PEER groups, for the quota
         fold. {} unless global_quota is on (the default keeps the
@@ -378,6 +399,7 @@ class FederationHost:
                     # normal life; the last folded snapshot stands
                     # until its successor reports
                     continue
+            self._export_exchange_age()
 
         def body() -> None:
             while not stop.wait(self.exchange_interval_s):

@@ -1713,7 +1713,8 @@ class JobStore:
     # exactly its post-migration state and state_hash stays a valid
     # restore oracle across the handoff.
     def migrate_pool_out(self, pool: str, fence_owner: str = "",
-                         force: bool = False) -> dict:
+                         force: bool = False,
+                         span_id: str = "") -> dict:
         """Export-and-remove one pool for live migration to another
         leader group. Returns the portable payload: the pool's jobs as
         event-log dicts plus the group specs they reference (a group
@@ -1734,7 +1735,11 @@ class JobStore:
         PoolBusyError — checked HERE (not just at the route) because
         only inside this section is the verdict atomic with the fence;
         launches take the pool shard lock, which the global section
-        excludes."""
+        excludes.
+
+        ``span_id`` (the migration span, one per handoff) rides on the
+        durable "fedmove" record so the export is joinable to the
+        cross-group trace tree — replay ignores it."""
         t_ms = now_ms()
         with self._global_section():
             self._check_writable(pools=(pool,))
@@ -1759,10 +1764,11 @@ class JobStore:
                 # a crash after the fence but before the destination
                 # adopted leaves the payload recoverable from this
                 # log record instead of only in a dead process's memory
-                self._append("fedmove", {"pool": pool,
-                                         "jobs": list(uuids),
-                                         "items": items,
-                                         "groups": groups}, t_ms=t_ms)
+                ev = {"pool": pool, "jobs": list(uuids),
+                      "items": items, "groups": groups}
+                if span_id:
+                    ev["span"] = span_id
+                self._append("fedmove", ev, t_ms=t_ms)
                 # exported-but-not-fsynced window: a crash here replays
                 # the move (or drops the torn tail and keeps the pool)
                 # — either way one store owns every job
@@ -1797,11 +1803,14 @@ class JobStore:
             out.append(job.uuid)
         return out
 
-    def import_pool(self, pool: str, items, groups=()) -> list:
+    def import_pool(self, pool: str, items, groups=(),
+                    span_id: str = "") -> list:
         """Adopt a migrated pool's jobs (the payload migrate_pool_out
         returned on the source). Idempotent per uuid — a retried adopt
         after a lost HTTP response re-delivers the same payload and
-        inserts nothing twice."""
+        inserts nothing twice.  ``span_id`` (the adopt span) rides on
+        the durable "fedadopt" record, mirroring the source side's
+        "fedmove" span stamp."""
         t_ms = now_ms()
         with self._global_section():
             self._check_writable(pools=(pool,))
@@ -1817,8 +1826,10 @@ class JobStore:
                     gspecs.append(gd)
             adopted = self._adopt_pool_state(kept, gspecs)
             if adopted:
-                self._append("fedadopt", {"pool": pool, "items": kept,
-                                          "groups": gspecs}, t_ms=t_ms)
+                ev = {"pool": pool, "items": kept, "groups": gspecs}
+                if span_id:
+                    ev["span"] = span_id
+                self._append("fedadopt", ev, t_ms=t_ms)
                 procfault.kill_point("store.fedadopt")
                 for u in adopted:
                     self._emit("job", {"obj": self.jobs[u]})

@@ -429,6 +429,34 @@ def run_failover_soak(store_root, seed, tag=None, jobs=8, agents=2,
                 pass
 
 
+def _settled_health(url, n_groups, timeout_s=20.0):
+    """GET /federation/health (auth-bypassed) until the rollup settles
+    at every group healthy with zero stale folds, or the timeout
+    passes; returns the last rollup either way — the caller's gate
+    decides."""
+    import urllib.request
+    deadline = time.time() + timeout_s
+    body = {}
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/federation/health",
+                                        timeout=10.0) as r:
+                body = json.loads(r.read().decode())
+        except Exception as e:
+            body = {"error": repr(e)}
+            time.sleep(0.5)
+            continue
+        fleet = body.get("fleet", {})
+        stale = [g for g, e in body.get("groups", {}).items()
+                 if any(x.get("stale")
+                        for x in (e.get("exchange") or {}).values())]
+        if fleet.get("healthy") == n_groups and \
+                fleet.get("unreachable", 1) == 0 and not stale:
+            return body
+        time.sleep(0.5)
+    return body
+
+
 def _admin_post(url, path, body, timeout_s=15.0):
     """Admin-channel POST (header auth, user=admin). Returns
     (status, parsed body); HTTP errors come back as their status +
@@ -730,6 +758,11 @@ def run_fleet_soak(store_root, seed, tag=None, groups=3,
                                    "ep": e.get("ep", 0)})
         stale_info = {g: _fed(servers[g]).get("exchange", {})
                       for g in gnames}
+        # federated health rollup: at soak end (kills recovered,
+        # migration settled) every group must be reachable again and
+        # no exchange fold left flagged stale — retried briefly so a
+        # just-restarted group's first fold has time to land
+        health = _settled_health(urls[gnames[0]], len(gnames))
         evidence = {
             "seed": seed,
             "tag": tag,
@@ -745,6 +778,7 @@ def run_fleet_soak(store_root, seed, tag=None, groups=3,
             "epoch_ledgers": epoch_ledgers,
             "inst_tasks": inst_tasks,
             "exchange": stale_info,
+            "health": health,
             "server_deaths": {g: len(s.sup.deaths)
                               for g, s in servers.items()},
         }

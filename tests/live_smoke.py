@@ -72,6 +72,10 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
         metrics = scrape(stack.server.url + "/metrics").decode()
         trace = json.loads(scrape(stack.server.url + f"/trace/{uuid}"))
         flight = json.loads(scrape(stack.server.url + "/debug/flight"))
+        profile = json.loads(scrape(
+            stack.server.url + "/debug/profile?worst=8"))
+        profile_chrome = json.loads(scrape(
+            stack.server.url + "/debug/profile?chrome=8"))
         decisions = json.loads(scrape(
             stack.server.url + "/debug/decisions"))
         debug = json.loads(scrape(stack.server.url + "/debug"))
@@ -89,6 +93,11 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
                                "decisions.json"), "w") as f:
             json.dump({"unscheduled": unsched, "ring": decisions},
                       f, indent=1)
+        with open(os.path.join(artifact_dir, "profile.json"), "w") as f:
+            json.dump(profile, f, indent=1)
+        with open(os.path.join(artifact_dir,
+                               "profile_chrome.json"), "w") as f:
+            json.dump(profile_chrome, f)
         chrome = obs.to_chrome_trace(trace["spans"] + flight["spans"])
         with open(os.path.join(artifact_dir,
                                "chrome_trace.json"), "w") as f:
@@ -161,6 +170,23 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
             failures.append("/debug/flight has no cycle.match entries")
         if not chrome["traceEvents"]:
             failures.append("chrome trace conversion is empty")
+        # the always-on cycle profiler's operator surface: committed
+        # cycles, per-kind blame with a dominant phase, and the
+        # worst-K ring export that backs the Perfetto artifact
+        if not profile.get("enabled"):
+            failures.append("/debug/profile reports profiler disabled")
+        if profile.get("committed", 0) < 1:
+            failures.append("/debug/profile committed no cycles")
+        if "match" not in profile.get("kinds", {}):
+            failures.append(f"/debug/profile has no match-cycle ledger "
+                            f"({sorted(profile.get('kinds', {}))})")
+        if not any(k.get("dominant")
+                   for k in profile.get("kinds", {}).values()):
+            failures.append("/debug/profile names no dominant phase")
+        if not profile.get("worst"):
+            failures.append("/debug/profile worst-K export is empty")
+        if not profile_chrome.get("traceEvents"):
+            failures.append("/debug/profile chrome export is empty")
 
         for msg in failures:
             print(f"FAIL: {msg}")

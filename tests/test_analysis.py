@@ -1440,3 +1440,144 @@ def test_interproc_super_resolves_to_ancestor_only():
     child = next(k for k in fns if k.endswith("ChildErr.__init__"))
     assert any(any(t.endswith("BaseErr.__init__") for t in cs.targets)
                for cs in fns[child].calls)
+
+
+# ----------------------------------------------------------------------
+# R13: profiler discipline (hot-path stamps + listeners outside locks)
+
+R13_COORD = "cook_tpu/scheduler/coordinator.py"
+
+
+def test_r13_raw_clock_assign_in_hot_path():
+    src = """
+    import time
+
+    class Coordinator:
+        def _consume_cycle(self, pool, rp, out):
+            t0 = time.perf_counter()
+            work()
+            t1 = time.monotonic()
+            return t1 - t0
+    """
+    findings = run(src, rules=("R13",), path=R13_COORD)
+    assert rules_of(findings) == ["R13", "R13"]
+    assert all("rec.stamp" in f.message for f in findings)
+    assert findings[0].symbol == "Coordinator._consume_cycle"
+
+
+def test_r13_only_hot_functions_and_files_in_scope():
+    src = """
+    import time
+
+    def helper():
+        t0 = time.perf_counter()   # not a cycle body: fine
+        return t0
+
+    class Coordinator:
+        def rebalance_cycle(self):
+            t0 = time.monotonic()  # not a hot func: fine
+            return t0
+    """
+    assert run(src, rules=("R13",), path=R13_COORD) == []
+    hot = """
+    import time
+
+    def match_cycle(self):
+        t0 = time.perf_counter()
+        return t0
+    """
+    # same source out of the scoped files is clean
+    assert run(hot, rules=("R13",),
+               path="cook_tpu/scheduler/rebalance.py") == []
+    assert len(run(hot, rules=("R13",), path=R13_COORD)) == 1
+
+
+def test_r13_non_boundary_clock_uses_are_clean():
+    src = """
+    import time
+
+    class Coordinator:
+        def _consume_cycle(self, pool, rp, out):
+            # bookkeeping into a structure, not a phase boundary
+            self.skipped[job.uuid] = time.monotonic()
+            # arithmetic / derived deadline, not a direct clock assign
+            deadline = time.monotonic() + defer_for(job)
+            # the blessed raw accessor for per-item sub-timings
+            pc = rec.now()
+            rec.stamp("fold")
+            return deadline, pc
+    """
+    assert run(src, rules=("R13",), path=R13_COORD) == []
+
+
+def test_r13_notify_inside_lock_in_obs():
+    src = """
+    class Ledger:
+        def commit(self, entry):
+            with self._lock:
+                self._ring.append(entry)
+                for fn in self._listeners:
+                    fn(entry)
+    """
+    findings = run(src, rules=("R13",),
+                   path="cook_tpu/obs/profiler.py")
+    assert rules_of(findings) == ["R13"]
+    assert "outside the lock" in findings[0].message
+    assert findings[0].symbol == "Ledger.commit"
+
+
+def test_r13_notify_outside_lock_is_clean():
+    src = """
+    class Ledger:
+        def commit(self, entry):
+            with self._lock:
+                self._ring.append(entry)
+            for fn in self._listeners:
+                fn(entry)
+
+        def _notify(self, entry):
+            pass
+    """
+    assert run(src, rules=("R13",),
+               path="cook_tpu/obs/profiler.py") == []
+    # lock rule is scoped to obs/ modules: elsewhere this idiom is
+    # other rules' business
+    bad = """
+    class Ledger:
+        def commit(self, entry):
+            with self._lock:
+                self._notify(entry)
+    """
+    assert run(bad, rules=("R13",),
+               path="cook_tpu/scheduler/coordinator.py") == []
+    assert len(run(bad, rules=("R13",),
+                   path="cook_tpu/obs/profiler.py")) == 1
+
+
+def test_r13_suppression():
+    src = """
+    import time
+
+    class Coordinator:
+        def match_cycle(self):
+            t0 = time.perf_counter()  # cookcheck: disable=R13
+            return t0
+    """
+    assert run(src, rules=("R13",), path=R13_COORD) == []
+
+
+def test_r13_real_repo_profiler_is_clean():
+    """The shipped profiler/coordinator must satisfy their own rule
+    with no suppressions or baseline slots."""
+    import cook_tpu
+    root = os.path.dirname(os.path.dirname(cook_tpu.__file__))
+    for rel in ("cook_tpu/obs/profiler.py",
+                "cook_tpu/scheduler/coordinator.py",
+                "cook_tpu/scheduler/resident.py"):
+        fp = os.path.join(root, rel)
+        if not os.path.exists(fp):
+            continue
+        with open(fp, encoding="utf-8") as f:
+            src = f.read()
+        assert analyze_source(src, rel, rules=("R13",),
+                              apply_suppressions=False) == [], rel

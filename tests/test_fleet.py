@@ -92,6 +92,19 @@ def _assert_fleet_gates(r, group_kill=True, migrate=True):
         for u in m["burst_uuids"]:
             assert u in r["jobs"] and r["jobs"][u].status == \
                 "completed", f"[{ctx}] migrated job {u} lost"
+    # federated health rollup at soak end: kills recovered, every
+    # group reachable again, zero stale exchange folds fleet-wide
+    h = r["health"]
+    assert h.get("fleet", {}).get("healthy") == len(r["groups"]) and \
+        h.get("fleet", {}).get("unreachable") == 0, \
+        f"[{ctx}] fleet never settled healthy: {h}"
+    for g, entry in h["groups"].items():
+        assert entry.get("status") == "healthy", \
+            f"[{ctx}] group {g} unhealthy at soak end: {entry}"
+        stale = [p for p, e in (entry.get("exchange") or {}).items()
+                 if e.get("stale")]
+        assert not stale, \
+            f"[{ctx}] group {g} still holds stale folds: {entry}"
 
 
 @pytest.mark.parametrize("seed", [41])
@@ -344,5 +357,189 @@ def test_stale_fold_flagged_not_trusted(tmp_path):
                 pass
         if daemon is not None:
             daemon.stop()
+        for s in servers.values():
+            s.stop()
+
+
+# ---------------------------------------------------------------------
+# observability plane: cross-group trace + federated health rollup
+# ---------------------------------------------------------------------
+
+def _fleet_trio(tmp_path, extra_fed=None):
+    """Three single-member groups with disjoint stores: g0 owns
+    pool-a, g1 pool-b, g2 pool-c; every member's config names all
+    three pools and groups."""
+    names = ("g0", "g1", "g2")
+    ports = {g: free_port() for g in names}
+    urls = {g: f"http://127.0.0.1:{ports[g]}" for g in names}
+    fed_groups = {"g0": {"pools": ["pool-a"], "url": urls["g0"]},
+                  "g1": {"pools": ["pool-b"], "url": urls["g1"]},
+                  "g2": {"pools": ["pool-c"], "url": urls["g2"]}}
+    default = {"g0": "pool-a", "g1": "pool-b", "g2": "pool-c"}
+    servers = {}
+    for g in names:
+        fed = {"group": g, "groups": fed_groups,
+               "exchange_interval_s": 0.2,
+               "global_quota_staleness_s": 1.0}
+        fed.update(extra_fed or {})
+        servers[g] = LiveServer(
+            tmp_path / g, name=g, port=ports[g], max_kills=0,
+            overrides={
+                "default_pool": default[g],
+                "pools": [{"name": "pool-a"}, {"name": "pool-b"},
+                          {"name": "pool-c"}],
+                "auth": {"admins": ["admin"]},
+                "federation": fed,
+            })
+    return servers, urls
+
+
+def test_migration_trace_one_connected_tree(tmp_path):
+    """A job whose pool migrates mid-flight must still read as ONE
+    connected span tree: submit at the source, fed.migrate at the
+    source, fed.adopt + fed.reconcile + completion at the destination
+    — and the trace must be fetchable from a THIRD group that owns
+    neither side (local miss -> peer job resolution -> fleet-wide
+    span merge)."""
+    from cook_tpu.agent.daemon import AgentDaemon
+    servers, urls = _fleet_trio(tmp_path)
+    daemons = []
+    try:
+        for s in servers.values():
+            s.start()
+        # unrelated traffic on pool-b at g1 for the whole handoff
+        traf = AgentDaemon(
+            urls["g1"], hostname="traf-agent", mem=4096.0, cpus=8.0,
+            pool="pool-b", sandbox_root=str(tmp_path / "sbx-b"),
+            heartbeat_interval_s=0.4,
+            agent_token=LiveServer.AGENT_TOKEN)
+        traf.start()
+        daemons.append(traf)
+        g1_cli = JobClient(urls["g1"], user="traffic", timeout=5.0)
+        traffic = [str(uuidlib.uuid4()) for _ in range(3)]
+        for u in traffic:
+            g1_cli.submit(command="sleep 0.2", mem=32.0, cpus=1.0,
+                          uuid=u, pool="pool-b", max_retries=2)
+        # traced jobs pending on pool-a at g0 (no source agent, so
+        # they are pending launches when the migration fires)
+        g0_cli = JobClient(urls["g0"], user="mover", timeout=5.0)
+        uuids = [str(uuidlib.uuid4()) for _ in range(2)]
+        for u in uuids:
+            g0_cli.submit(command="true", mem=32.0, cpus=1.0, uuid=u,
+                          pool="pool-a", max_retries=2)
+        st, resp = _admin_post(urls["g0"], "/federation/migrate",
+                               {"pool": "pool-a", "to": "g1"})
+        assert st == 200 and resp["moved"] == len(uuids), (st, resp)
+        # destination agent appears; the migrated jobs complete at g1
+        mig = AgentDaemon(
+            urls["g1"], hostname="mig-agent", mem=4096.0, cpus=8.0,
+            pool="pool-a", sandbox_root=str(tmp_path / "sbx-a"),
+            heartbeat_interval_s=0.4,
+            agent_token=LiveServer.AGENT_TOKEN)
+        mig.start()
+        daemons.append(mig)
+        g1_admin = JobClient(urls["g1"], user="admin", timeout=5.0)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            got = g1_admin.query_jobs(uuids + traffic)
+            if len(got) == 5 and \
+                    all(j.status == "completed" for j in got):
+                break
+            time.sleep(0.3)
+        got = g1_admin.query_jobs(uuids + traffic)
+        assert all(j.status == "completed" for j in got), \
+            [(j.uuid, j.status) for j in got]
+        # fetch each migrated job's trace from g2 — the group that
+        # owns NOTHING here — exercising peer resolution + merge
+        g2_admin = JobClient(urls["g2"], user="admin", timeout=10.0)
+        for u in uuids:
+            body = g2_admin._request("GET", f"/trace/{u}")
+            spans = body["spans"]
+            names = {sp["name"] for sp in spans}
+            assert {"job.submit", "fed.migrate", "fed.adopt",
+                    "fed.reconcile", "job.complete"} <= names, names
+            # ONE connected tree: every span parents into the set and
+            # assemble_tree finds exactly one root, the submit span
+            ids = {sp["span"] for sp in spans}
+            by_name = {sp["name"]: sp for sp in spans}
+            for sp in spans:
+                assert sp["trace"] == body["trace_id"], sp
+                assert sp["parent"] == "" or sp["parent"] in ids, \
+                    f"orphan span {sp}"
+            assert len(body["tree"]) == 1, \
+                [t["name"] for t in body["tree"]]
+            assert body["tree"][0]["name"] == "job.submit"
+            # the handoff chain parents source -> destination
+            assert by_name["fed.adopt"]["parent"] == \
+                by_name["fed.migrate"]["span"]
+            assert by_name["fed.reconcile"]["parent"] == \
+                by_name["fed.adopt"]["span"]
+            assert by_name["fed.migrate"]["attrs"].get("to") in \
+                ("g1", None)   # attrs may be sampled away; shape only
+    finally:
+        for d in daemons:
+            d.stop()
+        for s in servers.values():
+            s.stop()
+
+
+def test_federation_health_rollup_unreachable_peer(tmp_path):
+    """/federation/health on a 3-group fleet: all healthy first; after
+    SIGSTOPping one group the survivors' rollups degrade it to
+    ``unreachable`` within the poll timeout while every reachable
+    group stays ``healthy`` — the dark peer never blocks the rollup."""
+    servers, urls = _fleet_trio(tmp_path)
+    frozen_pid = None
+
+    def scrape(g):
+        # /federation/health is on the auth bypass list: raw urllib
+        with urllib.request.urlopen(
+                urls[g] + "/federation/health", timeout=15.0) as r:
+            return json.loads(r.read())
+
+    try:
+        for s in servers.values():
+            s.start()
+        deadline = time.time() + 20
+        body = {}
+        while time.time() < deadline:
+            body = scrape("g0")
+            if body["fleet"]["healthy"] == 3:
+                break
+            time.sleep(0.3)
+        assert body["fleet"] == {"groups": 3, "healthy": 3,
+                                 "unreachable": 0}, body
+        assert set(body["groups"]) == {"g0", "g1", "g2"}
+        # the local block carries the triage numbers
+        local = body["groups"]["g0"]
+        for key in ("epoch", "pools", "exchange", "stale_folds",
+                    "decisions_per_s", "profile",
+                    "shard_lock_wait_p99_ms"):
+            assert key in local, f"missing {key}: {local}"
+        assert local["pools"] == ["pool-a"]
+        # freeze g2: survivors must degrade, not block
+        frozen_pid = servers["g2"].sup._proc.pid
+        os.kill(frozen_pid, signal.SIGSTOP)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            body = scrape("g0")
+            if body["fleet"]["unreachable"] == 1:
+                break
+            time.sleep(0.5)
+        assert body["fleet"]["unreachable"] == 1, body
+        assert body["groups"]["g2"] == {
+            "group": "g2", "url": urls["g2"], "status": "unreachable"}
+        for g in ("g0", "g1"):
+            assert body["groups"][g]["status"] == "healthy", body
+        # a second survivor tells the same story
+        b1 = scrape("g1")
+        assert b1["groups"]["g2"]["status"] == "unreachable", b1
+        assert b1["groups"]["g0"]["status"] == "healthy", b1
+    finally:
+        if frozen_pid is not None:
+            try:
+                os.kill(frozen_pid, signal.SIGCONT)
+            except OSError:
+                pass
         for s in servers.values():
             s.stop()
