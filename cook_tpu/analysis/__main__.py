@@ -111,7 +111,7 @@ def main(argv: list[str] | None = None) -> int:
                     "shard-lock discipline (R9), consume discipline "
                     "(R10), whole-program lock order (R11), "
                     "durability-ack dominance (R12), profiler "
-                    "discipline (R13)")
+                    "discipline (R13), membership discipline (R14)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the cook_tpu "
                          "package)")

@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
 ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
-             "R10", "R11", "R12", "R13")
+             "R10", "R11", "R12", "R13", "R14")
 
 # rules that run over the whole scanned file set at once (the
 # interprocedural model), not per-module
@@ -45,6 +45,7 @@ RULE_DIRS = {
     "R9": ("state",),
     "R10": ("state", "backends", "scheduler", "native", "agent"),
     "R13": ("scheduler", "obs"),
+    "R14": ("scheduler", "rest"),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -176,11 +177,12 @@ def diff_baseline(findings: list[Finding], baseline: dict[str, int]
 def analyze_source(source: str, path: str,
                    rules: Iterable[str] = ("R1", "R2", "R3", "R5", "R6",
                                            "R7", "R8", "R9", "R10",
-                                           "R13"),
+                                           "R13", "R14"),
                    apply_suppressions: bool = True) -> list[Finding]:
     """Run the per-module AST rules over one source text."""
     from cook_tpu.analysis import (async_hygiene, consume_discipline,
                                    epoch_discipline, lock_discipline,
+                                   membership_discipline,
                                    metrics_discipline,
                                    profiler_discipline,
                                    retry_discipline, shard_discipline,
@@ -213,6 +215,8 @@ def analyze_source(source: str, path: str,
         findings += consume_discipline.check(mod)
     if "R13" in rules:
         findings += profiler_discipline.check(mod)
+    if "R14" in rules:
+        findings += membership_discipline.check(mod)
     if apply_suppressions:
         sup = collect_suppressions(source)
         findings = [f for f in findings if not suppressed(f, sup)]

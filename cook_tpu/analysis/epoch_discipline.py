@@ -15,6 +15,15 @@ a code path that could commit a superseded leader's record.  (A writer aliased i
 only reachable inside the chokepoints today; the rule is receiver-name
 based and deliberately cheap, the same trade R7 makes.)
 
+The same funnel argument covers the fsync'd sidecar ledgers (the
+epoch ledger and the membership ledger for live reconfiguration):
+their append protocol — record, fsync file, fsync directory, all
+inside the global section — lives in exactly two writers,
+``_mint_epoch_locked`` and ``_append_membership_locked``. R8 also
+flags any other ``os.write`` in the store module: a raw write outside
+those functions is a ledger append that skips the durability order
+or the section lock.
+
 The rule is scoped to the store module: ``_log`` attributes elsewhere
 in the tree are unrelated.
 """
@@ -31,9 +40,19 @@ _CHOKEPOINTS = frozenset(("_append_raw", "_append_raw_many",
 
 _APPENDS = frozenset(("append", "append_many", "append_segments"))
 
+# the only functions allowed to os.write a sidecar ledger — both run
+# in the global section and fsync file-then-directory before returning
+_LEDGER_WRITERS = frozenset(("_mint_epoch_locked",
+                             "_append_membership_locked"))
+
 _MSG = ("direct event-log append bypasses the epoch fence — route "
         "through _append_raw/_append_raw_many/_append_segments (they "
         "run the leadership gate and _fence_stale_epoch first)")
+
+_LEDGER_MSG = ("raw os.write in the store bypasses the ledger append "
+               "protocol — route through _mint_epoch_locked/"
+               "_append_membership_locked (global section + fsync "
+               "file then directory)")
 
 
 def _enclosing_function(parents: dict, node: ast.AST) -> str:
@@ -70,6 +89,13 @@ def check(mod: ModuleInfo) -> list[Finding]:
         if not isinstance(node, ast.Call):
             continue
         func = node.func
+        # raw ledger write: os.write(...) outside the blessed writers
+        if mod.resolve(func) == "os.write":
+            if _enclosing_function(parents, node) not in _LEDGER_WRITERS:
+                findings.append(Finding("R8", mod.path, node.lineno,
+                                        _symbol(parents, node),
+                                        _LEDGER_MSG))
+            continue
         # <recv>._log.append(...) / .append_many(...)
         if not (isinstance(func, ast.Attribute)
                 and func.attr in _APPENDS

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 
 SITE = "agent.churn"
 LEADER_SITE = "leader.churn"
+MEMBERSHIP_SITE = "membership.churn"
 
 KILL = "kill"
 RESTART = "restart"
@@ -51,6 +52,27 @@ LEADER_PARTITION = "leader_partition"
 
 ACTIONS = (KILL, RESTART, FLAP, PARTITION)
 LEADER_ACTIONS = (LEADER_KILL, LEADER_PARTITION)
+
+# membership-tier faults (the reconfiguration soak's schedule): the
+# fleet's TOPOLOGY changes while traffic flows — a group joins (boot +
+# /federation/reload announce), a group leaves (drain every owned pool
+# then retire), a group leaves while its pool still holds pending work
+# ("hot" — the drain's 409/retry window is exercised for real). The
+# _KILL/_STOP variants compound a crash into the change window: the
+# reloading coordinator is SIGKILLed mid-reload (after the membership
+# ledger's begin record — resume must finish the change), SIGKILLed
+# mid-retire-drain (after >=1 pool moved — resume must not re-move
+# it), or the DEPARTING group is SIGSTOP-frozen so the drain has to
+# wait the freeze out.
+MEMBER_JOIN = "member_join"
+MEMBER_LEAVE = "member_leave"
+MEMBER_LEAVE_HOT = "member_leave_hot"
+MEMBER_JOIN_KILL = "member_join_kill"        # SIGKILL mid-reload
+MEMBER_LEAVE_KILL = "member_leave_kill"      # SIGKILL mid-retire-drain
+MEMBER_LEAVE_STOP = "member_leave_stop"      # SIGSTOP departing group
+MEMBERSHIP_ACTIONS = (MEMBER_JOIN, MEMBER_LEAVE, MEMBER_LEAVE_HOT,
+                      MEMBER_JOIN_KILL, MEMBER_LEAVE_KILL,
+                      MEMBER_LEAVE_STOP)
 
 
 @dataclass(frozen=True)
@@ -168,3 +190,59 @@ def generate_leader_churn(seed: int, duration_s: float,
                                  hostname="leader", down_s=down))
     return ChurnSchedule(seed=seed, duration_s=duration_s, events=events,
                          site=LEADER_SITE)
+
+
+def generate_membership_churn(seed: int, duration_s: float,
+                              joins: int = 1, leaves: int = 1,
+                              kill_mid_reload: bool = False,
+                              kill_mid_drain: bool = False,
+                              leave_hot: bool = False,
+                              stop_departing: bool = False,
+                              stop_down_s: tuple = (0.5, 2.0),
+                              min_gap_s: float = 5.0) -> ChurnSchedule:
+    """Deterministic membership-change schedule for the
+    reconfiguration soak: ``joins`` group joins and ``leaves`` group
+    leaves spread over ``duration_s``, joins always scheduled before
+    leaves (a fleet must grow before it can shrink back without going
+    below quorum-of-one-survivor). The flags UPGRADE events in place
+    rather than adding more: ``kill_mid_reload`` turns the last join
+    into a join whose reloading coordinator is SIGKILLed after the
+    ledger's begin record; ``kill_mid_drain`` / ``leave_hot`` /
+    ``stop_departing`` upgrade leave events likewise (at most one
+    upgrade per event, applied in that priority order). The hostname
+    field names the ROLE slot ("join-0", "leave-0", ...) — the
+    harness binds it to a concrete group at fire time — and
+    ``down_s`` is the SIGSTOP freeze for the stop variant. Sorted and
+    gap-enforced like generate_leader_churn, so the whole schedule is
+    a pure function of the inputs."""
+    rng = random.Random(f"{seed}:{MEMBERSHIP_SITE}")
+    n = joins + leaves
+    span = max(duration_s - 0.1 * duration_s, min_gap_s * max(n, 1))
+    slots = sorted(rng.uniform(0.1 * duration_s,
+                               0.1 * duration_s + span)
+                   for _ in range(n))
+    for i in range(1, len(slots)):     # settle gap between changes
+        slots[i] = max(slots[i], slots[i - 1] + min_gap_s)
+    join_actions = [MEMBER_JOIN] * joins
+    if join_actions and kill_mid_reload:
+        join_actions[-1] = MEMBER_JOIN_KILL
+    leave_actions = [MEMBER_LEAVE] * leaves
+    upgrades = []
+    if kill_mid_drain:
+        upgrades.append(MEMBER_LEAVE_KILL)
+    if leave_hot:
+        upgrades.append(MEMBER_LEAVE_HOT)
+    if stop_departing:
+        upgrades.append(MEMBER_LEAVE_STOP)
+    for i, up in enumerate(upgrades[:len(leave_actions)]):
+        leave_actions[len(leave_actions) - 1 - i] = up
+    events: list[ChurnEvent] = []
+    for i, (t, action) in enumerate(
+            zip(slots, join_actions + leave_actions)):
+        role = (f"join-{i}" if i < joins else f"leave-{i - joins}")
+        down = rng.uniform(*stop_down_s) \
+            if action == MEMBER_LEAVE_STOP else 0.0
+        events.append(ChurnEvent(t_s=t, action=action,
+                                 hostname=role, down_s=down))
+    return ChurnSchedule(seed=seed, duration_s=duration_s,
+                         events=events, site=MEMBERSHIP_SITE)

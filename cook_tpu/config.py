@@ -243,6 +243,73 @@ class ChaosSettings:
                 raise ConfigError(f"chaos.sites[{name!r}]: {e}")
 
 
+def validate_federation(fed: dict) -> None:
+    """Validate a ``federation`` config block. Factored out of
+    Settings.validate so POST /federation/reload (and the SIGHUP
+    reload path) can vet a PROPOSED block with exactly the boot-time
+    rules before journaling a membership change — an invalid target
+    view must be rejected before the ledger ever records intent."""
+    if not isinstance(fed, dict):
+        raise ConfigError("federation must be a mapping")
+    groups = fed.get("groups") or {}
+    if not isinstance(groups, dict):
+        raise ConfigError("federation.groups must be a mapping "
+                          "of group name -> spec")
+    group = fed.get("group", "")
+    if groups and (not group or group not in groups):
+        raise ConfigError(
+            f"federation.group {group!r} must name an entry in "
+            "federation.groups")
+    for name, spec in groups.items():
+        if not isinstance(spec, dict):
+            raise ConfigError(
+                f"federation.groups[{name!r}] must be a mapping")
+        unknown = set(spec) - {"pools", "url", "devices"}
+        if unknown:
+            raise ConfigError(
+                f"federation.groups[{name!r}]: unknown keys "
+                f"{sorted(unknown)}")
+        devs = spec.get("devices", [])
+        if not all(isinstance(d, int) and d >= 0 for d in devs):
+            raise ConfigError(
+                f"federation.groups[{name!r}].devices must be "
+                "non-negative device indices")
+    owners: dict = {}
+    for name, spec in groups.items():
+        for p in spec.get("pools", []):
+            if p in owners:
+                raise ConfigError(
+                    f"pool {p!r} claimed by both "
+                    f"{owners[p]!r} and {name!r}")
+            owners[p] = name
+    if float(fed.get("exchange_interval_s", 2.0)) <= 0:
+        raise ConfigError(
+            "federation.exchange_interval_s must be > 0")
+    if float(fed.get("global_quota_staleness_s", 10.0)) < 0:
+        raise ConfigError(
+            "federation.global_quota_staleness_s must be >= 0 "
+            "(0 = never flag folds stale)")
+    rebalance = fed.get("rebalance")
+    if rebalance is not None:
+        if not isinstance(rebalance, dict):
+            raise ConfigError("federation.rebalance must be a mapping")
+        from cook_tpu.scheduler.federation import REBALANCE_DEFAULTS
+        unknown = set(rebalance) - set(REBALANCE_DEFAULTS)
+        if unknown:
+            raise ConfigError(
+                f"federation.rebalance: unknown keys {sorted(unknown)}")
+        for key in ("interval_s", "cooldown_s"):
+            if float(rebalance.get(key,
+                                   REBALANCE_DEFAULTS[key])) <= 0:
+                raise ConfigError(
+                    f"federation.rebalance.{key} must be > 0")
+        if int(rebalance.get("hysteresis_rounds",
+                             REBALANCE_DEFAULTS["hysteresis_rounds"])) \
+                < 1:
+            raise ConfigError(
+                "federation.rebalance.hysteresis_rounds must be >= 1")
+
+
 @dataclass
 class TaskConstraintSettings:
     max_mem_mb: float = 256 * 1024
@@ -430,45 +497,7 @@ class Settings:
             raise ConfigError("ingest_queue_depth and ingest_max_batch "
                               "must be >= 1 when ingest_workers > 0")
         if self.federation:
-            fed = self.federation
-            groups = fed.get("groups") or {}
-            if not isinstance(groups, dict):
-                raise ConfigError("federation.groups must be a mapping "
-                                  "of group name -> spec")
-            group = fed.get("group", "")
-            if groups and (not group or group not in groups):
-                raise ConfigError(
-                    f"federation.group {group!r} must name an entry in "
-                    "federation.groups")
-            for name, spec in groups.items():
-                if not isinstance(spec, dict):
-                    raise ConfigError(
-                        f"federation.groups[{name!r}] must be a mapping")
-                unknown = set(spec) - {"pools", "url", "devices"}
-                if unknown:
-                    raise ConfigError(
-                        f"federation.groups[{name!r}]: unknown keys "
-                        f"{sorted(unknown)}")
-                devs = spec.get("devices", [])
-                if not all(isinstance(d, int) and d >= 0 for d in devs):
-                    raise ConfigError(
-                        f"federation.groups[{name!r}].devices must be "
-                        "non-negative device indices")
-            owners: dict = {}
-            for name, spec in groups.items():
-                for p in spec.get("pools", []):
-                    if p in owners:
-                        raise ConfigError(
-                            f"pool {p!r} claimed by both "
-                            f"{owners[p]!r} and {name!r}")
-                    owners[p] = name
-            if float(fed.get("exchange_interval_s", 2.0)) <= 0:
-                raise ConfigError(
-                    "federation.exchange_interval_s must be > 0")
-            if float(fed.get("global_quota_staleness_s", 10.0)) < 0:
-                raise ConfigError(
-                    "federation.global_quota_staleness_s must be >= 0 "
-                    "(0 = never flag folds stale)")
+            validate_federation(self.federation)
         # a write-capable machine channel must not default open: an
         # agent cluster without an agent token is only a dev setup
         if any(c.kind == "agent" for c in self.clusters) \

@@ -36,6 +36,7 @@ from typing import Any, Callable, Optional
 
 from cook_tpu import __version__ as VERSION
 from cook_tpu import obs
+from cook_tpu.chaos import procfault
 from cook_tpu.rest.auth import (AuthConfig, AuthError, authenticate,
                                 require_authorized)
 from cook_tpu.rest.ingest import IngestQueueFull
@@ -187,11 +188,14 @@ class CookApi:
                 blocked = self._leader_block(agent_channel=True)
                 if blocked is not None:
                     return blocked
-            elif path == "/federation/adopt" and self.auth.agent_token \
+            elif path in ("/federation/adopt", "/federation/migrate",
+                          "/federation/reload") \
+                    and self.auth.agent_token \
                     and self.auth.agent_token_ok(
                         headers.get("x-cook-agent-token", "")):
-                # leader-to-leader machine channel: the migration
-                # source authenticates with the shared fleet token
+                # leader-to-leader machine channel: migration peers,
+                # the fleet rebalancer, and membership-reload
+                # propagation authenticate with the shared fleet token
                 # (same trust domain as the agent channel). An admin
                 # user principal works too — the generic branch below.
                 req.user = "federation-peer"
@@ -333,6 +337,10 @@ class CookApi:
         # the payload to the DESTINATION's adopt endpoint
         r.add("POST", "/federation/migrate", self.migrate_pool)
         r.add("POST", "/federation/adopt", self.adopt_pool)
+        # live fleet reconfiguration: diff a new federation block
+        # against the running view and apply it under a durable
+        # membership epoch (joins announce, leaves drain-then-retire)
+        r.add("POST", "/federation/reload", self.federation_reload)
         # fleet observability plane: health rollup across every leader
         # group + the peer-facing span reads get_trace merges from
         r.add("GET", "/federation/health", self.federation_health)
@@ -384,7 +392,8 @@ class CookApi:
         unscoped mint, payload re-imported, routing restored — so the
         fleet never ends in a state where no group owns the pool."""
         fed = self._fed_or_404()
-        require_authorized(self.auth, req.user, "update", None)
+        if req.user != "federation-peer":
+            require_authorized(self.auth, req.user, "update", None)
         body = req.body or {}
         pool = body.get("pool")
         dest = body.get("to")
@@ -549,6 +558,298 @@ class CookApi:
                             attrs={"pool": pool, "group": fed.group})
         return Response(200, {"pool": pool, "group": fed.group,
                               "adopted": len(adopted)})
+
+    # -- live fleet reconfiguration (tentpole: membership reload) ------
+    #
+    # POST /federation/reload (and SIGHUP, rest/server.py) diffs a new
+    # `federation` block against the running view and applies it under
+    # a MEMBERSHIP EPOCH journaled in the store's membership ledger:
+    # "begin" (full target view — the crash-resume payload) before any
+    # table is touched, "commit"/"abort" after. Joins only announce
+    # (the new group's own boot claims its pools + devices;
+    # place_pools adoption is derived). Leaves drain every owned pool
+    # through the ordinary migrate protocol (409/retry, rollback on
+    # adopt failure lives inside that protocol) and then retire. The
+    # view swap itself is fed._swap_membership — atomic under the
+    # owner lock, so in-flight requests see the old or the new view,
+    # never half of each.
+
+    _RELOAD_DRAIN_TIMEOUT_S = 30.0
+
+    def federation_reload(self, req: Request) -> Response:
+        """Apply a new federation membership view live. Body:
+        ``{"federation": {"groups": {...}}, "propagate": true}`` —
+        ``propagate`` (coordinator form) re-posts the target view to
+        every peer in the old+new union so the whole fleet converges
+        from one POST; propagated copies arrive with it false."""
+        fed = self._fed_or_404()
+        if req.user != "federation-peer":
+            require_authorized(self.auth, req.user, "update", None)
+        body = req.body or {}
+        block = body.get("federation") or body
+        if not isinstance(block, dict) or \
+                not isinstance(block.get("groups"), dict):
+            raise ApiError(400, "federation.groups mapping is required")
+        mepoch, result = self.apply_membership_reload(
+            block, by=req.user or "admin",
+            propagate=bool(body.get("propagate", True)))
+        return Response(200, {"membership_epoch": mepoch,
+                              "group": fed.group, **result})
+
+    def apply_membership_reload(self, block: dict, by: str = "",
+                                propagate: bool = True,
+                                resume_mepoch: int = 0) -> tuple:
+        """The reload core, shared by the REST route, the SIGHUP
+        handler, and crash resume. Returns (membership_epoch, result
+        dict). ``resume_mepoch`` re-drives a journaled begin record
+        instead of allocating a fresh epoch — drains are idempotent
+        (an already-moved pool answers 503 with the new owner's hint,
+        which resume treats as done)."""
+        from cook_tpu.config import ConfigError, validate_federation
+        from cook_tpu.utils.metrics import registry
+        fed = self._fed_or_404()
+        target = {name: dict(spec)
+                  for name, spec in (block.get("groups") or {}).items()}
+        probe = dict(block)
+        probe["groups"] = target
+        # validate the SPEC, not our seat in it: a departing group
+        # receives a target view it is rightly absent from
+        probe["group"] = fed.group if fed.group in target else \
+            next(iter(sorted(target)), fed.group)
+        try:
+            validate_federation(probe)
+        except ConfigError as e:
+            registry.counter("federation_reloads_total",
+                             outcome="invalid", group=fed.group).inc()
+            raise ApiError(400, f"invalid federation block: {e}")
+        joins, leaves = fed.diff_membership(target)
+        changed = bool(joins or leaves) or target != fed.groups
+        if not changed:
+            if resume_mepoch:
+                # crash landed after the swap's effects became moot
+                # (view already matches): close the dangling record
+                self.store.append_membership(
+                    "commit", action="reload", mepoch=resume_mepoch,
+                    owner=by)
+                return resume_mepoch, {"changed": False,
+                                       "resumed": True}
+            registry.counter("federation_reloads_total",
+                             outcome="noop", group=fed.group).inc()
+            return fed.membership_epoch, {"changed": False}
+        mepoch = resume_mepoch or self.store.append_membership(
+            "begin", action="reload", target=target, owner=by)
+        old_groups = {n: dict(s or {}) for n, s in fed.groups.items()}
+        drained: dict = {}
+        try:
+            for g in leaves:
+                if g != fed.group:
+                    drained.update(
+                        self._drain_departing(fed, g, target))
+            if fed.group in leaves:
+                drained.update(
+                    self._drain_departing(fed, fed.group, target))
+        except Exception as e:
+            self.store.append_membership(
+                "abort", action="reload", mepoch=mepoch,
+                owner=by, detail=repr(e)[:512])
+            registry.counter("federation_reloads_total",
+                             outcome="drain_failed",
+                             group=fed.group).inc()
+            raise ApiError(502, f"membership reload {mepoch} aborted: "
+                                f"drain failed: {e}",
+                           {"membership_epoch": mepoch,
+                            "drained": drained, "aborted": True})
+        # a drained pool the target spec left unclaimed would default
+        # back to "local" on every member — claim it for the actual
+        # destination so the swapped view matches where the jobs went
+        # (deterministic: resume recomputes the same destinations)
+        for pool, dest in drained.items():
+            if dest in target and not any(
+                    pool in (s.get("pools") or ()) for s in
+                    target.values()):
+                target[dest].setdefault("pools", []).append(pool)
+        fed._swap_membership(target, mepoch,
+                             note=f"reload by {by or 'admin'}")
+        self.store.append_membership("commit", action="reload",
+                                     mepoch=mepoch, owner=by)
+        registry.counter("federation_reloads_total", outcome="ok",
+                         group=fed.group).inc()
+        result: dict = {"changed": True, "joins": joins,
+                        "leaves": leaves, "drained": drained}
+        if propagate:
+            result["propagated"] = self._propagate_reload(
+                fed, target, old_groups)
+        return mepoch, result
+
+    def _drain_departing(self, fed, group: str, target: dict) -> dict:
+        """Drain every pool a departing group owns through the
+        ordinary migrate protocol, 409-retrying while jobs finish.
+        Remote groups are driven at their own migrate route (the
+        source side owns the drain); our own retirement goes through
+        the local handler. Returns {pool: destination group}. A pool
+        the source no longer owns (503 + owner hint — e.g. a resumed
+        reload re-driving a finished drain) counts as done."""
+        survivors = sorted(n for n in target if n != group)
+        if not survivors:
+            raise RuntimeError(
+                f"cannot retire {group!r}: no surviving group")
+        moved: dict = {}
+        for pool in fed.pools_of(group):
+            claimed = next(
+                (n for n, spec in target.items()
+                 if pool in (spec.get("pools") or ())), None)
+            import zlib
+            dest = claimed or survivors[
+                zlib.crc32(pool.encode()) % len(survivors)]
+            if dest == group:
+                raise RuntimeError(
+                    f"target still claims {pool!r} for departing "
+                    f"group {group!r}")
+            self._drain_one(fed, group, pool, dest)
+            moved[pool] = dest
+            procfault.kill_point("fed.reload_drain")
+        return moved
+
+    def _drain_one(self, fed, group: str, pool: str,
+                   dest: str) -> None:
+        """One pool's drain with the 409 retry loop (RUNNING jobs get
+        their completion window before the export fences the pool)."""
+        import urllib.error
+        import urllib.request
+        local = group == fed.group
+        src_url = "" if local else \
+            (fed.groups.get(group) or {}).get("url", "")
+        if not local and not src_url:
+            raise RuntimeError(f"no url for departing group {group!r}")
+        deadline = time.monotonic() + self._RELOAD_DRAIN_TIMEOUT_S
+        while True:
+            status, out = 0, {}
+            if local:
+                resp = self.migrate_pool(Request(
+                    method="POST", path="/federation/migrate",
+                    query={}, body={"pool": pool, "to": dest},
+                    headers={}, user="federation-peer"))
+                status, out = resp.status, resp.body or {}
+            else:
+                data = json.dumps({"pool": pool, "to": dest}).encode()
+                r = urllib.request.Request(
+                    f"{src_url}/federation/migrate", data=data,
+                    headers={"Content-Type": "application/json",
+                             "X-Cook-Agent-Token":
+                                 self.auth.agent_token or ""},
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(r, timeout=10.0) \
+                            as resp:
+                        status = resp.status
+                        out = json.loads(resp.read().decode())
+                except urllib.error.HTTPError as e:
+                    status = e.code
+                    try:
+                        out = json.loads(e.read().decode())
+                    except Exception:
+                        out = {}
+                except Exception as e:
+                    raise RuntimeError(
+                        f"drain of {pool!r} unreachable at "
+                        f"{group!r}: {e}")
+            if status == 200:
+                return
+            if status == 503:
+                return   # already drained: owner hint names successor
+            if status == 409 and time.monotonic() < deadline:
+                time.sleep(0.5)
+                continue
+            raise RuntimeError(
+                f"drain of {pool!r} from {group!r} failed: "
+                f"{status} {out}")
+
+    def _propagate_reload(self, fed, target: dict,
+                          old_groups: dict) -> dict:
+        """Re-post the committed target view to every peer in the
+        old+new union (departing groups included — they must learn
+        they retired) over the machine channel. ``old_groups`` is the
+        PRE-swap view: by the time this runs ``fed.groups`` is already
+        the target, so departing peers only appear in the old side.
+        Best effort per peer: a dark peer is reported in the result,
+        never fatal — the operator (or the soak) re-posts the reload
+        to it once it returns; the apply is idempotent (a matching
+        view no-ops)."""
+        import urllib.request
+        peers: dict = {}
+        for name, spec in list(old_groups.items()) + \
+                list(target.items()):
+            url = (spec or {}).get("url")
+            if name != fed.group and url:
+                peers.setdefault(name, url)
+        out: dict = {}
+        body = json.dumps({"federation": {"groups": target},
+                           "propagate": False}).encode()
+        for name, url in sorted(peers.items()):
+            try:
+                r = urllib.request.Request(
+                    f"{url}/federation/reload", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Cook-Agent-Token":
+                                 self.auth.agent_token or ""},
+                    method="POST")
+                with urllib.request.urlopen(r, timeout=10.0) as resp:
+                    out[name] = resp.status
+            except Exception as e:
+                out[name] = f"unreachable: {type(e).__name__}"
+        return out
+
+    def resume_membership_reload(self) -> Optional[dict]:
+        """Close out a dangling membership-ledger begin record found
+        at boot (fed.bootstrap_membership): re-drive the journaled
+        target view. Called by the server once the leadership gates
+        open — a coordinator SIGKILLed mid-reload finishes the change
+        (or aborts it durably) instead of wedging the fleet."""
+        fed = getattr(self, "federation", None)
+        if fed is None or not fed.pending_reload:
+            return None
+        rec, fed.pending_reload = fed.pending_reload, None
+        mepoch = int(rec.get("mepoch", 0))
+        target = rec.get("target")
+        if not isinstance(target, dict):
+            self.store.append_membership(
+                "abort", action="reload", mepoch=mepoch,
+                detail="begin record carries no target view")
+            return {"aborted": mepoch}
+        try:
+            mep, result = self.apply_membership_reload(
+                {"groups": target},
+                by=f"resume:{rec.get('owner', '')}",
+                propagate=True, resume_mepoch=mepoch)
+            log.info("resumed membership reload %d: %s", mep, result)
+            return {"resumed": mep, **result}
+        except ApiError as e:     # abort journaled by the apply path
+            log.warning("membership reload %d aborted on resume: %s",
+                        mepoch, e.body)
+            return {"aborted": mepoch}
+
+    def policy_migrate(self, pool: str, src_group: str,
+                       dst_group: str) -> bool:
+        """The FleetRebalancer's migrate_fn: drive one migration at
+        the SOURCE group's migrate route over the machine channel
+        (dest is always this group — the rebalancer only pulls)."""
+        import urllib.request
+        fed = self._fed_or_404()
+        url = (fed.groups.get(src_group) or {}).get("url", "")
+        if not url:
+            return False
+        data = json.dumps({"pool": pool, "to": dst_group}).encode()
+        r = urllib.request.Request(
+            f"{url}/federation/migrate", data=data,
+            headers={"Content-Type": "application/json",
+                     "X-Cook-Agent-Token":
+                         self.auth.agent_token or ""},
+            method="POST")
+        try:
+            with urllib.request.urlopen(r, timeout=10.0) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
 
     def get_openapi(self, req: Request) -> Response:
         """OpenAPI 3.0 description of every served route."""
@@ -1717,6 +2018,18 @@ class CookApi:
                 "stale_folds": registry.counter(
                     "federation_stale_folds_total",
                     group=fed.group).value,
+                # live-reconfiguration evidence: the membership view
+                # the reconfiguration soak asserts survivors agree on,
+                # plus the reload/policy-migration counters the
+                # metrics satellite exports
+                "membership": fdbg.get("membership", {}),
+                "membership_epoch": fed.membership_epoch,
+                "reloads": registry.counter(
+                    "federation_reloads_total", outcome="ok",
+                    group=fed.group).value,
+                "policy_migrations": registry.counter(
+                    "federation_policy_migrations_total",
+                    outcome="ok", group=fed.group).value,
             })
         prof = profiler.snapshot()
         out["decisions_per_s"] = prof.get("decisions_per_s", 0.0)
@@ -1750,6 +2063,15 @@ class CookApi:
         local = self._health_local()
         if req.qp("local"):
             return Response(200, local)
+        return Response(200, self.fleet_health_snapshot(local))
+
+    def fleet_health_snapshot(self, local: Optional[dict] = None) \
+            -> dict:
+        """The full fleet rollup dict — the /federation/health body
+        and the FleetRebalancer's health_fn (the hot/cold score folds
+        exactly what the operator sees)."""
+        if local is None:
+            local = self._health_local()
         fed = getattr(self, "federation", None)
         peers = fed.peers() if fed is not None else []
         groups = {local.get("group", "local"): local}
@@ -1765,11 +2087,11 @@ class CookApi:
                            "status": "unreachable"}
                 groups[got.get("group", g)] = got
         statuses = [e.get("status") for e in groups.values()]
-        return Response(200, {
+        return {
             "fleet": {"groups": len(groups),
                       "healthy": statuses.count("healthy"),
                       "unreachable": statuses.count("unreachable")},
-            "groups": groups})
+            "groups": groups}
 
     # -- data-locality debug endpoints (data_locality.clj debug REST,
     # rest/api.clj data-local routes) ----------------------------------

@@ -549,6 +549,18 @@ def build_scheduler(config, read_only=False):
         settings=config.public(), leader_url=config.url,
         ingest=ingest)
     api.federation = fed
+    # membership ledger replay (live reconfiguration): after a reload,
+    # the <log>.membership ledger is newer truth than the config file
+    # a restarted process just read — apply the last committed view
+    # over the boot view, and park any dangling "begin" record on
+    # fed.pending_reload for the post-takeover resume.
+    fed.bootstrap_membership()
+    # policy rebalancer (default off): folds the fleet health rollup
+    # into hot/cold scores and pulls pools off hot groups through the
+    # ordinary migrate protocol. Built here, started on leadership.
+    fed.configure_rebalance(fcfg.get("rebalance") or {},
+                            health_fn=api.fleet_health_snapshot,
+                            migrate_fn=api.policy_migrate)
     coord.monitor = monitor
     return store, coord, api
 
@@ -585,6 +597,37 @@ def main(argv=None) -> None:
     api.leader_url = settings.leader_hint_url or settings.url
 
     api.leader_ready = threading.Event()
+
+    # SIGHUP = live membership reload: re-read the config file's
+    # federation block and apply it through the same path as POST
+    # /federation/reload. The apply runs off the signal frame — drains
+    # POST to peers and must never run inside a signal handler.
+    def _sighup_reload(signum=None, frame=None):
+        del signum, frame
+
+        def apply():
+            if not args.config:
+                log.warning("SIGHUP reload: no --config file to re-read")
+                return
+            try:
+                fresh = Settings.from_file(args.config)
+                if not fresh.federation:
+                    log.warning(
+                        "SIGHUP reload: config has no federation block")
+                    return
+                mep, result = api.apply_membership_reload(
+                    fresh.federation, by="sighup", propagate=True)
+                log.info("SIGHUP membership reload %d: %s", mep, result)
+            except Exception:
+                log.exception("SIGHUP membership reload failed")
+
+        threading.Thread(target=apply, daemon=True).start()
+
+    import signal
+    try:
+        signal.signal(signal.SIGHUP, _sighup_reload)
+    except (ValueError, OSError, AttributeError):
+        pass   # non-main thread (embedded) or no SIGHUP on platform
 
     def _still_leader():
         elector = getattr(api, "leader_elector", None)
@@ -648,6 +691,32 @@ def main(argv=None) -> None:
             fed.record_takeover(
                 epoch, (time.monotonic() - t_takeover) * 1e3)
             fed.start_exchange()
+
+            def finish_reconfig():
+                # a membership reload the previous incarnation
+                # journaled but never committed is re-driven now that
+                # this leader's gates are open. Deferred until OUR
+                # listener answers: a resumed leave-drain can route an
+                # adopt payload right back at this group, and the
+                # HTTP server only starts serving after this callback
+                # returns (same ordering note as reconcile_thread).
+                import urllib.request
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    try:
+                        with urllib.request.urlopen(
+                                f"{settings.url}/info", timeout=1.0):
+                            break
+                    except Exception:
+                        time.sleep(0.1)
+                try:
+                    api.resume_membership_reload()
+                except Exception:
+                    log.exception("membership reload resume failed")
+                fed.start_rebalancer()
+
+            threading.Thread(target=finish_reconfig,
+                             daemon=True).start()
 
         if agentish and reconcile_s > 0:
             def reconcile_thread():

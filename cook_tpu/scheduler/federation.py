@@ -41,7 +41,9 @@ Config (Settings.federation):
                           "devices": [2]}},
      "exchange_interval_s": 2.0,
      "global_quota": false,
-     "global_quota_staleness_s": 10.0}
+     "global_quota_staleness_s": 10.0,
+     "rebalance": {"enabled": false, "interval_s": 15.0,
+                   "hysteresis_rounds": 2, "cooldown_s": 120.0}}
 
 Fleet-scale additions (N >= 3 groups carrying real traffic):
 
@@ -126,6 +128,19 @@ class FederationHost:
         self._remote_rx: dict[str, float] = {}
         self._remote_lock = threading.Lock()
         self._exchange_stop: Optional[threading.Event] = None
+        # live membership (tentpole: fleet topology as a runtime
+        # object): the membership epoch counts committed reconfigs,
+        # durably journaled in the store's membership ledger; every
+        # view change goes through _swap_membership — the ONE blessed
+        # mutation site for self.groups / self._pool_owner outside
+        # __init__/reassign (pinned by cookcheck R14). pending_reload
+        # holds a dangling ledger "begin" found at boot, for the
+        # server to resume once the leadership gates open.
+        self.membership_epoch: int = 0
+        self.pending_reload: Optional[dict] = None
+        # membership-change evidence ring: [{mepoch, groups, note,...}]
+        self.membership_log: list[dict] = []
+        self.rebalancer: Optional["FleetRebalancer"] = None
 
     @classmethod
     def single(cls, store=None, url: str = "") -> "FederationHost":
@@ -182,6 +197,117 @@ class FederationHost:
                              group=self.group).inc()
         return rec
 
+    def pools_of(self, group: str) -> list[str]:
+        """Pools the named group owns per the CURRENT view (runtime
+        reassignments included) — what the rebalancer and the reload
+        drain loop enumerate."""
+        with self._owner_lock:
+            return sorted(p for p, g in self._pool_owner.items()
+                          if g == group)
+
+    # ------------------------------------------------------------------
+    # live membership (tentpole: config reload under a membership
+    # epoch). The view swap is ATOMIC: groups and the pool->owner map
+    # are replaced together under _owner_lock, so any reader — routing
+    # 503 hints, owns() cycle filtering, peers() for the exchange —
+    # sees exactly the old or the new view, never a half-applied one.
+    def diff_membership(self, target: dict) -> tuple[list, list]:
+        """(joins, leaves) of group names between the current view and
+        a target ``groups`` mapping."""
+        cur = set(self.groups) or {self.group}
+        new = set(target or {}) or {self.group}
+        return sorted(new - cur), sorted(cur - new)
+
+    def membership_view(self) -> dict:
+        """The agreed-membership evidence /federation/health serves:
+        {epoch, groups} — what the reconfiguration soak asserts every
+        survivor converges to."""
+        with self._owner_lock:
+            names = sorted(self.groups) or [self.group]
+        return {"epoch": self.membership_epoch, "groups": names}
+
+    def _swap_membership(self, groups: dict, mepoch: int,
+                         note: str = "") -> dict:
+        """THE blessed membership swap (cookcheck R14 flags any other
+        mutation of the membership tables): atomically replace
+        self.groups and self._pool_owner under _owner_lock and advance
+        the membership epoch. Runtime pool reassignments (live
+        migrations) survive the swap when their owner remains a member
+        of the new view — a reload must not silently undo a migration
+        the fleet already committed; pools owned by a DEPARTED group
+        fall back to the target spec's claim (the reload drain already
+        moved their jobs)."""
+        new_groups = {name: dict(spec)
+                      for name, spec in (groups or {}).items()}
+        base: dict[str, str] = {}
+        for name, spec in new_groups.items():
+            for pool in spec.get("pools", ()):
+                base[pool] = name
+        with self._owner_lock:
+            for pool, owner in self._pool_owner.items():
+                if owner != self.group and owner not in new_groups:
+                    continue   # departed owner: target spec claim wins
+                if pool not in base:
+                    base[pool] = owner   # runtime-only pool, no claim
+                elif owner != base[pool]:
+                    base[pool] = owner   # live migration overlay wins
+            self.groups = new_groups
+            self._pool_owner = base
+            self.membership_epoch = int(mepoch)
+            names = sorted(new_groups) or [self.group]
+        rec = {"mepoch": int(mepoch), "groups": names,
+               "t_ms": int(time.time() * 1e3)}
+        if note:
+            rec["note"] = note
+        self.membership_log.append(rec)
+        del self.membership_log[:-32]
+        from cook_tpu.utils.metrics import registry
+        registry.gauge("federation_membership_epoch",
+                       group=self.group).set(float(mepoch))
+        log.info("federation[%s]: membership epoch %d -> groups %s%s",
+                 self.group, int(mepoch), names,
+                 f" ({note})" if note else "")
+        return rec
+
+    def bootstrap_membership(self) -> Optional[dict]:
+        """Replay the membership ledger at boot: apply the last
+        COMMITTED target view over the config-file view (after a
+        reload, the ledger is newer truth than the config a restarted
+        process read), and return the dangling "begin" record — a
+        reload that journaled intent but never committed/aborted — for
+        the server to resume once leadership gates open. Begins older
+        than a later committed epoch are dead (superseded), not
+        resumable."""
+        if self.store is None:
+            return None
+        records = self.store.membership_records()
+        closed: dict[int, str] = {}
+        for r in records:
+            if r.get("phase") in ("commit", "abort"):
+                closed[int(r.get("mepoch", 0))] = r["phase"]
+        last_committed, dangling = None, None
+        top_closed = max(closed, default=0)
+        top_committed = max(
+            (ep for ep, ph in closed.items() if ph == "commit"),
+            default=0)
+        for r in records:
+            if r.get("phase") != "begin":
+                continue
+            ep = int(r.get("mepoch", 0))
+            if closed.get(ep) == "commit":
+                last_committed = r
+            elif ep not in closed and ep > top_closed:
+                dangling = r
+        if last_committed is not None and \
+                last_committed.get("target") is not None:
+            self._swap_membership(last_committed["target"],
+                                  int(last_committed["mepoch"]),
+                                  note="ledger replay")
+        elif top_committed > self.membership_epoch:
+            self.membership_epoch = top_committed
+        self.pending_reload = dangling
+        return dangling
+
     # ------------------------------------------------------------------
     # pool -> device placement (tentpole: group ownership picks which
     # device a pool's resident cycle runs on)
@@ -227,6 +353,16 @@ class FederationHost:
                          group=self.group).inc()
         registry.histogram("failover_duration_ms",
                            group=self.group).observe(duration_ms)
+        # pre-touch the live-reconfiguration metric families so every
+        # deployment (even one that never reloads) exposes them at
+        # zero — live-smoke gates on their presence
+        registry.gauge("federation_membership_epoch",
+                       group=self.group).set(
+                           float(self.membership_epoch))
+        registry.counter("federation_reloads_total", outcome="ok",
+                         group=self.group).inc(0)
+        registry.counter("federation_policy_migrations_total",
+                         outcome="ok", group=self.group).inc(0)
         self.last_handoff = {"epoch": epoch,
                              "t_ms": int(time.time() * 1e3),
                              "duration_ms": round(duration_ms, 1)}
@@ -265,15 +401,21 @@ class FederationHost:
                                "age_s": round(age_s, 3),
                                "stale": bool(bound > 0 and age_s > bound)}
         self._export_exchange_age(exchange)
-        return {"group": self.group,
-                "pools": pools,
-                "epoch": self.epoch,
-                "transitions": self.transitions,
-                "last_handoff": dict(self.last_handoff),
-                "migrations": [dict(m) for m in self.migrations[-16:]],
-                "exchange": exchange,
-                "global_quota": self.global_quota,
-                "global_quota_staleness_s": bound}
+        out = {"group": self.group,
+               "pools": pools,
+               "epoch": self.epoch,
+               "transitions": self.transitions,
+               "last_handoff": dict(self.last_handoff),
+               "migrations": [dict(m) for m in self.migrations[-16:]],
+               "membership": self.membership_view(),
+               "membership_log": [dict(m)
+                                  for m in self.membership_log[-8:]],
+               "exchange": exchange,
+               "global_quota": self.global_quota,
+               "global_quota_staleness_s": bound}
+        if self.rebalancer is not None:
+            out["rebalance"] = self.rebalancer.debug()
+        return out
 
     # ------------------------------------------------------------------
     # cross-shard usage exchange
@@ -413,6 +555,197 @@ class FederationHost:
         if self._exchange_stop is not None:
             self._exchange_stop.set()
             self._exchange_stop = None
+
+    # ------------------------------------------------------------------
+    # policy-initiated migration (tentpole b): a slow-cadence
+    # rebalancer that folds the /federation/health rollup into a
+    # hot/cold score and drives the PR-18 migration protocol itself
+    def configure_rebalance(self, cfg: Optional[dict] = None,
+                            health_fn=None,
+                            migrate_fn=None) -> "FleetRebalancer":
+        """Build (but do not start) this host's FleetRebalancer.
+        ``health_fn`` returns the fleet health rollup dict;
+        ``migrate_fn(pool, src_group, dst_group)`` drives one
+        migration and returns True on success — both injected by the
+        REST layer so the policy core stays unit-testable without
+        servers."""
+        self.rebalancer = FleetRebalancer(self, cfg, health_fn,
+                                          migrate_fn)
+        return self.rebalancer
+
+    def start_rebalancer(self) -> None:
+        if self.rebalancer is not None:
+            self.rebalancer.start()
+
+    def stop_rebalancer(self) -> None:
+        if self.rebalancer is not None:
+            self.rebalancer.stop()
+
+
+REBALANCE_DEFAULTS = {
+    "enabled": False,          # default OFF: bench.py fleet unchanged
+    "interval_s": 15.0,        # policy cadence (slow by design)
+    "hysteresis_rounds": 2,    # consecutive hot observations required
+    "cooldown_s": 120.0,       # per-pool: no re-move inside this
+    "hot_score": 20.0,         # a peer at/above this is a candidate
+    "cold_score": 5.0,         # only a group at/below this pulls work
+    "unreachable_weight": 100.0,   # dark/frozen peer: maximally hot
+    "overload_weight": 10.0,       # per overload rung
+    "stale_weight": 5.0,           # per stale exchange entry it holds
+    "dps_weight": 10.0,            # scaled by decisions/s over the ref
+    "hot_decisions_per_s": 0.0,    # 0 disables the decision-rate term
+}
+
+
+class FleetRebalancer:
+    """Policy-initiated pool migration: fold each group's health
+    evidence (decisions/s, overload rung, exchange staleness,
+    reachability) into one hot/cold score and, when a peer stays hot
+    across ``hysteresis_rounds`` consecutive polls while THIS group is
+    cold, pull one of its pools here through the ordinary
+    /federation/migrate protocol.
+
+    Every enabled leader runs its own instance and only PULLS work
+    toward itself — no global coordinator. Two cold groups racing for
+    the same hot pool resolve at the source's migrate route (first
+    drain wins; the loser's POST gets the 503 ownership hint). Flap
+    control is layered: hysteresis before acting, a per-pool cooldown
+    after acting, at-most-one-migration-in-flight-per-pool, and at
+    most one migration per tick."""
+
+    def __init__(self, fed: FederationHost, cfg: Optional[dict] = None,
+                 health_fn=None, migrate_fn=None):
+        self.fed = fed
+        merged = dict(REBALANCE_DEFAULTS)
+        merged.update(cfg or {})
+        self.cfg = merged
+        self.health_fn = health_fn
+        self.migrate_fn = migrate_fn
+        self._stop: Optional[threading.Event] = None
+        self._hot_streak: dict[str, int] = {}
+        self._cooldown_until: dict[str, float] = {}
+        self._in_flight: set[str] = set()
+        self.decisions: list[dict] = []   # evidence ring for /debug
+        self.ticks = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.cfg.get("enabled"))
+
+    def score(self, entry) -> float:
+        """One group's hotness from its /federation/health block. An
+        unreachable / non-healthy group scores the unreachable weight
+        — a SIGSTOP-frozen leader can't serve its pools, which is
+        exactly when policy should move them."""
+        if not isinstance(entry, dict) or \
+                entry.get("status") != "healthy":
+            return float(self.cfg["unreachable_weight"])
+        s = float(entry.get("overload_level", 0) or 0) * \
+            float(self.cfg["overload_weight"])
+        stale = sum(1 for e in (entry.get("exchange") or {}).values()
+                    if isinstance(e, dict) and e.get("stale"))
+        s += stale * float(self.cfg["stale_weight"])
+        ref = float(self.cfg["hot_decisions_per_s"] or 0.0)
+        if ref > 0:
+            dps = float(entry.get("decisions_per_s", 0.0) or 0.0)
+            s += (dps / ref) * float(self.cfg["dps_weight"])
+        return s
+
+    def tick(self, rollup: Optional[dict] = None) -> Optional[dict]:
+        """One policy round (tests drive this inline for determinism).
+        Returns the migration decision acted on, else None."""
+        self.ticks += 1
+        if rollup is None and self.health_fn is not None:
+            try:
+                rollup = self.health_fn()
+            except Exception:
+                rollup = None
+        groups = (rollup or {}).get("groups") or {}
+        if not groups:
+            return None
+        scores = {g: self.score(e) for g, e in groups.items()}
+        me = self.fed.group
+        # hysteresis ledger first, so a hot spell is tracked even on
+        # rounds where we ourselves are too busy to act.
+        # _hot_streak/_in_flight are confined to this loop thread —
+        # debug() only reads the decisions ring.
+        for g, s in scores.items():
+            if g != me and s >= float(self.cfg["hot_score"]):
+                self._hot_streak[g] = self._hot_streak.get(g, 0) + 1  # cookcheck: disable=R2
+            else:
+                self._hot_streak.pop(g, None)
+        if scores.get(me, 0.0) > float(self.cfg["cold_score"]):
+            return None   # only a cold group pulls work toward itself
+        ripe = sorted(((s, g) for g, s in scores.items()
+                       if g != me and self._hot_streak.get(g, 0) >=
+                       int(self.cfg["hysteresis_rounds"])),
+                      reverse=True)
+        if not ripe:
+            return None
+        _, victim = ripe[0]
+        now = time.monotonic()
+        pool = next(
+            (p for p in self.fed.pools_of(victim)
+             if p not in self._in_flight and
+             now >= self._cooldown_until.get(p, 0.0)), None)
+        if pool is None:
+            return None
+        decision = {"pool": pool, "from": victim, "to": me,
+                    "score": round(scores[victim], 2),
+                    "t_ms": int(time.time() * 1e3)}
+        from cook_tpu.utils.metrics import registry
+        self._in_flight.add(pool)  # cookcheck: disable=R2
+        try:
+            ok = bool(self.migrate_fn(pool, victim, me)) \
+                if self.migrate_fn else False
+        except Exception as e:
+            log.warning("rebalance[%s]: migrate %s from %s failed: %s",
+                        me, pool, victim, e)
+            ok = False
+        finally:
+            self._in_flight.discard(pool)
+        # cooldown regardless of outcome: a failing source (frozen
+        # leader) must not be hammered every tick
+        self._cooldown_until[pool] = now + float(self.cfg["cooldown_s"])
+        self._hot_streak.pop(victim, None)   # re-observe from scratch
+        decision["outcome"] = "ok" if ok else "failed"
+        registry.counter("federation_policy_migrations_total",
+                         outcome=decision["outcome"],
+                         group=me).inc()
+        self.decisions.append(decision)
+        del self.decisions[:-32]
+        log.info("rebalance[%s]: %s %s <- %s (score %.1f)", me,
+                 decision["outcome"], pool, victim, scores[victim])
+        return decision
+
+    def start(self) -> None:
+        if not self.enabled or self._stop is not None:
+            return
+        stop = self._stop = threading.Event()
+
+        def body() -> None:
+            while not stop.wait(float(self.cfg["interval_s"])):
+                try:
+                    self.tick()
+                except Exception:
+                    log.exception("rebalance[%s]: tick failed",
+                                  self.fed.group)
+
+        threading.Thread(target=body, daemon=True,
+                         name=f"fed-rebalance-{self.fed.group}").start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+            self._stop = None
+
+    def debug(self) -> dict:
+        return {"enabled": self.enabled,
+                "interval_s": float(self.cfg["interval_s"]),
+                "ticks": self.ticks,
+                "hot_streak": dict(self._hot_streak),
+                "in_flight": sorted(self._in_flight),
+                "decisions": [dict(d) for d in self.decisions[-8:]]}
 
 
 class FederatedQuotaView(QuotaStore):

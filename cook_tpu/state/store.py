@@ -430,6 +430,16 @@ class JobStore:
         # flowing at the old epoch. Kept out of _epoch_ledger_max so a
         # pool-scoped mint never fences the whole source store.
         self._epoch_pool_fences: dict = {}
+        # durable membership ledger (<log>.membership, append-only
+        # JSONL beside the epoch ledger): live fleet reconfiguration
+        # appends a "begin" record carrying the full target view (the
+        # crash-resume payload) before applying a membership change,
+        # and a "commit"/"abort" record after — each fsync'd file+dir
+        # through _append_membership_locked, the one blessed writer
+        # (pinned by cookcheck R8). Logless stores keep the records in
+        # _membership_mem so the federation layer behaves identically
+        # without a log.
+        self._membership_mem: list = []
         self._log_path = log_path
         self._log = log_writer
         if log_path and log_writer is None:
@@ -1702,6 +1712,80 @@ class JobStore:
                 self._epoch_ledger_max = new
         if not pools:
             self.epoch = new
+        return new
+
+    # ------------------------------------------------------------------
+    # membership ledger (live fleet reconfiguration): the durable
+    # intent/commit journal for membership-epoch changes. A reload
+    # appends {"phase": "begin", "target": <full groups view>} BEFORE
+    # touching any routing table, so a coordinator SIGKILLed mid-reload
+    # resumes (or aborts) from the ledger on restart instead of wedging
+    # the fleet; "commit"/"abort" close the record. Same fsync
+    # discipline as the epoch ledger: file then directory, before the
+    # append returns.
+    @property
+    def _membership_ledger_path(self) -> Optional[str]:
+        return f"{self._log_path}.membership" if self._log_path else None
+
+    def membership_records(self) -> list:
+        """Every durable membership-ledger record, oldest first (the
+        in-memory tail for logless stores)."""
+        path = self._membership_ledger_path
+        if path:
+            return _read_membership_ledger(path)
+        return list(self._membership_mem)
+
+    def append_membership(self, phase: str, action: str = "",
+                          target=None, owner: str = "",
+                          mepoch: int = 0, detail: str = "") -> int:
+        """Append one fsync'd membership-epoch record and return its
+        membership epoch. ``phase`` is "begin" (allocates the next
+        epoch: max over the ledger + 1), or "commit"/"abort" (pass the
+        begin's ``mepoch`` through). ``target`` on a begin record is
+        the FULL target groups view — not a diff — so resume never
+        needs the crashed coordinator's memory. Runs in the global
+        section for the same reason mint_epoch does: a membership swap
+        must not interleave with an in-flight epoch mint's ledger
+        stat-cache update."""
+        with self._global_section():
+            new = self._append_membership_locked(
+                phase, action, target, owner, mepoch, detail)
+        procfault.kill_point("store.membership")
+        return new
+
+    def _append_membership_locked(self, phase: str, action: str = "",
+                                  target=None, owner: str = "",
+                                  mepoch: int = 0,
+                                  detail: str = "") -> int:
+        """Membership append body, caller holds the global section.
+        The one blessed membership-ledger writer (cookcheck R8)."""
+        path = self._membership_ledger_path
+        prior = (_read_membership_ledger(path) if path
+                 else list(self._membership_mem))
+        top = max((int(r.get("mepoch", 0)) for r in prior), default=0)
+        new = int(mepoch) if mepoch else top + 1
+        body: dict = {"mepoch": new, "phase": phase, "t": now_ms()}
+        if action:
+            body["action"] = action
+        if owner:
+            body["owner"] = owner
+        if detail:
+            body["detail"] = detail
+        if target is not None:
+            body["target"] = target
+        if not path:
+            self._membership_mem.append(body)
+            return new
+        rec = json.dumps(body, separators=(",", ":"))
+        fd = os.open(path,
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, (rec + "\n").encode("utf-8"))
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
         return new
 
     # ------------------------------------------------------------------
@@ -3020,6 +3104,30 @@ def _read_epoch_fences(path: str) -> tuple:
     except OSError:
         return 0, {}
     return top, fences
+
+
+def _read_membership_ledger(path: str) -> list:
+    """All membership records in the ledger, oldest first. A torn
+    final line (crash mid-append) skips — a begin that never fsync'd
+    never promised anyone a new view, so a restarted coordinator
+    simply sees the previous membership. Same torn-line contract as
+    _read_epoch_ledger."""
+    out: list = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("mepoch"):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
 
 
 def _fsync_dir(path: str) -> None:

@@ -47,11 +47,16 @@ import uuid as uuidlib
 
 from cook_tpu.agent.daemon import AgentDaemon
 from cook_tpu.chaos.churn import (LEADER_KILL, LEADER_PARTITION,
-                                  generate_leader_churn)
+                                  MEMBER_JOIN, MEMBER_JOIN_KILL,
+                                  MEMBER_LEAVE, MEMBER_LEAVE_HOT,
+                                  MEMBER_LEAVE_KILL, MEMBER_LEAVE_STOP,
+                                  generate_leader_churn,
+                                  generate_membership_churn)
 from cook_tpu.client import JobClient
 from cook_tpu.sim.gen import generate_trace
 from cook_tpu.state.model import Job, new_uuid
-from cook_tpu.state.store import JobStore, StaleEpochError
+from cook_tpu.state.store import (JobStore, StaleEpochError,
+                                  _read_membership_ledger)
 from tests.livestack import LiveServer
 
 READY_BOUND_S = 25.0
@@ -797,23 +802,416 @@ def run_fleet_soak(store_root, seed, tag=None, groups=3,
                 pass
 
 
-def _dump_fleet_artifacts(tag, servers, evidence):
+def run_reconfig_soak(store_root, seed, tag=None, groups=3,
+                      jobs_per_wave=2, window_s=12.0, wall_s=120.0,
+                      joins=1, leaves=1, kill_mid_reload=False,
+                      kill_mid_drain=False, leave_hot=False,
+                      stop_departing=False, hot_burst=3):
+    """Live-reconfiguration soak: the fleet's TOPOLOGY changes while
+    traffic flows. A seeded ``generate_membership_churn`` schedule is
+    executed against a real N-group fleet (one LiveServer per group,
+    disjoint stores, ``g0`` is the fixed reload coordinator):
+
+      - ``member_join``: a new group boots with the full TARGET view
+        in its config, then one ``POST /federation/reload`` at the
+        coordinator announces it fleet-wide (propagate). Jobs are then
+        submitted into its pool through the fleet client.
+      - ``member_leave[_hot]``: the target view drops a group; the
+        coordinator drains every pool it owns through the ordinary
+        migrate protocol into a target-spec claim on a survivor (an
+        agent for the moving pool is registered at the destination
+        first — capacity travels ahead of the handoff). ``_hot``
+        burst-submits into the departing pool right before the reload
+        so the drain's 409/retry window is exercised for real. Once
+        every survivor's membership view converges the departed server
+        is stopped — retirement — after its terminal job statuses are
+        snapshotted (completed history legitimately stays in the
+        departed store; the zero-lost gate folds the snapshot in).
+      - ``member_join_kill`` / ``member_leave_kill``: the coordinator
+        is armed (``store.membership`` / ``fed.reload_drain`` kill
+        points) and SIGKILLs itself mid-reload / mid-retire-drain; the
+        supervisor respawns it and boot replay + resume finish the
+        journaled change — the harness only waits for convergence.
+      - ``member_leave_stop``: the DEPARTING group is SIGSTOP-frozen
+        for ``down_s`` right before the reload, so the coordinator's
+        drain has to wait the freeze out (409/connect stalls retried).
+
+    Collects evidence, asserts nothing (tests/test_reconfig.py and the
+    CI fleet-smoke job own the gates)."""
+    from tests.livestack import free_port
+    tag = tag or f"reconfig{seed}"
+    violations: list[str] = []
+    launch_counts: dict[str, int] = {}
+    transitions: list[dict] = []
+    departed_statuses: dict[str, str] = {}
+    schedule = generate_membership_churn(
+        seed, duration_s=window_s, joins=joins, leaves=leaves,
+        kill_mid_reload=kill_mid_reload, kill_mid_drain=kill_mid_drain,
+        leave_hot=leave_hot, stop_departing=stop_departing)
+
+    gnames = [f"g{i}" for i in range(groups)]
+    jnames = [f"j{i}" for i in range(joins)]
+    pools = {g: f"pool-{g}" for g in gnames + jnames}
+    ports = {g: free_port() for g in gnames + jnames}
+    urls = {g: f"http://127.0.0.1:{ports[g]}" for g in gnames + jnames}
+    # every pool (join slots included) known everywhere from boot: a
+    # pool adopted mid-soak must 503-hint at non-owners, not 400
+    all_pools = [{"name": p} for p in pools.values()]
+    view = {g: {"pools": [pools[g]], "url": urls[g]} for g in gnames}
+    coord = gnames[0]     # fixed coordinator; never departs
+    sites = {}
+    if kill_mid_reload:
+        sites["store.membership"] = 1.0
+    if kill_mid_drain:
+        sites["fed.reload_drain"] = 1.0
+
+    def _mk_server(g, groups_view, armed=False):
+        overrides = {
+            "default_pool": pools[g],
+            "pools": all_pools,
+            "auth": {"admins": ["admin"]},
+            "federation": {"group": g, "groups": groups_view,
+                           "exchange_interval_s": 0.5,
+                           "global_quota_staleness_s": 5.0},
+        }
+        return LiveServer(os.path.join(str(store_root), g), name=g,
+                          port=ports[g], seed=seed,
+                          sites=sites if armed else None,
+                          max_kills=(len(sites) if armed else 0),
+                          overrides=overrides)
+
+    servers: dict[str, LiveServer] = {
+        g: _mk_server(g, view, armed=(g == coord)) for g in gnames}
+    live: list[str] = list(gnames)
+
+    def _fed(g):
+        try:
+            return servers[g].debug().get("federation", {})
+        except Exception:
+            return {}
+
+    def _wait_epoch(g, min_epoch=1, timeout_s=READY_BOUND_S):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if _fed(g).get("epoch", 0) >= min_epoch:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def _wait_converged(target, skip_epoch=(), timeout_s=READY_BOUND_S):
+        """Every live group's membership view must settle at the
+        target's group SET (membership epochs are per-group ledgers —
+        each member journals its own apply — so the set, not the
+        number, is the convergence object). ``skip_epoch`` exempts
+        groups whose view legitimately never changed (a joiner boots
+        with the target view already, so the propagated reload no-ops
+        there) from the journaled-epoch requirement."""
+        want = set(target)
+        deadline = time.monotonic() + timeout_s
+        views = {}
+        while time.monotonic() < deadline:
+            views = {g: (_fed(g).get("membership") or {})
+                     for g in live}
+            if all(set(v.get("groups") or {}) == want and
+                   (g in skip_epoch or v.get("epoch", 0) >= 1)
+                   for g, v in views.items()):
+                return True, views
+            time.sleep(0.2)
+        return False, views
+
+    daemons: list[AgentDaemon] = []
+
+    def make_daemon(g, host, pool=None):
+        d = AgentDaemon(urls[g], hostname=host, mem=4096.0, cpus=8.0,
+                        pool=pool or pools[g],
+                        sandbox_root=os.path.join(
+                            str(store_root), g, f"sbx-{host}",
+                            str(time.monotonic_ns())),
+                        heartbeat_interval_s=0.4,
+                        agent_token=LiveServer.AGENT_TOKEN)
+        orig = d.executor.launch
+
+        def counted(task_id, *a, _orig=orig, **kw):
+            launch_counts[task_id] = launch_counts.get(task_id, 0) + 1
+            return _orig(task_id, *a, **kw)
+
+        d.executor.launch = counted
+        d.start()
+        daemons.append(d)
+        return d
+
+    clients: dict[str, JobClient] = {}
+    admin_clients = {g: JobClient(urls[g], user="admin", timeout=5.0)
+                     for g in gnames + jnames}
+    uuids: list[tuple] = []
+
+    def _find_job(u):
+        for g in live:
+            try:
+                got = admin_clients[g].query_jobs([u])
+            except Exception:
+                continue
+            if got:
+                return got[0]
+        return None
+
+    def submit_with_retry(user, pool):
+        cli = clients.setdefault(user, JobClient(
+            ",".join(urls[g] for g in live), user=user, timeout=5.0))
+        u = str(uuidlib.uuid4())
+        for _ in range(SUBMIT_RETRIES):
+            try:
+                cli.submit(command="sleep 0.3", mem=64.0, cpus=1.0,
+                           uuid=u, pool=pool, max_retries=4)
+                break
+            except Exception:
+                if _find_job(u) is not None:
+                    break
+                time.sleep(0.5)
+        else:
+            violations.append(f"submit of {u} (pool {pool}) never "
+                              "landed")
+        uuids.append((u, user, pool))
+
+    def _wave(note):
+        # traffic flows across every membership change: one job per
+        # live pool, routed through the fleet client (post-change
+        # clients are rebuilt so the URL set tracks the live view)
+        clients.clear()
+        for g in list(live):
+            submit_with_retry(f"wave-{note}", pools[g])
+
+    def _reload(target, expect_kill=False):
+        """POST the target view at the coordinator; on an armed kill
+        the socket dies mid-request — respawn the coordinator and let
+        boot replay + resume finish the journaled change."""
+        status, resp = 0, {}
+        try:
+            status, resp = _admin_post(
+                urls[coord], "/federation/reload",
+                {"federation": {"groups": target}, "propagate": True},
+                timeout_s=60.0)
+        except Exception as e:
+            resp = {"error": repr(e)}
+        if expect_kill:
+            dd = time.monotonic() + 5.0
+            while servers[coord].sup.alive() and time.monotonic() < dd:
+                time.sleep(0.02)
+            if servers[coord].sup.alive():
+                violations.append(
+                    "armed coordinator survived the reload kill point")
+            try:
+                servers[coord].ensure_alive(READY_BOUND_S)
+            except Exception as e:
+                violations.append(
+                    f"killed coordinator failed to respawn: {e}")
+        elif status != 200:
+            violations.append(
+                f"reload to {sorted(target)} failed: {status} {resp}")
+        return status, resp
+
+    def do_join(ev, slot):
+        g = jnames[slot]
+        target = {**{k: dict(v) for k, v in view.items()},
+                  g: {"pools": [pools[g]], "url": urls[g]}}
+        servers[g] = _mk_server(g, target)
+        servers[g].start()
+        if not _wait_epoch(g):
+            violations.append(f"joining group {g} never minted")
+        make_daemon(g, f"{tag}-{g}-a0")
+        status, resp = _reload(
+            target, expect_kill=(ev.action == MEMBER_JOIN_KILL))
+        live.append(g)
+        view.clear()
+        view.update(target)
+        ok, views = _wait_converged(target, skip_epoch={g})
+        if not ok:
+            violations.append(
+                f"fleet never converged on join of {g}: "
+                f"{ {k: sorted(v.get('groups') or {}) for k, v in views.items()} }")
+        _wave(f"join-{g}")
+        transitions.append({"action": ev.action, "group": g,
+                            "status": status,
+                            "resp": {k: v for k, v in (resp or {}).items()
+                                     if k != "propagated"},
+                            "converged": ok,
+                            "deaths": len(servers[coord].sup.deaths)})
+
+    def do_leave(ev):
+        # newest non-coordinator member departs (shrink undoes growth)
+        g = next(x for x in reversed(live) if x != coord)
+        dest = next(x for x in live if x != g and x != coord) \
+            if len(live) > 2 else coord
+        target = {k: dict(v) for k, v in view.items() if k != g}
+        # target-spec claim: the departing pool is assigned to a named
+        # survivor, and capacity is registered there BEFORE the drain
+        target[dest]["pools"] = sorted(
+            set(target[dest].get("pools") or []) | {pools[g]})
+        make_daemon(dest, f"{tag}-{dest}-adopt-{g}", pool=pools[g])
+        if ev.action == MEMBER_LEAVE_HOT:
+            for _ in range(hot_burst):
+                submit_with_retry("hot", pools[g])
+        frozen_pid = None
+        if ev.action == MEMBER_LEAVE_STOP:
+            frozen_pid = servers[g].sup._proc.pid
+            os.kill(frozen_pid, signal.SIGSTOP)
+            threading.Timer(max(ev.down_s, 0.2), os.kill,
+                            args=(frozen_pid, signal.SIGCONT)).start()
+        status, resp = _reload(
+            target, expect_kill=(ev.action == MEMBER_LEAVE_KILL))
+        view.clear()
+        view.update({k: dict(v) for k, v in target.items()})
+        ok, views = _wait_converged(target,
+                                    timeout_s=READY_BOUND_S * 2)
+        if not ok:
+            violations.append(
+                f"fleet never converged on leave of {g}: "
+                f"{ {k: sorted(v.get('groups') or {}) for k, v in views.items()} }")
+        # retire: completed history stays in the departed store — take
+        # its terminal snapshot before stopping it so the zero-lost
+        # gate can account for jobs that finished there pre-drain
+        if frozen_pid is not None:
+            try:
+                os.kill(frozen_pid, signal.SIGCONT)
+            except OSError:
+                pass
+        pool_uuids = [u for u, _, p in uuids if p == pools[g]]
+        snap: dict = {}
+        deadline = time.monotonic() + READY_BOUND_S
+        while pool_uuids and time.monotonic() < deadline:
+            try:
+                got = admin_clients[g].query_jobs(pool_uuids)
+            except Exception:
+                got = []
+            snap = {j.uuid: j.status for j in got}
+            # a uuid ABSENT here was exported by the drain and will be
+            # found live at the destination — only jobs that stayed
+            # must have reached terminal state before retirement
+            if all(snap.get(u, "completed") == "completed"
+                   for u in pool_uuids):
+                break
+            time.sleep(0.3)
+        departed_statuses.update(
+            {u: s for u, s in snap.items() if s == "completed"})
+        live.remove(g)
+        servers[g].stop()
+        _wave(f"leave-{g}")
+        transitions.append({"action": ev.action, "group": g,
+                            "dest": dest, "status": status,
+                            "resp": {k: v for k, v in (resp or {}).items()
+                                     if k != "propagated"},
+                            "converged": ok, "snapshot": len(snap),
+                            "deaths": len(servers[coord].sup.deaths)})
+
+    jobs_final: dict = {}
+    try:
+        for g in gnames:
+            servers[g].start()
+        for g in gnames:
+            if not _wait_epoch(g):
+                violations.append(f"group {g} never minted an epoch")
+            make_daemon(g, f"{tag}-{g}-a0")
+        _wave("boot")
+        join_slot = 0
+        for ev in schedule.events:
+            time.sleep(0.5)   # settle gap (schedule t_s is the
+            # ordering artifact; the soak compresses the clock)
+            if ev.action in (MEMBER_JOIN, MEMBER_JOIN_KILL):
+                do_join(ev, join_slot)
+                join_slot += 1
+            else:
+                do_leave(ev)
+        _wave("final")
+
+        # ---- completeness: every submission completes SOMEWHERE ----
+        # (a live group, or — terminal-snapshotted — a retired one)
+        deadline = time.time() + wall_s
+        while time.time() < deadline:
+            done = {}
+            for u, _user, _pool in uuids:
+                if departed_statuses.get(u) == "completed":
+                    done[u] = "completed"
+                    continue
+                j = _find_job(u)
+                if j is not None:
+                    done[u] = j.status
+            jobs_final = done
+            if len(done) == len(uuids) and all(
+                    s == "completed" for s in done.values()):
+                break
+            time.sleep(0.5)
+
+        epoch_ledgers, membership_ledgers, inst_tasks = {}, {}, []
+        for g in gnames + jnames[:join_slot]:
+            glog = os.path.join(str(store_root), g, "events.log")
+            epoch_ledgers[g] = [r.get("epoch", 0) for r in
+                                _read_epoch_ledger(glog + ".epoch")]
+            membership_ledgers[g] = _read_membership_ledger(
+                glog + ".membership")
+            for e in _scan_inst_events(glog):
+                inst_tasks.append({"group": g, "task": e.get("task"),
+                                   "ep": e.get("ep", 0)})
+        health = _settled_health(urls[live[0]], len(live))
+        mviews = {g: (_fed(g).get("membership") or {}) for g in live}
+        evidence = {
+            "seed": seed,
+            "tag": tag,
+            "schedule": [e.as_dict() for e in schedule.events],
+            "groups": list(gnames), "joined": jnames[:join_slot],
+            "live": list(live), "pools": pools, "urls": urls,
+            "violations": violations,
+            "jobs": jobs_final,
+            "expected_jobs": len(uuids),
+            "departed_statuses": departed_statuses,
+            "launch_counts": dict(launch_counts),
+            "transitions": transitions,
+            "epoch_ledgers": epoch_ledgers,
+            "membership_ledgers": membership_ledgers,
+            "membership_views": mviews,
+            "inst_tasks": inst_tasks,
+            "health": health,
+            "server_deaths": {g: len(s.sup.deaths)
+                              for g, s in servers.items()},
+        }
+        _dump_fleet_artifacts(tag, servers, evidence,
+                              prefix="reconfig", schedule=schedule)
+        return evidence
+    finally:
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception:
+                pass
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def _dump_fleet_artifacts(tag, servers, evidence, prefix="fleet",
+                          schedule=None):
     out = os.environ.get("CHAOS_ARTIFACTS_DIR")
     if not out:
         return
     os.makedirs(out, exist_ok=True)
+    if schedule is not None:
+        schedule.save(os.path.join(out, f"{prefix}-{tag}-churn.jsonl"))
     for name, s in servers.items():
         if os.path.exists(s.server_log):
             shutil.copy(s.server_log,
-                        os.path.join(out, f"fleet-{tag}-server-{name}.log"))
-        ep = os.path.join(s.store_dir, "events.log.epoch")
-        if os.path.exists(ep):
-            shutil.copy(ep, os.path.join(
-                out, f"fleet-{tag}-epoch-{name}.jsonl"))
+                        os.path.join(out, f"{prefix}-{tag}-server-{name}.log"))
+        for suffix, kind in ((".epoch", "epoch"),
+                             (".membership", "membership")):
+            led = os.path.join(s.store_dir, "events.log" + suffix)
+            if os.path.exists(led):
+                shutil.copy(led, os.path.join(
+                    out, f"{prefix}-{tag}-{kind}-{name}.jsonl"))
     slim = {k: v for k, v in evidence.items() if k != "jobs"}
-    slim["job_statuses"] = {u: j.status
-                           for u, j in evidence["jobs"].items()}
-    with open(os.path.join(out, f"fleet-{tag}-evidence.json"),
+    slim["job_statuses"] = {
+        u: (j if isinstance(j, str) else j.status)
+        for u, j in evidence["jobs"].items()}
+    with open(os.path.join(out, f"{prefix}-{tag}-evidence.json"),
               "w") as f:
         json.dump(slim, f, indent=1)
 

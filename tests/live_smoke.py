@@ -127,6 +127,24 @@ def main(artifact_dir: str = "smoke-artifacts") -> int:
             failures.append("/metrics missing leader transition counter")
         if "cook_failover_duration_ms" not in metrics:
             failures.append("/metrics missing failover duration histogram")
+        # live reconfiguration's operator surface: the membership
+        # epoch gauge and the reload / policy-migration counters are
+        # pre-touched at takeover so they scrape at zero even before
+        # any reload ever runs
+        mlines = metrics.splitlines()
+        if "cook_federation_membership_epoch" not in metrics:
+            failures.append("/metrics missing membership epoch gauge")
+        if not any(l.startswith("cook_federation_reloads_total{") and
+                   'outcome="ok"' in l for l in mlines):
+            failures.append("/metrics missing federation reload counter")
+        if not any(l.startswith(
+                "cook_federation_policy_migrations_total{") and
+                'outcome="ok"' in l for l in mlines):
+            failures.append("/metrics missing policy migration counter")
+        if not isinstance(fed.get("membership"), dict) or \
+                "epoch" not in fed.get("membership", {}):
+            failures.append(
+                f"/debug federation has no membership view ({fed})")
         codes = [r.get("code") for r in unsched[0]["reasons"]]
         if "no_host_fit" not in codes:
             failures.append(

@@ -718,6 +718,100 @@ def test_r8_append_segments_is_fenced_chokepoint():
     assert run(src, rules=("R8",), path=_STORE_PATH) == []
 
 
+def test_r8_raw_ledger_write_outside_blessed_writers_flagged():
+    # os.write in the store is a sidecar-ledger append; outside the two
+    # fsync'd writers it skips the durability order / global section
+    fs = run("""
+        import os
+        class JobStore:
+            def sneaky_ledger(self, fd, rec):
+                os.write(fd, rec)
+    """, rules=("R8",), path=_STORE_PATH)
+    assert rules_of(fs) == ["R8"]
+    assert "ledger append protocol" in fs[0].message
+    src = """
+        import os
+        class JobStore:
+            def _mint_epoch_locked(self, fd, rec):
+                os.write(fd, rec)
+
+            def _append_membership_locked(self, fd, rec):
+                os.write(fd, rec)
+    """
+    assert run(src, rules=("R8",), path=_STORE_PATH) == []
+    # os.write in other modules is not a ledger append
+    assert run("""
+        import os
+        def flush(fd, b):
+            os.write(fd, b)
+    """, rules=("R8",), path="cook_tpu/state/other.py") == []
+
+
+# ----------------------------------------------------------------------
+# R14 membership discipline (federation groups/_pool_owner funnel)
+
+_FED_PATH = "cook_tpu/scheduler/federation.py"
+
+
+def test_r14_mutation_outside_blessed_swap_flagged():
+    fs = run("""
+        class FederationHost:
+            def rogue(self, pool, g):
+                self._pool_owner[pool] = g
+                self.groups = dict(g)
+                self._pool_owner.update({pool: g})
+                del self._pool_owner[pool]
+    """, rules=("R14",), path=_FED_PATH)
+    assert rules_of(fs) == ["R14"] * 4
+    assert all("blessed swap" in f.message for f in fs)
+
+
+def test_r14_blessed_sites_and_reads_are_clean():
+    src = """
+        class FederationHost:
+            def __init__(self, groups):
+                self.groups = groups
+                self._pool_owner = {}
+
+            def reassign(self, pool, g):
+                with self._owner_lock:
+                    self._pool_owner[pool] = g
+
+            def _swap_membership(self, groups, owner):
+                with self._owner_lock:
+                    self.groups = groups
+                    self._pool_owner = owner
+
+            def _owner_of(self, pool):
+                return self._pool_owner.get(pool, self.group)
+
+            def membership_view(self):
+                return {"groups": sorted(self.groups)}
+    """
+    assert run(src, rules=("R14",), path=_FED_PATH) == []
+
+
+def test_r14_pool_owner_write_from_other_module_flagged():
+    # other scheduler/rest modules may read the routing view, never
+    # write it; plain `groups` names elsewhere are not chased
+    fs = run("""
+        def hijack(fed, pool, g):
+            fed._pool_owner[pool] = g
+            fed.groups = {}
+    """, rules=("R14",), path="cook_tpu/rest/api.py")
+    assert rules_of(fs) == ["R14"]
+    assert fs[0].line == 3
+
+
+def test_r14_suppression():
+    fs = run("""
+        class FederationHost:
+            def recover(self, pool, g):
+                self._pool_owner[pool] = g  # cookcheck: disable=R14
+    """, rules=("R14",), path=_FED_PATH)
+    assert fs == []
+
+
 # ----------------------------------------------------------------------
 # R9 shard-lock discipline (state/store.py section helpers)
 

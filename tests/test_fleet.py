@@ -230,6 +230,91 @@ def test_live_migration_pending_jobs(tmp_path):
             s.stop()
 
 
+def test_migration_adopt_failure_rolls_back(tmp_path):
+    """Deterministic regression for the adopt-failure rollback seam:
+    the destination group's URL points at a dead port, so the source
+    completes its half (drain, atomic export + pool-scoped fence,
+    routing flip) and then every adopt POST fails. The route must
+    answer 502 with ``rolled_back: true`` and leave the fleet exactly
+    where it started: an UNSCOPED mint lifts the pool fence, the
+    payload re-imports, routing flips back — the source serves the
+    pool again and every exported job survives to completion."""
+    from cook_tpu.agent.daemon import AgentDaemon
+    servers, urls = _fleet_pair(tmp_path)
+    daemon = None
+    launch_counts: dict = {}
+    try:
+        servers["g0"].start()   # g1 never starts: its port is dead
+        cli = JobClient(urls["g0"], user="mover", timeout=5.0)
+        uuids = [str(uuidlib.uuid4()) for _ in range(3)]
+        for u in uuids:
+            cli.submit(command="sleep 0.1", mem=32.0, cpus=1.0,
+                       uuid=u, pool="pool-a", max_retries=2)
+        st, resp = _admin_post(urls["g0"], "/federation/migrate",
+                               {"pool": "pool-a", "to": "g1"},
+                               timeout_s=30.0)
+        assert st == 502 and resp.get("rolled_back") is True, (st, resp)
+        # routing restored: the source accepts pool-a submissions
+        # again (no 503 ownership hint pointing at the dead group)
+        st2, resp2 = _admin_post(
+            urls["g0"], "/jobs",
+            {"jobs": [{"uuid": str(uuidlib.uuid4()),
+                       "command": "true", "mem": 1.0, "cpus": 0.1}],
+             "pool": "pool-a"})
+        assert st2 in (200, 201), (st2, resp2)
+        # the exported jobs were re-imported, none lost
+        g0 = JobClient(urls["g0"], user="admin", timeout=5.0)
+        assert len(g0.query_jobs(uuids)) == len(uuids)
+        # durable evidence of the seam: the pool-scoped fence mint,
+        # then a LATER unscoped fedmove-rollback mint that lifts it
+        ledger = []
+        with open(os.path.join(servers["g0"].store_dir,
+                               "events.log.epoch")) as f:
+            for line in f:
+                if line.strip():
+                    ledger.append(json.loads(line))
+        fences = [r for r in ledger
+                  if r.get("owner", "").startswith("fedmove:g0->g1")]
+        lifts = [r for r in ledger
+                 if r.get("owner", "").startswith(
+                     "fedmove-rollback:pool-a")]
+        assert fences and fences[-1].get("pools") == ["pool-a"], ledger
+        assert lifts and "pools" not in lifts[-1], ledger
+        assert lifts[-1]["epoch"] > fences[-1]["epoch"], ledger
+        # the pool is live post-rollback: an agent drains the jobs,
+        # each launched exactly once (the fence lift really happened —
+        # a still-fenced pool would refuse the launch transactions)
+        daemon = AgentDaemon(
+            urls["g0"], hostname="rollback-agent", mem=4096.0,
+            cpus=8.0, pool="pool-a", sandbox_root=str(tmp_path / "sbx"),
+            heartbeat_interval_s=0.4,
+            agent_token=LiveServer.AGENT_TOKEN)
+        orig = daemon.executor.launch
+
+        def counted(task_id, *a, **kw):
+            launch_counts[task_id] = launch_counts.get(task_id, 0) + 1
+            return orig(task_id, *a, **kw)
+
+        daemon.executor.launch = counted
+        daemon.start()
+        deadline = time.time() + 60
+        got = []
+        while time.time() < deadline:
+            got = g0.query_jobs(uuids)
+            if all(j.status == "completed" for j in got):
+                break
+            time.sleep(0.3)
+        assert all(j.status == "completed" for j in got), \
+            [(j.uuid, j.status) for j in got]
+        doubled = {t: n for t, n in launch_counts.items() if n > 1}
+        assert not doubled, f"double launch after rollback: {doubled}"
+    finally:
+        if daemon is not None:
+            daemon.stop()
+        for s in servers.values():
+            s.stop()
+
+
 def test_migration_refused_while_running(tmp_path):
     """The RUNNING guard: with an agent attached and a long job
     running, /federation/migrate answers 409 (listing the uuids) and

@@ -1,0 +1,437 @@
+"""Live fleet reconfiguration gates (tests.fedsoak.run_reconfig_soak
+plus the deterministic membership/rebalancer units).
+
+The fleet soak (test_fleet) proves a FIXED topology survives kills and
+migrations; this tier proves the topology itself is a live,
+crash-recoverable runtime object:
+
+  - membership reload: one ``POST /federation/reload`` at a
+    coordinator grows a 3-group fleet to 4 and shrinks it back, with
+    traffic flowing — zero lost jobs, at-most-once launch across
+    membership epochs, every survivor's membership view converging on
+    the target group set;
+  - crash-recoverable: the membership ledger's begin/commit journal
+    means a coordinator SIGKILLed mid-reload (after the begin append)
+    or mid-retire-drain (after >=1 pool moved) finishes the change on
+    respawn — boot replay parks the dangling begin, resume re-drives
+    it idempotently (an already-moved pool answers 503 = done);
+  - policy rebalancing: each enabled leader pulls one pool from a
+    peer that stays hot across the hysteresis window while it itself
+    is cold — and the layered flap control (hysteresis, per-pool
+    cooldown, at-most-one-in-flight) keeps the pool from bouncing
+    back.
+"""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cook_tpu.chaos.churn import (MEMBER_JOIN, MEMBER_JOIN_KILL,
+                                  MEMBER_LEAVE, MEMBER_LEAVE_HOT,
+                                  MEMBER_LEAVE_KILL, MEMBER_LEAVE_STOP,
+                                  generate_membership_churn)
+from cook_tpu.config import ConfigError, validate_federation
+from cook_tpu.scheduler.federation import (FederationHost,
+                                           FleetRebalancer,
+                                           REBALANCE_DEFAULTS)
+from cook_tpu.state.store import JobStore
+from tests.fedsoak import run_reconfig_soak
+
+
+# ---------------------------------------------------------------------
+# shared evidence gates
+# ---------------------------------------------------------------------
+
+def _assert_reconfig_gates(r, expect_deaths=0):
+    ctx = f"seed={r['seed']} tag={r['tag']}"
+    assert not r["violations"], \
+        f"[{ctx}] in-flight violations: {r['violations']}"
+    # zero lost jobs across every membership change: completed at a
+    # live group, or terminal-snapshotted at a retired one
+    assert len(r["jobs"]) == r["expected_jobs"], \
+        f"[{ctx}] lost jobs: {len(r['jobs'])}/{r['expected_jobs']}"
+    stuck = {u: s for u, s in r["jobs"].items() if s != "completed"}
+    assert not stuck, f"[{ctx}] jobs stuck: {stuck}"
+    # at-most-once launch across groups AND membership epochs
+    doubled = {t: n for t, n in r["launch_counts"].items() if n > 1}
+    assert not doubled, f"[{ctx}] double-launched: {doubled}"
+    seen: dict = {}
+    for rec in r["inst_tasks"]:
+        assert rec["ep"] >= 1, \
+            f"[{ctx}] unstamped instance record: {rec}"
+        seen[rec["task"]] = seen.get(rec["task"], 0) + 1
+    dup = {t: n for t, n in seen.items() if n > 1}
+    assert not dup, \
+        f"[{ctx}] task ids duplicated across group logs: {dup}"
+    # every transition applied and converged
+    assert len(r["transitions"]) == len(r["schedule"]), \
+        f"[{ctx}] schedule not fully executed: {r['transitions']}"
+    for t in r["transitions"]:
+        assert t["converged"], f"[{ctx}] never converged: {t}"
+    # membership ledgers: per-group strictly increasing begin epochs,
+    # every begin closed by a commit/abort (no dangling intent left)
+    for g, recs in r["membership_ledgers"].items():
+        begins = [x["mepoch"] for x in recs if x["phase"] == "begin"]
+        closed = {x["mepoch"] for x in recs
+                  if x["phase"] in ("commit", "abort")}
+        assert begins == sorted(set(begins)), \
+            f"[{ctx}] group {g} begin epochs not increasing: {begins}"
+        open_ = [ep for ep in begins if ep not in closed]
+        assert not open_, \
+            f"[{ctx}] group {g} left dangling begins: {open_}"
+    # survivors agree on the final group set
+    want = set(r["live"])
+    for g, v in r["membership_views"].items():
+        assert set(v.get("groups") or {}) == want, \
+            f"[{ctx}] group {g} view diverged: {v} != {sorted(want)}"
+    # federated health rollup settled over the final membership
+    h = r["health"]
+    assert h.get("fleet", {}).get("healthy") == len(r["live"]) and \
+        h.get("fleet", {}).get("unreachable") == 0, \
+        f"[{ctx}] fleet never settled healthy: {h.get('fleet')}"
+    deaths = sum(r["server_deaths"].values())
+    assert deaths >= expect_deaths, \
+        f"[{ctx}] expected >= {expect_deaths} coordinator deaths, " \
+        f"saw {r['server_deaths']}"
+
+
+# ---------------------------------------------------------------------
+# live reconfiguration soaks
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [11])
+def test_reconfig_soak_quick(tmp_path, seed):
+    """Quick tier (the CI fleet-smoke schedule): a 3-group fleet grows
+    to 4 by reload, then shrinks back by a leave whose coordinator is
+    SIGKILLed mid-retire-drain — respawn + ledger resume finish the
+    change."""
+    r = run_reconfig_soak(tmp_path / "reconfig", seed, groups=3,
+                          joins=1, leaves=1, kill_mid_drain=True)
+    actions = [e["action"] for e in r["schedule"]]
+    assert actions == [MEMBER_JOIN, MEMBER_LEAVE_KILL], actions
+    _assert_reconfig_gates(r, expect_deaths=1)
+
+
+@pytest.mark.parametrize("seed", [13])
+def test_reconfig_kill_mid_reload(tmp_path, seed):
+    """The reloading coordinator dies at the membership ledger's
+    begin append (before any swap): the journaled intent is the only
+    copy of the change, and resume completes the join from it."""
+    r = run_reconfig_soak(tmp_path / "reconfig", seed, groups=2,
+                          joins=1, leaves=0, kill_mid_reload=True)
+    assert [e["action"] for e in r["schedule"]] == [MEMBER_JOIN_KILL]
+    _assert_reconfig_gates(r, expect_deaths=1)
+    # the coordinator's ledger shows the crash seam: begin journaled
+    # by the admin POST, commit journaled by the resume path
+    recs = r["membership_ledgers"]["g0"]
+    owners = {x["phase"]: x.get("owner", "") for x in recs}
+    assert owners.get("commit", "").startswith("resume:"), recs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 29])
+def test_reconfig_soak_full_magnitude(tmp_path, seed):
+    """Nightly tier: grow by one, then shrink twice — one hot leave
+    (drain races pending work through the 409/retry window) and one
+    SIGSTOP-frozen departing group the drain must wait out."""
+    r = run_reconfig_soak(tmp_path / "reconfig", seed, groups=3,
+                          joins=1, leaves=2, leave_hot=True,
+                          stop_departing=True, window_s=20.0,
+                          wall_s=180.0, hot_burst=5)
+    acts = [e["action"] for e in r["schedule"]]
+    assert acts == [MEMBER_JOIN, MEMBER_LEAVE_STOP, MEMBER_LEAVE_HOT], \
+        acts
+    _assert_reconfig_gates(r, expect_deaths=0)
+
+
+# ---------------------------------------------------------------------
+# policy rebalancing, live: a SIGSTOP-throttled hot group loses a pool
+# ---------------------------------------------------------------------
+
+def test_rebalancer_live_pulls_from_throttled_group(tmp_path):
+    """Two live groups; ``cold``'s rebalancer is enabled at a fast
+    cadence and ``hot`` is duty-cycle SIGSTOP-throttled (mostly
+    frozen, briefly runnable — its exchange goes stale and its health
+    probe times out, but migrate POSTs land in the CONT windows). The
+    policy must move a pool off ``hot`` within a few cadences, and the
+    pool must NOT flap back (cooldown + the healthy group never scores
+    hot)."""
+    from tests.fedsoak import _admin_post
+    from tests.livestack import LiveServer, free_port
+    ports = {g: free_port() for g in ("cold", "hot")}
+    urls = {g: f"http://127.0.0.1:{ports[g]}" for g in ports}
+    fed_groups = {g: {"pools": [f"pool-{g}"], "url": urls[g]}
+                  for g in ports}
+    pools = [{"name": f"pool-{g}"} for g in ports]
+    servers = {}
+    for g in ports:
+        overrides = {
+            "default_pool": f"pool-{g}",
+            "pools": pools,
+            "auth": {"admins": ["admin"]},
+            "federation": {
+                "group": g, "groups": fed_groups,
+                "exchange_interval_s": 0.3,
+                # generous staleness bound: the PULLER's own stale
+                # folds must not push its score past cold_score while
+                # the peer is frozen — hotness comes from the peer's
+                # probe timing out, not from local staleness
+                "global_quota_staleness_s": 5.0,
+                "rebalance": {
+                    "enabled": g == "cold", "interval_s": 0.5,
+                    "hysteresis_rounds": 2, "cooldown_s": 300.0,
+                },
+            },
+        }
+        servers[g] = LiveServer(tmp_path / g, name=g, port=ports[g],
+                                max_kills=0, overrides=overrides)
+    stop_throttle = threading.Event()
+
+    def throttle(pid):
+        # ~90% frozen duty cycle with freeze windows LONGER than the
+        # 1.5s peer-probe timeout: health probes of the frozen leader
+        # time out (-> scored unreachable-hot), while the puller's
+        # 10s-timeout migrate POST still lands in a CONT window
+        while not stop_throttle.is_set():
+            os.kill(pid, signal.SIGSTOP)
+            time.sleep(2.8)
+            os.kill(pid, signal.SIGCONT)
+            time.sleep(0.3)
+
+    try:
+        for s in servers.values():
+            s.start()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                if servers["cold"].debug().get("federation", {}) \
+                        .get("rebalance", {}).get("enabled"):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        pid = servers["hot"].sup._proc.pid
+        th = threading.Thread(target=throttle, args=(pid,),
+                              daemon=True)
+        th.start()
+        # the pull: pool-hot's owner flips to cold within policy
+        # cadence (hysteresis=2 at 0.5s + drain — bound generously)
+        def _owns_pool_hot():
+            fed = servers["cold"].debug().get("federation", {})
+            entry = (fed.get("pools") or {}).get("pool-hot") or {}
+            return bool(entry.get("local"))
+
+        moved_at = None
+        deadline = time.monotonic() + 45.0
+        while time.monotonic() < deadline:
+            if _owns_pool_hot():
+                moved_at = time.monotonic()
+                break
+            time.sleep(0.3)
+        assert moved_at is not None, \
+            f"policy never moved pool-hot: " \
+            f"{servers['cold'].debug().get('federation')}"
+        stop_throttle.set()
+        th.join(timeout=3.0)
+        try:
+            os.kill(pid, signal.SIGCONT)
+        except OSError:
+            pass
+        # no flap: several cadences later the pool is still here and
+        # exactly one policy migration was acted (cooldown holds even
+        # though the source has healed)
+        time.sleep(3.0)
+        fed = servers["cold"].debug().get("federation", {})
+        assert _owns_pool_hot(), fed
+        reb = fed.get("rebalance") or {}
+        moves = [d for d in reb.get("decisions", [])
+                 if d.get("outcome") == "ok"]
+        assert len(moves) == 1, reb
+        with urllib.request.urlopen(urls["cold"] + "/metrics",
+                                    timeout=5.0) as resp:
+            metrics = resp.read().decode()
+        assert 'cook_federation_policy_migrations_total{' in metrics
+    finally:
+        stop_throttle.set()
+        try:
+            os.kill(servers["hot"].sup._proc.pid, signal.SIGCONT)
+        except Exception:
+            pass
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------
+# deterministic units: ledger, bootstrap, swap, schedule, policy core
+# ---------------------------------------------------------------------
+
+def test_membership_ledger_append_and_replay(tmp_path):
+    log = str(tmp_path / "events.log")
+    s = JobStore(log_path=log)
+    ep = s.append_membership("begin", action="reload",
+                             target={"a": {"pools": ["p"]}},
+                             owner="admin")
+    assert ep == 1
+    s.append_membership("commit", action="reload", mepoch=ep,
+                        owner="admin")
+    ep2 = s.append_membership("begin", action="reload",
+                              target={"a": {}, "b": {}}, owner="x")
+    assert ep2 == 2
+    # a SECOND handle over the same files reads the fsync'd records
+    recs = JobStore(log_path=log).membership_records()
+    assert [(r["mepoch"], r["phase"]) for r in recs] == \
+        [(1, "begin"), (1, "commit"), (2, "begin")]
+    assert recs[0]["target"] == {"a": {"pools": ["p"]}}
+
+
+def test_bootstrap_membership_replay_and_dangling(tmp_path):
+    log = str(tmp_path / "events.log")
+    s = JobStore(log_path=log)
+    committed = {"a": {"pools": ["pa"], "url": "http://a:1"},
+                 "b": {"pools": ["pb"], "url": "http://b:1"}}
+    e1 = s.append_membership("begin", action="reload",
+                             target=committed, owner="admin")
+    s.append_membership("commit", action="reload", mepoch=e1)
+    dangling_target = {"a": {"pools": ["pa"], "url": "http://a:1"}}
+    e2 = s.append_membership("begin", action="reload",
+                             target=dangling_target, owner="admin")
+    fed = FederationHost(group="a", groups={"a": {"pools": ["pa"]}},
+                         store=s)
+    pending = fed.bootstrap_membership()
+    # committed view replayed over the (stale) config view...
+    assert set(fed.groups) == {"a", "b"}
+    assert fed.membership_epoch == e1
+    assert fed.pools_of("b") == ["pb"]
+    # ...and the uncommitted begin parked for the server to resume
+    assert pending is not None and pending["mepoch"] == e2
+    assert fed.pending_reload["target"] == dangling_target
+    # an ABORTED begin is not resumable and never bumps the epoch
+    s.append_membership("abort", action="reload", mepoch=e2)
+    fed2 = FederationHost(group="a", groups={"a": {"pools": ["pa"]}},
+                          store=s)
+    assert fed2.bootstrap_membership() is None
+    assert fed2.membership_epoch == e1
+
+
+def test_swap_membership_preserves_runtime_migrations():
+    fed = FederationHost(group="a", groups={
+        "a": {"pools": ["pa"]}, "b": {"pools": ["pb"]},
+        "c": {"pools": ["pc"]}})
+    # a live migration the fleet already committed: pb moved a <- b
+    fed.reassign("pb", "a")
+    # reload drops c; its pool is claimed by b in the target spec
+    target = {"a": {"pools": ["pa"]},
+              "b": {"pools": ["pb", "pc"]}}
+    fed._swap_membership(target, 1, note="test")
+    # the runtime overlay survives the swap (pb stays migrated to a,
+    # the spec's stale claim does NOT undo it)...
+    assert fed.pools_of("a") == ["pa", "pb"]
+    # ...while the departed group's pool follows the target claim
+    assert fed.pools_of("c") == []
+    assert fed.pools_of("b") == ["pc"]
+    assert fed.membership_epoch == 1
+    assert fed.membership_view() == {"epoch": 1, "groups": ["a", "b"]}
+
+
+def test_membership_churn_deterministic_and_upgrades():
+    a = generate_membership_churn(7, 30.0, joins=2, leaves=2,
+                                  kill_mid_reload=True,
+                                  kill_mid_drain=True, leave_hot=True)
+    b = generate_membership_churn(7, 30.0, joins=2, leaves=2,
+                                  kill_mid_reload=True,
+                                  kill_mid_drain=True, leave_hot=True)
+    assert [e.as_dict() for e in a.events] == \
+        [e.as_dict() for e in b.events]
+    acts = [e.action for e in a.events]
+    # joins precede leaves; flags upgrade in place (never add events)
+    assert acts == [MEMBER_JOIN, MEMBER_JOIN_KILL, MEMBER_LEAVE_HOT,
+                    MEMBER_LEAVE_KILL]
+    ts = [e.t_s for e in a.events]
+    assert all(t2 - t1 >= 5.0 - 1e-6 for t1, t2 in zip(ts, ts[1:]))
+    # the stop variant carries its freeze window
+    c = generate_membership_churn(7, 30.0, joins=0, leaves=1,
+                                  stop_departing=True)
+    assert c.events[0].action == MEMBER_LEAVE_STOP
+    assert c.events[0].down_s > 0
+
+
+def _entry(status="healthy", overload=0, stale=0):
+    return {"status": status, "overload_level": overload,
+            "exchange": {f"g{i}": {"stale": True}
+                         for i in range(stale)}}
+
+
+def test_rebalancer_hysteresis_cooldown_and_single_pull():
+    fed = FederationHost(group="cold", groups={
+        "cold": {"pools": ["pc"]},
+        "hot": {"pools": ["ph1", "ph2"]}})
+    moves = []
+    reb = FleetRebalancer(
+        fed, {"enabled": True, "hysteresis_rounds": 2,
+              "cooldown_s": 300.0},
+        migrate_fn=lambda pool, src, dst: moves.append(
+            (pool, src, dst)) or True)
+    rollup = {"groups": {"cold": _entry(),
+                         "hot": _entry(status="unreachable")}}
+    # round 1: hot observed but hysteresis not met -> no action
+    assert reb.tick(rollup) is None and not moves
+    # round 2: streak reached -> exactly one pool pulled
+    d = reb.tick(rollup)
+    assert d and d["outcome"] == "ok" and moves == \
+        [("ph1", "hot", "cold")]
+    fed.reassign("ph1", "cold")   # what the real migrate would do
+    # round 3: streak was reset by acting -> no immediate second pull
+    assert reb.tick(rollup) is None
+    # round 4: streak is ripe again -> the OTHER pool moves (ph1 is
+    # ours now; at most one migration per tick throughout)
+    d2 = reb.tick(rollup)
+    assert d2 and d2["pool"] == "ph2"
+    fed.reassign("ph2", "cold")
+    # hot has nothing left: ripe streak but no pool -> no action, and
+    # the moved pools are cooldown-locked against flapping back
+    reb.tick(rollup)
+    assert reb.tick(rollup) is None
+    assert all(t > 0 for t in reb._cooldown_until.values())
+    assert len(moves) == 2
+
+
+def test_rebalancer_cold_guard_and_failure_cooldown():
+    fed = FederationHost(group="me", groups={
+        "me": {"pools": ["pm"]}, "peer": {"pools": ["pp"]}})
+    calls = []
+    reb = FleetRebalancer(
+        fed, {"enabled": True, "hysteresis_rounds": 1,
+              "cooldown_s": 300.0},
+        migrate_fn=lambda *a: calls.append(a) or False)
+    hot = _entry(status="unreachable")
+    # a BUSY group never pulls, even with a ripe hot peer
+    rollup_busy = {"groups": {"me": _entry(overload=2), "peer": hot}}
+    assert reb.tick(rollup_busy) is None and not calls
+    # cold now: pull attempted, source fails -> cooldown STILL set so
+    # a frozen source is not hammered every tick
+    rollup_cold = {"groups": {"me": _entry(), "peer": hot}}
+    d = reb.tick(rollup_cold)
+    assert d and d["outcome"] == "failed" and len(calls) == 1
+    assert reb.tick(rollup_cold) is None   # pp cooldown-locked
+    assert len(calls) == 1
+
+
+def test_validate_federation_rejects_bad_rebalance():
+    base = {"group": "a", "groups": {"a": {"pools": ["p"]}}}
+    validate_federation(dict(base, rebalance=dict(REBALANCE_DEFAULTS)))
+    with pytest.raises(ConfigError):
+        validate_federation(dict(base, rebalance={"bogus_knob": 1}))
+    with pytest.raises(ConfigError):
+        validate_federation(dict(base, rebalance={"interval_s": 0}))
+    with pytest.raises(ConfigError):
+        validate_federation(
+            dict(base, rebalance={"hysteresis_rounds": 0}))
+    with pytest.raises(ConfigError):
+        validate_federation(dict(base, rebalance=[1, 2]))
